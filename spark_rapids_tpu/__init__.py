@@ -1,0 +1,27 @@
+"""spark_rapids_tpu: a TPU-native SQL acceleration framework.
+
+A from-scratch re-design of the capability set of NVIDIA's RAPIDS Accelerator
+for Apache Spark (reference: /root/reference, spark-rapids v25.02), built
+TPU-first on JAX/XLA/Pallas:
+
+- TPU-resident Arrow-compatible columnar batches (columnar/)
+- Spark-exact expression engine compiled to fused XLA (exprs/)
+- physical operators: scan/project/filter/hash-agg/sort/join/... (exec/)
+- plan rewrite with per-operator CPU fallback (plan/, cpu/)
+- HBM accounting pool, device->host->disk spill, OOM retry/split (mem/)
+- columnar shuffle: kudo-style host serialization + ICI all_to_all (shuffle/)
+- device-mesh parallelism helpers (parallel/)
+
+Reference architecture map: SURVEY.md sections 1-2.
+"""
+
+import jax as _jax
+
+# Spark semantics are 64-bit (LongType, DoubleType, TimestampType micros).
+# The whole framework assumes x64 is on; see docs/design.md.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from spark_rapids_tpu import types  # noqa: E402,F401
+from spark_rapids_tpu.config.conf import RapidsConf  # noqa: E402,F401

@@ -1,0 +1,7 @@
+from spark_rapids_tpu.columnar.column import DeviceColumn, ColVal  # noqa: F401
+from spark_rapids_tpu.columnar.batch import (  # noqa: F401
+    ColumnarBatch,
+    batch_from_arrow,
+    batch_to_arrow,
+    bucket_capacity,
+)

@@ -1,0 +1,238 @@
+"""TPU-resident columnar batches + Arrow interop.
+
+The batch is the unit of work flowing between operators, replacing the
+reference's ``ColumnarBatch`` of ``GpuColumnVector`` (reference:
+GpuColumnVector.java:40; transitions in GpuRowToColumnarExec.scala /
+HostColumnarToGpu.scala). TPU-first differences:
+
+- batches are pytrees of statically-shaped jnp arrays; ``num_rows`` is a
+  traced int32 scalar so one compiled kernel serves every batch in the same
+  capacity bucket;
+- host<->device moves are whole-buffer ``jax.device_put`` / ``np.asarray``
+  against Arrow buffers (zero copy on host side where possible).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import (
+    DeviceColumn,
+    make_fixed_column,
+    make_string_column,
+)
+
+
+def bucket_capacity(n: int, min_bucket: int = 1024) -> int:
+    """Round a row count up to the next power-of-two bucket (compile-cache
+    friendly: capacity is a static shape)."""
+    cap = max(int(min_bucket), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ColumnarBatch:
+    """A TPU-resident batch: columns + live row count.
+
+    ``num_rows`` is a jnp int32 scalar (traced); ``capacity`` is static.
+    """
+
+    columns: List[DeviceColumn]
+    num_rows: jax.Array  # int32 scalar
+
+    def tree_flatten(self):
+        return (self.columns, self.num_rows), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        columns, num_rows = children
+        return cls(list(columns), num_rows)
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return 0
+        return self.columns[0].capacity
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    def dtypes(self) -> List[T.DataType]:
+        return [c.dtype for c in self.columns]
+
+    def row_count(self) -> int:
+        """Host-side row count (blocks on device value)."""
+        return int(self.num_rows)
+
+    def active_mask(self) -> jax.Array:
+        """Boolean mask of live rows (True for i < num_rows)."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+    def column(self, i: int) -> DeviceColumn:
+        return self.columns[i]
+
+
+def empty_batch(dtypes: Sequence[T.DataType], capacity: int = 1024) -> ColumnarBatch:
+    cols = []
+    for dt in dtypes:
+        if dt.fixed_width:
+            cols.append(
+                make_fixed_column(dt, np.zeros(0, T.numpy_dtype(dt)), None, capacity)
+            )
+        else:
+            cols.append(
+                make_string_column(
+                    np.zeros(0, np.uint8), np.zeros(1, np.int32), None, capacity, 8, dt
+                )
+            )
+    return ColumnarBatch(cols, jnp.int32(0))
+
+
+def _arrow_fixed_to_numpy(arr: pa.Array, dt: T.DataType):
+    """Extract (values, valid) numpy arrays from a fixed-width arrow array."""
+    np_dtype = T.numpy_dtype(dt)
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    valid = (
+        None
+        if arr.null_count == 0
+        else np.asarray(arr.is_valid(), dtype=np.bool_)
+    )
+    if isinstance(dt, T.DecimalType):
+        # arrow decimal128 -> int64 unscaled: the 16-byte little-endian value's
+        # low limb is the full value for p<=18 (|v| < 2^63).
+        limbs = np.frombuffer(
+            arr.buffers()[1], dtype=np.int64, count=2 * len(arr),
+            offset=arr.offset * 16,
+        )
+        values = limbs[0::2].copy()
+    elif dt == T.TIMESTAMP:
+        values = np.asarray(arr.fill_null(0).cast(pa.int64()))
+    elif dt == T.DATE:
+        values = np.asarray(arr.fill_null(0).cast(pa.int32()))
+    elif dt == T.BOOLEAN:
+        values = np.asarray(arr.fill_null(False).cast(pa.int8())).astype(np.bool_)
+    else:
+        values = np.asarray(arr.fill_null(0)).astype(np_dtype, copy=False)
+    if valid is not None:
+        values = np.where(valid, values, np.zeros((), np_dtype))
+    return values, valid
+
+
+def batch_from_arrow(
+    table, min_bucket: int = 1024, capacity: Optional[int] = None
+) -> ColumnarBatch:
+    """Host Arrow table/record-batch -> padded device batch."""
+    if isinstance(table, pa.RecordBatch):
+        table = pa.table(table)
+    n = table.num_rows
+    cap = capacity if capacity is not None else bucket_capacity(n, min_bucket)
+    cols: List[DeviceColumn] = []
+    for name in table.column_names:
+        arr = table.column(name).combine_chunks()
+        dt = T.from_arrow_type(arr.type)
+        if dt.fixed_width:
+            values, valid = _arrow_fixed_to_numpy(arr, dt)
+            cols.append(make_fixed_column(dt, values, valid, cap))
+        else:
+            sarr = arr.cast(pa.string()) if dt == T.STRING else arr.cast(pa.binary())
+            valid = (
+                None
+                if sarr.null_count == 0
+                else np.asarray(sarr.is_valid(), dtype=np.bool_)
+            )
+            # arrow string arrays: buffers()[1] = offsets, [2] = data
+            offsets = np.frombuffer(sarr.buffers()[1], dtype=np.int32,
+                                    count=n + 1, offset=sarr.offset * 4).copy()
+            offsets -= offsets[0]
+            databuf = sarr.buffers()[2]
+            nbytes = int(offsets[-1])
+            if databuf is None:
+                data = np.zeros(0, np.uint8)
+            else:
+                start = np.frombuffer(sarr.buffers()[1], dtype=np.int32,
+                                      count=1, offset=sarr.offset * 4)[0]
+                data = np.frombuffer(databuf, dtype=np.uint8,
+                                     count=nbytes, offset=int(start)).copy()
+            byte_cap = bucket_capacity(max(nbytes, 8), 8)
+            cols.append(
+                make_string_column(data, offsets, valid, cap, byte_cap, dt)
+            )
+    return ColumnarBatch(cols, jnp.int32(n))
+
+
+def batch_to_arrow(batch: ColumnarBatch, schema: T.Schema) -> pa.Table:
+    """Device batch -> host Arrow table (slices away padding)."""
+    n = batch.row_count()
+    arrays = []
+    for col, field in zip(batch.columns, schema):
+        dt = field.dtype
+        valid_np = np.asarray(col.validity)[:n]
+        mask = None if valid_np.all() else ~valid_np
+        if dt.fixed_width:
+            values = np.asarray(col.data)[:n]
+            if isinstance(dt, T.DecimalType):
+                import decimal as _d
+
+                scale = _d.Decimal(1).scaleb(-dt.scale)
+                pyvals = [
+                    None if (mask is not None and mask[i]) else
+                    _d.Decimal(int(values[i])) * scale
+                    for i in range(n)
+                ]
+                arr = pa.array(pyvals, type=dt.arrow_type())
+            elif dt == T.DATE:
+                arr = pa.array(values.astype(np.int32), type=pa.int32(), mask=mask)
+                arr = arr.cast(pa.date32())
+            elif dt == T.TIMESTAMP:
+                arr = pa.array(values.astype(np.int64), type=pa.int64(), mask=mask)
+                arr = arr.cast(pa.timestamp("us", tz="UTC"))
+            else:
+                arr = pa.array(values, type=dt.arrow_type(), mask=mask)
+        else:
+            offsets = np.asarray(col.offsets)[: n + 1]
+            data = np.asarray(col.data)[: int(offsets[-1]) if n else 0]
+            arr = pa.Array.from_buffers(
+                pa.string() if dt == T.STRING else pa.binary(),
+                n,
+                [
+                    _validity_buffer(valid_np) if mask is not None else None,
+                    pa.py_buffer(offsets.astype(np.int32).tobytes()),
+                    pa.py_buffer(data.tobytes()),
+                ],
+            )
+        arrays.append(arr)
+    return pa.table(arrays, schema=schema.to_arrow())
+
+
+def _validity_buffer(valid: np.ndarray) -> pa.Buffer:
+    return pa.py_buffer(np.packbits(valid, bitorder="little").tobytes())
+
+
+def concat_batches(
+    batches: Sequence[ColumnarBatch], schema: T.Schema, min_bucket: int = 1024
+) -> ColumnarBatch:
+    """Concatenate device batches (host-coordinated; used by coalesce).
+
+    Mirrors the reference's GpuCoalesceBatches concat (GpuCoalesceBatches.scala:160)
+    but implemented as an Arrow-level host concat + single upload when sizes
+    are heterogeneous, matching the GpuShuffleCoalesceExec pattern of one
+    upload per coalesced output (GpuShuffleCoalesceExec.scala:49).
+    """
+    if len(batches) == 1:
+        return batches[0]
+    tables = [batch_to_arrow(b, schema) for b in batches]
+    return batch_from_arrow(pa.concat_tables(tables), min_bucket)

@@ -1,0 +1,123 @@
+"""TPU-resident columns: Arrow-compatible layout as JAX arrays.
+
+Re-designs the reference's device column (reference:
+sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:40,
+backed by ai.rapids.cudf.ColumnVector) for XLA: a column is a pytree of
+fixed-shape jnp arrays so whole batches flow through jit-compiled kernels.
+
+Layout (Arrow-compatible so host interop is a memcpy):
+- fixed-width: ``data``  shape (capacity,)           value buffer
+               ``validity`` shape (capacity,) bool   True = valid
+- string/bin:  ``data``  shape (byte_capacity,) uint8  concatenated bytes
+               ``offsets`` shape (capacity+1,) int32   row i = data[off[i]:off[i+1]]
+               ``validity`` as above
+
+Capacity is a *static* (padded, power-of-two-bucketed) shape; the live row
+count travels separately in the batch so XLA compiles one kernel per bucket,
+not per row count. Padding rows always have validity False and zeroed data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+
+class ColVal(NamedTuple):
+    """An expression value: fixed-width data + validity, inside a kernel."""
+
+    data: jax.Array
+    validity: jax.Array  # bool, same shape as data
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceColumn:
+    """One column of a TPU-resident batch."""
+
+    dtype: T.DataType
+    data: jax.Array
+    validity: jax.Array
+    offsets: Optional[jax.Array] = None  # only for string/binary
+
+    def tree_flatten(self):
+        if self.offsets is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.offsets), (self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, has_offsets = aux
+        if has_offsets:
+            data, validity, offsets = children
+            return cls(dtype, data, validity, offsets)
+        data, validity = children
+        return cls(dtype, data, validity, None)
+
+    @property
+    def capacity(self) -> int:
+        if self.offsets is not None:
+            return self.offsets.shape[0] - 1
+        return self.data.shape[0]
+
+    @property
+    def byte_capacity(self) -> int:
+        assert self.offsets is not None
+        return self.data.shape[0]
+
+    def nbytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize
+        n += self.validity.size  # bool = 1 byte on device accounting
+        if self.offsets is not None:
+            n += self.offsets.size * 4
+        return n
+
+    def as_colval(self) -> ColVal:
+        assert self.offsets is None, "ColVal is fixed-width only"
+        return ColVal(self.data, self.validity)
+
+    @staticmethod
+    def from_colval(dtype: T.DataType, cv: ColVal) -> "DeviceColumn":
+        return DeviceColumn(dtype, cv.data, cv.validity)
+
+
+def make_fixed_column(
+    dtype: T.DataType, values: np.ndarray, valid: Optional[np.ndarray], capacity: int
+) -> DeviceColumn:
+    """Build a padded device column from host numpy values."""
+    n = len(values)
+    np_dtype = T.numpy_dtype(dtype)
+    data = np.zeros(capacity, dtype=np_dtype)
+    data[:n] = values
+    validity = np.zeros(capacity, dtype=np.bool_)
+    validity[:n] = True if valid is None else valid
+    # zero out data where invalid so padding/nulls are deterministic
+    data[~validity] = 0
+    return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(validity))
+
+
+def make_string_column(
+    values_bytes: np.ndarray,
+    offsets: np.ndarray,
+    valid: Optional[np.ndarray],
+    capacity: int,
+    byte_capacity: int,
+    dtype: T.DataType = T.STRING,
+) -> DeviceColumn:
+    """Build a padded string column from host byte/offset buffers."""
+    n = len(offsets) - 1
+    data = np.zeros(byte_capacity, dtype=np.uint8)
+    data[: len(values_bytes)] = values_bytes
+    off = np.full(capacity + 1, offsets[-1], dtype=np.int32)
+    off[: n + 1] = offsets
+    validity = np.zeros(capacity, dtype=np.bool_)
+    validity[:n] = True if valid is None else valid
+    return DeviceColumn(
+        dtype, jnp.asarray(data), jnp.asarray(validity), jnp.asarray(off)
+    )

@@ -1,0 +1,1 @@
+from spark_rapids_tpu.config.conf import RapidsConf, ConfEntry  # noqa: F401

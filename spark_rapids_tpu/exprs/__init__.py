@@ -1,0 +1,2 @@
+from spark_rapids_tpu.exprs.expr import *  # noqa: F401,F403
+from spark_rapids_tpu.exprs.eval import bind_projection, compile_projection  # noqa: F401

@@ -1,0 +1,772 @@
+"""Expression trees with Spark-exact typing rules.
+
+The TPU analog of the reference's expression surface (reference:
+GpuOverrides.scala:911 commonExpressions — 222 expr rules; impls under
+org/apache/spark/sql/rapids/arithmetic.scala, predicates.scala,
+stringFunctions.scala, datetimeExpressions.scala). Instead of per-expression
+cudf kernel calls, an expression tree is *compiled*: the whole bound
+projection/filter lowers to one fused XLA computation (see eval.py), which is
+the TPU-idiomatic equivalent of the reference's tiered projection
+(basicPhysicalOperators.scala:806 GpuTieredProject) — XLA does the fusion.
+
+Null semantics: every expression evaluates to (data, validity); most
+expressions are null-intolerant (validity = AND of children), with explicit
+exceptions (And/Or three-valued logic, IsNull, Coalesce, ...).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+
+
+class Expression:
+    children: Tuple["Expression", ...] = ()
+
+    @property
+    def dtype(self) -> T.DataType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children)
+
+    def __repr__(self):
+        name = type(self).__name__
+        if self.children:
+            return f"{name}({', '.join(map(repr, self.children))})"
+        return name
+
+    # Builder sugar so tests/plans read naturally
+    def __add__(self, other):
+        return Add(self, _lit(other))
+
+    def __sub__(self, other):
+        return Subtract(self, _lit(other))
+
+    def __mul__(self, other):
+        return Multiply(self, _lit(other))
+
+    def __and__(self, other):
+        return And(self, _lit(other))
+
+    def __or__(self, other):
+        return Or(self, _lit(other))
+
+    def __invert__(self):
+        return Not(self)
+
+    def __lt__(self, other):
+        return LessThan(self, _lit(other))
+
+    def __le__(self, other):
+        return LessThanOrEqual(self, _lit(other))
+
+    def __gt__(self, other):
+        return GreaterThan(self, _lit(other))
+
+    def __ge__(self, other):
+        return GreaterThanOrEqual(self, _lit(other))
+
+    def eq(self, other):
+        return EqualTo(self, _lit(other))
+
+    def ne(self, other):
+        return Not(EqualTo(self, _lit(other)))
+
+    def is_null(self):
+        return IsNull(self)
+
+    def is_not_null(self):
+        return IsNotNull(self)
+
+    def cast(self, dtype: T.DataType):
+        return Cast(self, dtype)
+
+    def alias(self, name: str):
+        return Alias(self, name)
+
+
+def _lit(v) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    return Literal.of(v)
+
+
+@dataclasses.dataclass(frozen=True, repr=False, eq=False)
+class ColumnRef(Expression):
+    """Reference to an input column by ordinal (bound) with known type."""
+
+    index: int
+    _dtype: T.DataType
+    _nullable: bool = True
+    name: str = ""
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def __repr__(self):
+        return f"col#{self.index}:{self._dtype}"
+
+
+@dataclasses.dataclass(frozen=True, repr=False, eq=False)
+class UnresolvedColumn(Expression):
+    """Column referenced by name; resolved against a schema at bind time."""
+
+    name: str
+
+    @property
+    def dtype(self):
+        raise TypeError(f"unresolved column {self.name!r} has no type yet")
+
+    def __repr__(self):
+        return f"col({self.name!r})"
+
+
+def col(name: str) -> UnresolvedColumn:
+    return UnresolvedColumn(name)
+
+
+@dataclasses.dataclass(frozen=True, repr=False, eq=False)
+class Literal(Expression):
+    value: Any
+    _dtype: T.DataType
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    @staticmethod
+    def of(v, dtype: Optional[T.DataType] = None) -> "Literal":
+        if dtype is None:
+            if isinstance(v, bool):
+                dtype = T.BOOLEAN
+            elif isinstance(v, int):
+                dtype = T.INT if -(2**31) <= v < 2**31 else T.LONG
+            elif isinstance(v, float):
+                dtype = T.DOUBLE
+            elif isinstance(v, str):
+                dtype = T.STRING
+            elif v is None:
+                dtype = T.NULL
+            else:
+                import decimal
+                import datetime
+
+                if isinstance(v, decimal.Decimal):
+                    sign, digits, exp = v.as_tuple()
+                    scale = max(0, -exp)
+                    dtype = T.DecimalType(max(len(digits), scale), scale)
+                elif isinstance(v, datetime.date):
+                    dtype = T.DATE
+                else:
+                    raise TypeError(f"cannot infer literal type for {v!r}")
+        return Literal(v, dtype)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def lit(v, dtype: Optional[T.DataType] = None) -> Literal:
+    return Literal.of(v, dtype)
+
+
+@dataclasses.dataclass(frozen=True, repr=False, eq=False)
+class Alias(Expression):
+    child: Expression
+    name: str
+
+    @property
+    def children(self):  # type: ignore[override]
+        return (self.child,)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    def __repr__(self):
+        return f"{self.child!r} AS {self.name}"
+
+
+class _Binary(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.left = left
+        self.right = right
+        self.children = (left, right)
+
+
+class _Unary(Expression):
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+
+def _numeric_widen(a: T.DataType, b: T.DataType) -> T.DataType:
+    """Spark's binary-arithmetic common type (simplified: no implicit string)."""
+    order = [T.BYTE, T.SHORT, T.INT, T.LONG, T.FLOAT, T.DOUBLE]
+    if isinstance(a, T.DecimalType) or isinstance(b, T.DecimalType):
+        if isinstance(a, T.DecimalType) and isinstance(b, T.DecimalType):
+            return a  # same-type ops handled per-op for precision/scale
+        dec = a if isinstance(a, T.DecimalType) else b
+        other = b if isinstance(a, T.DecimalType) else a
+        if other in (T.FLOAT, T.DOUBLE):
+            return T.DOUBLE
+        return dec
+    if a not in order or b not in order:
+        raise TypeError(f"no common numeric type for {a}, {b}")
+    return order[max(order.index(a), order.index(b))]
+
+
+class BinaryArithmetic(_Binary):
+    symbol = "?"
+
+    @property
+    def dtype(self):
+        lt, rt = self.left.dtype, self.right.dtype
+        if isinstance(lt, T.DecimalType) and isinstance(rt, T.DecimalType):
+            return self._decimal_result(lt, rt)
+        return _numeric_widen(lt, rt)
+
+    def _decimal_result(self, lt: T.DecimalType, rt: T.DecimalType) -> T.DataType:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"({self.left!r} {self.symbol} {self.right!r})"
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def _decimal_result(self, lt, rt):
+        # Spark DecimalPrecision: p = max(p1-s1, p2-s2) + max(s1,s2) + 1
+        s = max(lt.scale, rt.scale)
+        p = max(lt.precision - lt.scale, rt.precision - rt.scale) + s + 1
+        return T.DecimalType(min(p, 38), s)
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+    _decimal_result = Add._decimal_result
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def _decimal_result(self, lt, rt):
+        return T.DecimalType(min(lt.precision + rt.precision + 1, 38),
+                             lt.scale + rt.scale)
+
+
+class Divide(BinaryArithmetic):
+    """Spark Divide: always fractional (double or decimal)."""
+
+    symbol = "/"
+
+    @property
+    def dtype(self):
+        lt, rt = self.left.dtype, self.right.dtype
+        if isinstance(lt, T.DecimalType) and isinstance(rt, T.DecimalType):
+            # Spark: s = max(6, s1 + p2 + 1); p = p1 - s1 + s2 + s
+            s = max(6, lt.scale + rt.precision + 1)
+            p = lt.precision - lt.scale + rt.scale + s
+            return T.DecimalType(min(p, 38), min(s, 38))
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True  # x / 0 -> null in non-ANSI mode
+
+
+class IntegralDivide(BinaryArithmetic):
+    symbol = "div"
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Remainder(BinaryArithmetic):
+    symbol = "%"
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Pmod(BinaryArithmetic):
+    symbol = "pmod"
+
+    @property
+    def nullable(self):
+        return True
+
+
+class UnaryMinus(_Unary):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class Abs(_Unary):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class BinaryComparison(_Binary):
+    symbol = "?"
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    def __repr__(self):
+        return f"({self.left!r} {self.symbol} {self.right!r})"
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+
+class EqualNullSafe(BinaryComparison):
+    symbol = "<=>"
+
+    @property
+    def nullable(self):
+        return False
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+
+class And(_Binary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class Or(_Binary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class Not(_Unary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class IsNull(_Unary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+
+class IsNotNull(_Unary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+
+class IsNaN(_Unary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Coalesce(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return all(c.nullable for c in self.children)
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, true_val: Expression, false_val: Expression):
+        self.children = (pred, true_val, false_val)
+
+    @property
+    def dtype(self):
+        return self.children[1].dtype
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... ELSE e END."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        self.branches = list(branches)
+        self.else_value = else_value
+        flat: List[Expression] = []
+        for p, v in self.branches:
+            flat += [p, v]
+        if else_value is not None:
+            flat.append(else_value)
+        self.children = tuple(flat)
+
+    @property
+    def dtype(self):
+        return self.branches[0][1].dtype
+
+
+class In(Expression):
+    """value IN (list of literals)."""
+
+    def __init__(self, value: Expression, items: Sequence[Expression]):
+        self.value = value
+        self.items = tuple(items)
+        self.children = (value,) + self.items
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+@dataclasses.dataclass(frozen=True, repr=False, eq=False)
+class Cast(Expression):
+    child: Expression
+    to: T.DataType
+    ansi: bool = False
+
+    @property
+    def children(self):  # type: ignore[override]
+        return (self.child,)
+
+    @property
+    def dtype(self):
+        return self.to
+
+    def __repr__(self):
+        return f"cast({self.child!r} as {self.to})"
+
+
+# --- math on doubles (Spark semantics: java.lang.Math) ---
+class _UnaryMath(_Unary):
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+class Sqrt(_UnaryMath):
+    @property
+    def nullable(self):
+        return True
+
+
+class Floor(_Unary):
+    @property
+    def dtype(self):
+        c = self.child.dtype
+        return c if isinstance(c, T.DecimalType) else T.LONG
+
+
+class Ceil(Floor):
+    pass
+
+
+class Round(Expression):
+    def __init__(self, child: Expression, scale: int = 0):
+        self.child = child
+        self.scale = scale
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class Exp(_UnaryMath):
+    pass
+
+
+class Log(_UnaryMath):
+    @property
+    def nullable(self):
+        return True
+
+
+class Pow(_Binary):
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+# --- datetime ---
+class _DatePart(_Unary):
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class Year(_DatePart):
+    pass
+
+
+class Month(_DatePart):
+    pass
+
+
+class DayOfMonth(_DatePart):
+    pass
+
+
+class DayOfWeek(_DatePart):
+    pass
+
+
+class DayOfYear(_DatePart):
+    pass
+
+
+class Quarter(_DatePart):
+    pass
+
+
+class DateAdd(_Binary):
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+class DateSub(_Binary):
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+class DateDiff(_Binary):
+    @property
+    def dtype(self):
+        return T.INT
+
+
+# --- strings (device kernels over offsets+bytes; see eval.py strings section) ---
+class Length(_Unary):
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class Upper(_Unary):
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class Lower(_Unary):
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class StartsWith(_Binary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class EndsWith(_Binary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class Contains(_Binary):
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class Substring(Expression):
+    """substring(str, pos, len) with Spark 1-based/negative-pos semantics."""
+
+    def __init__(self, child: Expression, pos: int, length: int):
+        self.child = child
+        self.pos = pos
+        self.length = length
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+# --- aggregate functions (consumed by exec/aggregate.py) ---
+class AggregateExpression(Expression):
+    """Marker base; these only appear inside aggregation execs
+    (reference: aggregate functions in GpuAggregateExec.scala / aggregate.scala)."""
+
+
+class Sum(AggregateExpression, _Unary):
+    @property
+    def dtype(self):
+        c = self.child.dtype
+        if isinstance(c, T.DecimalType):
+            return T.DecimalType(min(38, c.precision + 10), c.scale)
+        if c in (T.BYTE, T.SHORT, T.INT, T.LONG):
+            return T.LONG
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Count(AggregateExpression, Expression):
+    def __init__(self, child: Optional[Expression] = None):
+        self.child = child
+        self.children = (child,) if child is not None else ()
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Min(AggregateExpression, _Unary):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Max(AggregateExpression, _Unary):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Average(AggregateExpression, _Unary):
+    @property
+    def dtype(self):
+        c = self.child.dtype
+        if isinstance(c, T.DecimalType):
+            return T.DecimalType(min(38, c.precision + 4), min(38, c.scale + 4))
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+
+class First(AggregateExpression, _Unary):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class Last(AggregateExpression, _Unary):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class CountDistinct(AggregateExpression, _Unary):
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+
+def resolve(expr: Expression, schema: T.Schema) -> Expression:
+    """Replace UnresolvedColumn with typed ColumnRef against a schema."""
+    if isinstance(expr, UnresolvedColumn):
+        i = schema.index_of(expr.name)
+        f = schema[i]
+        return ColumnRef(i, f.dtype, f.nullable, f.name)
+    if isinstance(expr, ColumnRef) or isinstance(expr, Literal):
+        return expr
+    # rebuild generically
+    new_children = [resolve(c, schema) for c in expr.children]
+    return _rebuild(expr, new_children)
+
+
+def _rebuild(expr: Expression, new_children: List[Expression]) -> Expression:
+    """Reconstruct an expression with new children (structure-preserving)."""
+    cls = type(expr)
+    if isinstance(expr, Alias):
+        return Alias(new_children[0], expr.name)
+    if isinstance(expr, Cast):
+        return Cast(new_children[0], expr.to, expr.ansi)
+    if isinstance(expr, Substring):
+        return Substring(new_children[0], expr.pos, expr.length)
+    if isinstance(expr, Round):
+        return Round(new_children[0], expr.scale)
+    if isinstance(expr, CaseWhen):
+        n = len(expr.branches)
+        branches = [(new_children[2 * i], new_children[2 * i + 1]) for i in range(n)]
+        else_v = new_children[2 * n] if expr.else_value is not None else None
+        return CaseWhen(branches, else_v)
+    if isinstance(expr, In):
+        return In(new_children[0], new_children[1:])
+    if isinstance(expr, Count):
+        return Count(new_children[0] if new_children else None)
+    if isinstance(expr, Coalesce):
+        return Coalesce(*new_children)
+    if not new_children:
+        return expr
+    return cls(*new_children)

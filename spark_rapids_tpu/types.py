@@ -1,0 +1,351 @@
+"""Spark-compatible data type system mapped onto JAX/Arrow representations.
+
+Mirrors the type surface the reference supports on device (reference:
+sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:40
+``getNonNestedRapidsType``), re-based on jnp dtypes:
+
+- integral types   -> int8/16/32/64 (Java wraparound semantics)
+- float/double     -> float32/float64 (Java/IEEE, NaN ordering handled in ops)
+- boolean          -> bool_
+- date             -> int32 days since epoch
+- timestamp        -> int64 microseconds since epoch (UTC)
+- decimal(p<=18)   -> int64 unscaled value (DECIMAL64, like cudf)
+- string/binary    -> uint8 byte buffer + int32 offsets (Arrow layout)
+
+Nested types (array/struct/map) are represented recursively by the columnar
+layer; see columnar/column.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+
+class DataType:
+    """Base class for SQL data types."""
+
+    #: string name used in schemas / explain output
+    name: str = "?"
+
+    @property
+    def is_numeric(self) -> bool:
+        return False
+
+    @property
+    def fixed_width(self) -> bool:
+        """True if values are fixed-width scalars representable as one jnp array."""
+        return True
+
+    def jnp_dtype(self):
+        raise NotImplementedError(self.name)
+
+    def arrow_type(self) -> pa.DataType:
+        raise NotImplementedError(self.name)
+
+    def element_size(self) -> int:
+        """Bytes per value for fixed-width types."""
+        return np.dtype(self.jnp_dtype()).itemsize
+
+    def __repr__(self) -> str:
+        return self.name
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and repr(self) == repr(other)
+
+    def __hash__(self) -> int:
+        return hash(repr(self))
+
+
+class BooleanType(DataType):
+    name = "boolean"
+
+    def jnp_dtype(self):
+        return jnp.bool_
+
+    def arrow_type(self):
+        return pa.bool_()
+
+
+class _IntegralType(DataType):
+    _bits = 32
+
+    @property
+    def is_numeric(self):
+        return True
+
+
+class ByteType(_IntegralType):
+    name = "tinyint"
+
+    def jnp_dtype(self):
+        return jnp.int8
+
+    def arrow_type(self):
+        return pa.int8()
+
+
+class ShortType(_IntegralType):
+    name = "smallint"
+
+    def jnp_dtype(self):
+        return jnp.int16
+
+    def arrow_type(self):
+        return pa.int16()
+
+
+class IntegerType(_IntegralType):
+    name = "int"
+
+    def jnp_dtype(self):
+        return jnp.int32
+
+    def arrow_type(self):
+        return pa.int32()
+
+
+class LongType(_IntegralType):
+    name = "bigint"
+
+    def jnp_dtype(self):
+        return jnp.int64
+
+    def arrow_type(self):
+        return pa.int64()
+
+
+class FloatType(DataType):
+    name = "float"
+
+    @property
+    def is_numeric(self):
+        return True
+
+    def jnp_dtype(self):
+        return jnp.float32
+
+    def arrow_type(self):
+        return pa.float32()
+
+
+class DoubleType(DataType):
+    name = "double"
+
+    @property
+    def is_numeric(self):
+        return True
+
+    def jnp_dtype(self):
+        return jnp.float64
+
+    def arrow_type(self):
+        return pa.float64()
+
+
+class DateType(DataType):
+    """Days since 1970-01-01, stored int32 (matches Spark/Arrow date32)."""
+
+    name = "date"
+
+    def jnp_dtype(self):
+        return jnp.int32
+
+    def arrow_type(self):
+        return pa.date32()
+
+
+class TimestampType(DataType):
+    """Microseconds since epoch UTC, stored int64 (Spark TimestampType)."""
+
+    name = "timestamp"
+
+    def jnp_dtype(self):
+        return jnp.int64
+
+    def arrow_type(self):
+        return pa.timestamp("us", tz="UTC")
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class DecimalType(DataType):
+    """Decimal with precision/scale. p<=18 stored as int64 unscaled (DECIMAL64).
+
+    The reference relies on cudf DECIMAL32/64/128 (GpuColumnVector.java
+    ``toRapidsOrNull``); we support DECIMAL64 on device in round 1 and fall
+    back to CPU for p>18.
+    """
+
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 38
+    MAX_LONG_DIGITS = 18
+
+    @property
+    def name(self):  # type: ignore[override]
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def is_numeric(self):
+        return True
+
+    def jnp_dtype(self):
+        if self.precision <= self.MAX_LONG_DIGITS:
+            return jnp.int64
+        raise NotImplementedError("decimal128 on device not yet supported")
+
+    def arrow_type(self):
+        return pa.decimal128(self.precision, self.scale)
+
+    def __repr__(self):
+        return self.name
+
+
+class StringType(DataType):
+    name = "string"
+
+    @property
+    def fixed_width(self):
+        return False
+
+    def arrow_type(self):
+        return pa.string()
+
+
+class BinaryType(DataType):
+    name = "binary"
+
+    @property
+    def fixed_width(self):
+        return False
+
+    def arrow_type(self):
+        return pa.binary()
+
+
+class NullType(DataType):
+    name = "void"
+
+    def jnp_dtype(self):
+        return jnp.bool_
+
+    def arrow_type(self):
+        return pa.null()
+
+
+# Singletons (Spark-style)
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+STRING = StringType()
+BINARY = BinaryType()
+NULL = NullType()
+
+INTEGRAL_TYPES = (BYTE, SHORT, INT, LONG)
+FRACTIONAL_TYPES = (FLOAT, DOUBLE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+    def __repr__(self):
+        return f"{self.name}:{self.dtype}{'' if self.nullable else ' not null'}"
+
+
+class Schema:
+    """Ordered collection of named, typed fields."""
+
+    def __init__(self, fields):
+        self.fields = list(fields)
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+
+    @staticmethod
+    def of(*pairs) -> "Schema":
+        return Schema([Field(n, t) for n, t in pairs])
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            return self.fields[self._index[i]]
+        return self.fields[i]
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def names(self):
+        return [f.name for f in self.fields]
+
+    def types(self):
+        return [f.dtype for f in self.fields]
+
+    def to_arrow(self) -> pa.Schema:
+        return pa.schema(
+            [pa.field(f.name, f.dtype.arrow_type(), f.nullable) for f in self.fields]
+        )
+
+    @staticmethod
+    def from_arrow(schema: pa.Schema) -> "Schema":
+        return Schema(
+            [
+                Field(f.name, from_arrow_type(f.type), f.nullable)
+                for f in schema
+            ]
+        )
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(repr(f) for f in self.fields) + ")"
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+
+def from_arrow_type(t: pa.DataType) -> DataType:
+    if pa.types.is_boolean(t):
+        return BOOLEAN
+    if pa.types.is_int8(t):
+        return BYTE
+    if pa.types.is_int16(t):
+        return SHORT
+    if pa.types.is_int32(t):
+        return INT
+    if pa.types.is_int64(t):
+        return LONG
+    if pa.types.is_float32(t):
+        return FLOAT
+    if pa.types.is_float64(t):
+        return DOUBLE
+    if pa.types.is_date32(t):
+        return DATE
+    if pa.types.is_timestamp(t):
+        return TIMESTAMP
+    if pa.types.is_decimal(t):
+        return DecimalType(t.precision, t.scale)
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return STRING
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return BINARY
+    if pa.types.is_null(t):
+        return NULL
+    raise NotImplementedError(f"arrow type {t}")
+
+
+def numpy_dtype(t: DataType):
+    return np.dtype(t.jnp_dtype())

@@ -1,0 +1,25 @@
+"""Test harness: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's approach of testing distributed machinery without a
+cluster (SURVEY.md section 4): jax is forced onto the host platform with 8
+virtual devices so sharding/shuffle tests exercise real collectives.
+"""
+
+import os
+
+# Must happen before jax initializes a backend.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

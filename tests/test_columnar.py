@@ -1,0 +1,105 @@
+"""Columnar core round-trip tests (Arrow <-> device batch)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import batch_from_arrow, batch_to_arrow, bucket_capacity
+
+
+def roundtrip(table: pa.Table):
+    schema = T.Schema.from_arrow(table.schema)
+    b = batch_from_arrow(table)
+    out = batch_to_arrow(b, schema)
+    assert out.equals(table), f"\nexpected:\n{table}\ngot:\n{out}"
+    return b
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 1024
+    assert bucket_capacity(1024) == 1024
+    assert bucket_capacity(1025) == 2048
+    assert bucket_capacity(5, min_bucket=4) == 8
+
+
+def test_ints_roundtrip():
+    t = pa.table({
+        "a": pa.array([1, 2, None, 4], type=pa.int32()),
+        "b": pa.array([10, None, 30, 40], type=pa.int64()),
+        "c": pa.array([1, 2, 3, 4], type=pa.int8()),
+    })
+    b = roundtrip(t)
+    assert b.capacity == 1024
+    assert b.row_count() == 4
+
+
+def test_floats_bools_roundtrip():
+    t = pa.table({
+        "f": pa.array([1.5, None, float("nan"), -0.0], type=pa.float32()),
+        "d": pa.array([2.5, 3.5, None, float("inf")], type=pa.float64()),
+        "x": pa.array([True, False, None, True], type=pa.bool_()),
+    })
+    schema = T.Schema.from_arrow(t.schema)
+    b = batch_from_arrow(t)
+    out = batch_to_arrow(b, schema)
+    # NaN != NaN so compare with pandas-style nullable semantics
+    assert out.schema.equals(t.schema)
+    for name in t.column_names:
+        exp, got = t.column(name).to_pylist(), out.column(name).to_pylist()
+        for e, g in zip(exp, got):
+            if isinstance(e, float) and e != e:
+                assert g != g
+            else:
+                assert e == g
+
+
+def test_date_timestamp_roundtrip():
+    import datetime
+
+    t = pa.table({
+        "d": pa.array([datetime.date(2024, 1, 1), None], type=pa.date32()),
+        "ts": pa.array([1700000000000000, None], type=pa.timestamp("us", tz="UTC")),
+    })
+    roundtrip(t)
+
+
+def test_decimal_roundtrip():
+    import decimal
+
+    t = pa.table({
+        "m": pa.array(
+            [decimal.Decimal("12.34"), None, decimal.Decimal("-0.01")],
+            type=pa.decimal128(12, 2),
+        ),
+    })
+    roundtrip(t)
+
+
+def test_string_roundtrip():
+    t = pa.table({
+        "s": pa.array(["hello", "", None, "world", "日本語"], type=pa.string()),
+    })
+    b = roundtrip(t)
+    assert b.columns[0].offsets is not None
+
+
+def test_empty_table_roundtrip():
+    t = pa.table({"a": pa.array([], type=pa.int64()),
+                  "s": pa.array([], type=pa.string())})
+    roundtrip(t)
+
+
+def test_all_null_strings():
+    t = pa.table({"s": pa.array([None, None], type=pa.string())})
+    roundtrip(t)
+
+
+def test_concat_batches():
+    from spark_rapids_tpu.columnar.batch import concat_batches
+
+    t1 = pa.table({"a": pa.array([1, 2], type=pa.int64())})
+    t2 = pa.table({"a": pa.array([3, None], type=pa.int64())})
+    schema = T.Schema.from_arrow(t1.schema)
+    b = concat_batches([batch_from_arrow(t1), batch_from_arrow(t2)], schema)
+    assert batch_to_arrow(b, schema).column("a").to_pylist() == [1, 2, 3, None]

@@ -1,0 +1,348 @@
+"""Expression engine tests: Spark-exact semantics, differential vs host oracle."""
+
+import datetime
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs.eval import compile_projection, output_schema
+from spark_rapids_tpu.exprs.expr import col, lit
+
+
+def run_exprs(table: pa.Table, exprs):
+    schema = T.Schema.from_arrow(table.schema)
+    fn = compile_projection(exprs, schema)
+    from spark_rapids_tpu.exprs.eval import bind_projection
+
+    out_schema = output_schema(bind_projection(exprs, schema))
+    out = fn(batch_from_arrow(table))
+    return batch_to_arrow(out, out_schema)
+
+
+def pylist(table, exprs):
+    out = run_exprs(table, exprs)
+    return [out.column(i).to_pylist() for i in range(out.num_columns)]
+
+
+def test_add_mul_nulls():
+    t = pa.table({
+        "a": pa.array([1, None, 3, 4], type=pa.int32()),
+        "b": pa.array([10, 20, None, 40], type=pa.int64()),
+    })
+    (added, mult) = pylist(t, [col("a") + col("b"), col("a") * lit(2)])
+    assert added == [11, None, None, 44]
+    assert mult == [2, None, 6, 8]
+
+
+def test_int_overflow_wraps():
+    t = pa.table({"a": pa.array([2**31 - 1, -(2**31)], type=pa.int32())})
+    (r,) = pylist(t, [col("a") + lit(1, T.INT)])
+    assert r == [-(2**31), -(2**31) + 1]  # Java wraparound
+
+
+def test_long_overflow_wraps():
+    t = pa.table({"a": pa.array([2**63 - 1], type=pa.int64())})
+    (r,) = pylist(t, [col("a") + lit(1, T.LONG)])
+    assert r == [-(2**63)]
+
+
+def test_divide_semantics():
+    t = pa.table({
+        "a": pa.array([10, 7, -7, 5], type=pa.int32()),
+        "b": pa.array([2, 0, 2, None], type=pa.int32()),
+    })
+    (div, idiv, rem) = pylist(t, [
+        E.Divide(col("a"), col("b")),
+        E.IntegralDivide(col("a"), col("b")),
+        E.Remainder(col("a"), col("b")),
+    ])
+    assert div == [5.0, None, -3.5, None]
+    assert idiv == [5, None, -3, None]  # Java: -7/2 = -3 (trunc toward zero)
+    assert rem == [0, None, -1, None]  # Java: -7%2 = -1 (sign of dividend)
+
+
+def test_float_divide_by_zero_is_inf():
+    t = pa.table({"a": pa.array([1.0, -1.0, 0.0], type=pa.float64())})
+    (r,) = pylist(t, [E.Divide(col("a"), lit(0.0))])
+    assert r[0] == math.inf and r[1] == -math.inf and math.isnan(r[2])
+
+
+def test_pmod():
+    t = pa.table({"a": pa.array([-7, 7, -3], type=pa.int32())})
+    (r,) = pylist(t, [E.Pmod(col("a"), lit(3, T.INT))])
+    assert r == [2, 1, 0]
+
+
+def test_three_valued_logic():
+    t = pa.table({
+        "p": pa.array([True, True, False, None, None, None], type=pa.bool_()),
+        "q": pa.array([None, False, None, True, False, None], type=pa.bool_()),
+    })
+    (and_r, or_r, not_p) = pylist(
+        t, [E.And(col("p"), col("q")), E.Or(col("p"), col("q")), E.Not(col("p"))]
+    )
+    assert and_r == [None, False, False, None, False, None]
+    assert or_r == [True, True, None, True, None, None]
+    assert not_p == [False, False, True, None, None, None]
+
+
+def test_comparisons_with_nan():
+    nan = float("nan")
+    t = pa.table({
+        "a": pa.array([1.0, nan, nan, 2.0], type=pa.float64()),
+        "b": pa.array([nan, nan, 1.0, 1.0], type=pa.float64()),
+    })
+    (eq, lt, gt, le) = pylist(t, [
+        col("a").eq(col("b")),
+        col("a") < col("b"),
+        col("a") > col("b"),
+        col("a") <= col("b"),
+    ])
+    # Spark: NaN == NaN true; NaN greater than everything
+    assert eq == [False, True, False, False]
+    assert lt == [True, False, False, False]
+    assert gt == [False, False, True, True]
+    assert le == [True, True, False, False]
+
+
+def test_null_safe_equal():
+    t = pa.table({
+        "a": pa.array([1, None, None, 2], type=pa.int32()),
+        "b": pa.array([1, 1, None, 3], type=pa.int32()),
+    })
+    (r,) = pylist(t, [E.EqualNullSafe(col("a"), col("b"))])
+    assert r == [True, False, True, False]
+
+
+def test_is_null_coalesce():
+    t = pa.table({"a": pa.array([1, None], type=pa.int32())})
+    (isn, inn, co) = pylist(t, [
+        col("a").is_null(), col("a").is_not_null(),
+        E.Coalesce(col("a"), lit(99, T.INT)),
+    ])
+    assert isn == [False, True]
+    assert inn == [True, False]
+    assert co == [1, 99]
+
+
+def test_if_case_when():
+    t = pa.table({"a": pa.array([1, 5, None], type=pa.int32())})
+    (if_r, case_r) = pylist(t, [
+        E.If(col("a") > lit(2, T.INT), lit(100, T.INT), lit(-100, T.INT)),
+        E.CaseWhen(
+            [(col("a").eq(1), lit(10, T.INT)), (col("a").eq(5), lit(50, T.INT))],
+            lit(0, T.INT),
+        ),
+    ])
+    assert if_r == [-100, 100, -100]  # null pred -> else branch
+    assert case_r == [10, 50, 0]
+
+
+def test_in():
+    t = pa.table({"a": pa.array([1, 2, 3, None], type=pa.int32())})
+    (r,) = pylist(t, [E.In(col("a"), [lit(1, T.INT), lit(3, T.INT)])])
+    assert r == [True, False, True, None]
+
+
+def test_cast_double_to_int_java_semantics():
+    t = pa.table({
+        "a": pa.array([1.9, -1.9, float("nan"), 1e20, -1e20], type=pa.float64()),
+    })
+    (r,) = pylist(t, [col("a").cast(T.INT)])
+    assert r == [1, -1, 0, 2**31 - 1, -(2**31)]
+
+
+def test_cast_double_to_long_saturates():
+    t = pa.table({
+        "a": pa.array([1e20, -1e20, 9.3e18, 2.0**63], type=pa.float64()),
+    })
+    (r,) = pylist(t, [col("a").cast(T.LONG)])
+    assert r == [2**63 - 1, -(2**63), 2**63 - 1, 2**63 - 1]
+
+
+def test_in_null_item_per_row():
+    # Spark: no match + null item -> NULL; match -> TRUE
+    t = pa.table({
+        "a": pa.array([1, 2, 3], type=pa.int32()),
+        "b": pa.array([None, 9, None], type=pa.int32()),
+    })
+    (r,) = pylist(t, [E.In(col("a"), [lit(1, T.INT), col("b")])])
+    # row0: match -> TRUE; row1: no match, no null item in-row -> FALSE;
+    # row2: no match + null item -> NULL
+    assert r == [True, False, None]
+
+
+def test_in_strings():
+    t = pa.table({"s": pa.array(["a", "bb", None])})
+    (r,) = pylist(t, [E.In(col("s"), [lit("bb"), lit("c")])])
+    assert r == [False, True, None]
+
+
+def test_compare_date_vs_timestamp():
+    d = datetime.date(2024, 1, 2)
+    ts = datetime.datetime(2024, 1, 1, 23, 0, tzinfo=datetime.timezone.utc)
+    t = pa.table({
+        "d": pa.array([d], type=pa.date32()),
+        "ts": pa.array([ts], type=pa.timestamp("us", tz="UTC")),
+    })
+    (r,) = pylist(t, [col("d") > col("ts")])
+    assert r == [True]  # date coerces to midnight timestamp
+
+
+def test_case_when_strings():
+    t = pa.table({"a": pa.array([1, 2, 3], type=pa.int32())})
+    (r,) = pylist(t, [E.CaseWhen(
+        [(col("a").eq(1), lit("one")), (col("a").eq(2), lit("two"))],
+        lit("many"),
+    )])
+    assert r == ["one", "two", "many"]
+
+
+def test_if_strings_and_coalesce_strings():
+    t = pa.table({
+        "s": pa.array(["x", None, "zzz"]),
+        "q": pa.array([None, "fall", None]),
+    })
+    (if_r, co) = pylist(t, [
+        E.If(col("s").is_null(), lit("was-null"), E.Upper(col("s"))),
+        E.Coalesce(col("s"), col("q"), lit("dflt")),
+    ])
+    assert if_r == ["X", "was-null", "ZZZ"]
+    assert co == ["x", "fall", "zzz"]
+
+
+def test_cast_int_narrowing_wraps():
+    t = pa.table({"a": pa.array([300, -300], type=pa.int32())})
+    (r,) = pylist(t, [col("a").cast(T.BYTE)])
+    assert r == [300 - 256, -300 + 256]
+
+
+def test_cast_date_timestamp():
+    d0 = datetime.date(2024, 3, 1)
+    t = pa.table({"d": pa.array([d0], type=pa.date32())})
+    (ts,) = pylist(t, [col("d").cast(T.TIMESTAMP)])
+    assert ts == [datetime.datetime(2024, 3, 1, tzinfo=datetime.timezone.utc)]
+
+
+def test_cast_decimal_rescale():
+    import decimal
+
+    t = pa.table({
+        "m": pa.array([decimal.Decimal("1.25"), decimal.Decimal("-1.25")],
+                      type=pa.decimal128(10, 2)),
+    })
+    (up, down) = pylist(t, [
+        col("m").cast(T.DecimalType(12, 4)),
+        col("m").cast(T.DecimalType(10, 1)),
+    ])
+    assert up == [decimal.Decimal("1.2500"), decimal.Decimal("-1.2500")]
+    # HALF_UP away from zero
+    assert down == [decimal.Decimal("1.3"), decimal.Decimal("-1.3")]
+
+
+def test_decimal_arithmetic():
+    import decimal
+
+    t = pa.table({
+        "a": pa.array([decimal.Decimal("1.10")], type=pa.decimal128(4, 2)),
+        "b": pa.array([decimal.Decimal("2.305")], type=pa.decimal128(4, 3)),
+    })
+    (s, p) = pylist(t, [col("a") + col("b"), col("a") * col("b")])
+    assert s == [decimal.Decimal("3.405")]
+    assert p == [decimal.Decimal("2.53550")]
+
+
+def test_date_parts():
+    days = [datetime.date(2024, 2, 29), datetime.date(1969, 12, 31),
+            datetime.date(2000, 1, 1), None]
+    t = pa.table({"d": pa.array(days, type=pa.date32())})
+    (y, m, dom, dow, doy, q) = pylist(t, [
+        E.Year(col("d")), E.Month(col("d")), E.DayOfMonth(col("d")),
+        E.DayOfWeek(col("d")), E.DayOfYear(col("d")), E.Quarter(col("d")),
+    ])
+    assert y == [2024, 1969, 2000, None]
+    assert m == [2, 12, 1, None]
+    assert dom == [29, 31, 1, None]
+    # 2024-02-29 Thursday=5, 1969-12-31 Wednesday=4, 2000-01-01 Saturday=7
+    assert dow == [5, 4, 7, None]
+    assert doy == [60, 365, 1, None]
+    assert q == [1, 4, 1, None]
+
+
+def test_date_add_diff():
+    t = pa.table({"d": pa.array([datetime.date(2024, 1, 31)], type=pa.date32())})
+    (plus, minus, diff) = pylist(t, [
+        E.DateAdd(col("d"), lit(1, T.INT)),
+        E.DateSub(col("d"), lit(31, T.INT)),
+        E.DateDiff(col("d"), E.Literal(datetime.date(2024, 1, 1), T.DATE)),
+    ])
+    assert plus == [datetime.date(2024, 2, 1)]
+    assert minus == [datetime.date(2023, 12, 31)]
+    assert diff == [30]
+
+
+def test_math_fns():
+    t = pa.table({"a": pa.array([4.0, -1.0, 0.0], type=pa.float64())})
+    (sq, lg) = pylist(t, [E.Sqrt(col("a")), E.Log(col("a"))])
+    assert sq[0] == 2.0 and math.isnan(sq[1]) and sq[2] == 0.0
+    assert lg == [math.log(4.0), None, None]  # Spark log(<=0) -> null
+
+
+def test_round_half_up():
+    t = pa.table({"a": pa.array([2.5, -2.5, 1.15], type=pa.float64())})
+    (r0, r1) = pylist(t, [E.Round(col("a"), 0), E.Round(col("a"), 1)])
+    assert r0 == [3.0, -3.0, 1.0]  # HALF_UP away from zero, not banker's
+    assert r1[0] == 2.5 and r1[1] == -2.5
+
+
+def test_string_length_utf8():
+    t = pa.table({"s": pa.array(["abc", "", "日本語", None])})
+    (r,) = pylist(t, [E.Length(col("s"))])
+    assert r == [3, 0, 3, None]
+
+
+def test_upper_lower():
+    t = pa.table({"s": pa.array(["aBc", "XYZ", None])})
+    (u, l) = pylist(t, [E.Upper(col("s")), E.Lower(col("s"))])
+    assert u == ["ABC", "XYZ", None]
+    assert l == ["abc", "xyz", None]
+
+
+def test_string_search():
+    t = pa.table({"s": pa.array(["hello world", "worldly", "say hello", "", None])})
+    (st, en, ct) = pylist(t, [
+        E.StartsWith(col("s"), lit("world")),
+        E.EndsWith(col("s"), lit("world")),
+        E.Contains(col("s"), lit("world")),
+    ])
+    assert st == [False, True, False, False, None]
+    assert en == [True, False, False, False, None]
+    assert ct == [True, True, False, False, None]
+
+
+def test_substring():
+    t = pa.table({"s": pa.array(["hello", "hi", "", None])})
+    (r, neg) = pylist(t, [
+        E.Substring(col("s"), 2, 3),
+        E.Substring(col("s"), -3, 2),
+    ])
+    assert r == ["ell", "i", "", None]
+    # Spark: substring('hi', -3, 2) -> start=-1, window [-1,1) clamps to 'h'
+    assert neg == ["ll", "h", "", None]
+
+
+def test_string_equality():
+    t = pa.table({
+        "a": pa.array(["abc", "abc", "ab", None, None]),
+        "b": pa.array(["abc", "abd", "abc", "x", None]),
+    })
+    (eq, nse) = pylist(t, [
+        col("a").eq(col("b")), E.EqualNullSafe(col("a"), col("b")),
+    ])
+    assert eq == [True, False, False, None, None]
+    assert nse == [True, False, False, False, True]
