@@ -1,0 +1,8 @@
+"""Benchmark harness: seeded datagen + TPC-H-derived query plans.
+
+Mirrors the reference's benchmark tooling (SURVEY.md §2.10: datagen/
+bigDataGen.scala seeded generators; integration_tests ScaleTest q1-q28; NDS
+lives out-of-tree). BASELINE.md progression configs start at TPC-H Q6.
+"""
+
+from spark_rapids_tpu.bench import tpch  # noqa: F401

@@ -1,0 +1,264 @@
+"""TPC-H-derived data generation and query plans (Q1, Q3, Q5, Q6).
+
+Seeded, distribution-controlled generation in the spirit of the reference's
+datagen module (datagen/src/main/scala/.../bigDataGen.scala): deterministic
+per (table, scale, seed), approximating dbgen's column domains. Row counts
+follow dbgen scaling (lineitem ~ 6M * SF).
+
+Queries are built directly as physical plans on the exec layer; the plan/
+layer's DataFrame front-end lowers to the same operators.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow
+from spark_rapids_tpu.exec import (
+    BatchSourceExec,
+    FilterExec,
+    HashAggregateExec,
+    HashJoinExec,
+    ProjectExec,
+    SortExec,
+    SortOrder,
+)
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.exprs.expr import (
+    And, Average, Count, GreaterThanOrEqual, LessThan, Literal, Multiply,
+    Subtract, Sum, col, lit,
+)
+
+
+def _date_i(y, m, d) -> int:
+    return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+
+
+_EPOCH_1992 = _date_i(1992, 1, 1)
+_DAYS_7Y = _date_i(1998, 12, 31) - _EPOCH_1992
+
+NATIONS = 25
+REGIONS = 5
+
+
+def gen_lineitem(sf: float, seed: int = 0) -> pa.Table:
+    n = int(6_000_000 * sf)
+    rng = np.random.default_rng(seed)
+    orderkey = rng.integers(1, int(1_500_000 * sf) * 4 + 1, n)
+    shipdate = _EPOCH_1992 + rng.integers(0, _DAYS_7Y + 1, n)
+    qty = rng.integers(1, 51, n).astype(np.float64)
+    price = np.round(rng.uniform(900.0, 105000.0, n), 2)
+    discount = np.round(rng.integers(0, 11, n) * 0.01, 2)
+    tax = np.round(rng.integers(0, 9, n) * 0.01, 2)
+    rf = rng.integers(0, 3, n)
+    returnflag = np.array(["A", "N", "R"])[rf]
+    linestatus = np.where(shipdate > _date_i(1995, 6, 17), "O", "F")
+    return pa.table({
+        "l_orderkey": pa.array(orderkey, pa.int64()),
+        "l_quantity": pa.array(qty, pa.float64()),
+        "l_extendedprice": pa.array(price, pa.float64()),
+        "l_discount": pa.array(discount, pa.float64()),
+        "l_tax": pa.array(tax, pa.float64()),
+        "l_returnflag": pa.array(returnflag, pa.string()),
+        "l_linestatus": pa.array(linestatus, pa.string()),
+        "l_shipdate": pa.array(shipdate.astype(np.int32), pa.int32()).cast(
+            pa.date32()),
+        "l_suppkey": pa.array(rng.integers(1, max(int(10_000 * sf), 10) + 1, n),
+                              pa.int64()),
+    })
+
+
+def gen_orders(sf: float, seed: int = 1) -> pa.Table:
+    n = int(1_500_000 * sf)
+    rng = np.random.default_rng(seed)
+    orderdate = _EPOCH_1992 + rng.integers(0, _DAYS_7Y - 150, n)
+    return pa.table({
+        "o_orderkey": pa.array(np.arange(1, 4 * n + 1, 4), pa.int64()),
+        "o_custkey": pa.array(rng.integers(1, max(int(150_000 * sf), 10) + 1, n),
+                              pa.int64()),
+        "o_orderdate": pa.array(orderdate.astype(np.int32), pa.int32()).cast(
+            pa.date32()),
+        "o_shippriority": pa.array(np.zeros(n, np.int32), pa.int32()),
+    })
+
+
+def gen_customer(sf: float, seed: int = 2) -> pa.Table:
+    n = max(int(150_000 * sf), 10)
+    rng = np.random.default_rng(seed)
+    segs = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
+                     "HOUSEHOLD"])
+    return pa.table({
+        "c_custkey": pa.array(np.arange(1, n + 1), pa.int64()),
+        "c_mktsegment": pa.array(segs[rng.integers(0, 5, n)], pa.string()),
+        "c_nationkey": pa.array(rng.integers(0, NATIONS, n), pa.int64()),
+    })
+
+
+def gen_supplier(sf: float, seed: int = 3) -> pa.Table:
+    n = max(int(10_000 * sf), 10)
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "s_suppkey": pa.array(np.arange(1, n + 1), pa.int64()),
+        "s_nationkey": pa.array(rng.integers(0, NATIONS, n), pa.int64()),
+    })
+
+
+def gen_nation(seed: int = 4) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    names = [f"NATION_{i:02d}" for i in range(NATIONS)]
+    return pa.table({
+        "n_nationkey": pa.array(np.arange(NATIONS), pa.int64()),
+        "n_name": pa.array(names, pa.string()),
+        "n_regionkey": pa.array(rng.integers(0, REGIONS, NATIONS), pa.int64()),
+    })
+
+
+def gen_region() -> pa.Table:
+    names = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+    return pa.table({
+        "r_regionkey": pa.array(np.arange(REGIONS), pa.int64()),
+        "r_name": pa.array(names, pa.string()),
+    })
+
+
+def _source(table: pa.Table, batch_rows: int = 1 << 20) -> BatchSourceExec:
+    schema = T.Schema.from_arrow(table.schema)
+    batches = [
+        batch_from_arrow(table.slice(i, batch_rows))
+        for i in range(0, max(table.num_rows, 1), batch_rows)
+    ]
+    return BatchSourceExec([batches], schema)
+
+
+# ---------------------------------------------------------------------------
+# Queries
+# ---------------------------------------------------------------------------
+
+
+def q6(lineitem: TpuExec) -> TpuExec:
+    """select sum(l_extendedprice * l_discount) as revenue from lineitem
+    where l_shipdate >= '1994-01-01' and l_shipdate < '1995-01-01'
+      and l_discount between 0.05 and 0.07 and l_quantity < 24"""
+    cond = And(
+        And(
+            And(GreaterThanOrEqual(col("l_shipdate"),
+                                   lit(_date_i(1994, 1, 1), T.DATE)),
+                LessThan(col("l_shipdate"), lit(_date_i(1995, 1, 1), T.DATE))),
+            And(GreaterThanOrEqual(col("l_discount"), lit(0.05 - 1e-9)),
+                LessThan(col("l_discount"), lit(0.07 + 1e-9))),
+        ),
+        LessThan(col("l_quantity"), lit(24.0)),
+    )
+    filt = FilterExec(cond, lineitem)
+    return HashAggregateExec(
+        [], [Sum(Multiply(col("l_extendedprice"), col("l_discount"))).alias("revenue")],
+        filt,
+    )
+
+
+def q1(lineitem: TpuExec) -> TpuExec:
+    """Pricing summary report: group by returnflag/linestatus with sums/avgs,
+    where l_shipdate <= '1998-09-02', order by keys."""
+    filt = FilterExec(
+        LessThan(col("l_shipdate"), lit(_date_i(1998, 9, 3), T.DATE)), lineitem)
+    disc_price = Multiply(col("l_extendedprice"),
+                          Subtract(lit(1.0), col("l_discount")))
+    charge = Multiply(disc_price, (lit(1.0) + col("l_tax")))
+    agg = HashAggregateExec(
+        [col("l_returnflag"), col("l_linestatus")],
+        [
+            Sum(col("l_quantity")).alias("sum_qty"),
+            Sum(col("l_extendedprice")).alias("sum_base_price"),
+            Sum(disc_price).alias("sum_disc_price"),
+            Sum(charge).alias("sum_charge"),
+            Average(col("l_quantity")).alias("avg_qty"),
+            Average(col("l_extendedprice")).alias("avg_price"),
+            Average(col("l_discount")).alias("avg_disc"),
+            Count().alias("count_order"),
+        ],
+        filt,
+    )
+    return SortExec([SortOrder(col("l_returnflag")),
+                     SortOrder(col("l_linestatus"))], agg)
+
+
+def q3(customer: TpuExec, orders: TpuExec, lineitem: TpuExec) -> TpuExec:
+    """Shipping priority: top unshipped orders by revenue."""
+    cust = FilterExec(col("c_mktsegment").eq("BUILDING"), customer)
+    ords = FilterExec(
+        LessThan(col("o_orderdate"), lit(_date_i(1995, 3, 15), T.DATE)), orders)
+    line = FilterExec(
+        GreaterThanOrEqual(col("l_shipdate"), lit(_date_i(1995, 3, 16), T.DATE)),
+        lineitem)
+    oc = HashJoinExec([col("o_custkey")], [col("c_custkey")], "inner",
+                      ords, cust)
+    lo = HashJoinExec([col("l_orderkey")], [col("o_orderkey")], "inner",
+                      line, oc)
+    agg = HashAggregateExec(
+        [col("l_orderkey"), col("o_orderdate"), col("o_shippriority")],
+        [Sum(Multiply(col("l_extendedprice"),
+                      Subtract(lit(1.0), col("l_discount")))).alias("revenue")],
+        lo,
+    )
+    return SortExec([SortOrder(col("revenue"), ascending=False),
+                     SortOrder(col("o_orderdate"))], agg)
+
+
+def q5(customer: TpuExec, orders: TpuExec, lineitem: TpuExec,
+       supplier: TpuExec, nation: TpuExec, region: TpuExec) -> TpuExec:
+    """Local supplier volume for ASIA in 1994."""
+    reg = FilterExec(col("r_name").eq("ASIA"), region)
+    nat = HashJoinExec([col("n_regionkey")], [col("r_regionkey")], "inner",
+                       nation, reg)
+    sup = HashJoinExec([col("s_nationkey")], [col("n_nationkey")], "inner",
+                       supplier, nat)
+    ords = FilterExec(
+        And(GreaterThanOrEqual(col("o_orderdate"), lit(_date_i(1994, 1, 1), T.DATE)),
+            LessThan(col("o_orderdate"), lit(_date_i(1995, 1, 1), T.DATE))),
+        orders)
+    co = HashJoinExec([col("o_custkey")], [col("c_custkey")], "inner",
+                      ords, customer)
+    lco = HashJoinExec([col("l_orderkey")], [col("o_orderkey")], "inner",
+                       lineitem, co)
+    # l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+    ls = HashJoinExec([col("l_suppkey"), col("c_nationkey")],
+                      [col("s_suppkey"), col("s_nationkey")], "inner",
+                      lco, sup)
+    agg = HashAggregateExec(
+        [col("n_name")],
+        [Sum(Multiply(col("l_extendedprice"),
+                      Subtract(lit(1.0), col("l_discount")))).alias("revenue")],
+        ls,
+    )
+    return SortExec([SortOrder(col("revenue"), ascending=False)], agg)
+
+
+def tables_for(sf: float, seed: int = 0) -> Dict[str, pa.Table]:
+    return {
+        "lineitem": gen_lineitem(sf, seed),
+        "orders": gen_orders(sf, seed + 1),
+        "customer": gen_customer(sf, seed + 2),
+        "supplier": gen_supplier(sf, seed + 3),
+        "nation": gen_nation(seed + 4),
+        "region": gen_region(),
+    }
+
+
+def build_query(name: str, tables: Dict[str, pa.Table],
+                batch_rows: int = 1 << 20) -> TpuExec:
+    src = {k: _source(v, batch_rows) for k, v in tables.items()}
+    if name == "q6":
+        return q6(src["lineitem"])
+    if name == "q1":
+        return q1(src["lineitem"])
+    if name == "q3":
+        return q3(src["customer"], src["orders"], src["lineitem"])
+    if name == "q5":
+        return q5(src["customer"], src["orders"], src["lineitem"],
+                  src["supplier"], src["nation"], src["region"])
+    raise KeyError(name)

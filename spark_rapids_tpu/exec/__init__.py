@@ -1,0 +1,23 @@
+"""Physical operators (the Gpu*Exec layer re-designed TPU-first).
+
+Reference layer map: SURVEY.md §1 L3; base contract GpuExec.scala:286.
+"""
+
+from spark_rapids_tpu.exec.base import (  # noqa: F401
+    BatchSourceExec,
+    Metric,
+    TpuExec,
+)
+from spark_rapids_tpu.exec.project import FilterExec, ProjectExec  # noqa: F401
+from spark_rapids_tpu.exec.aggregate import HashAggregateExec  # noqa: F401
+from spark_rapids_tpu.exec.sort import SortExec, SortOrder  # noqa: F401
+from spark_rapids_tpu.exec.join import HashJoinExec  # noqa: F401
+from spark_rapids_tpu.exec.scan import ParquetScanExec  # noqa: F401
+from spark_rapids_tpu.exec.misc import (  # noqa: F401
+    CoalesceBatchesExec,
+    GlobalLimitExec,
+    LocalLimitExec,
+    RangeExec,
+    UnionExec,
+    take_ordered_and_project,
+)

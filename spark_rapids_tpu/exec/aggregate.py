@@ -1,0 +1,415 @@
+"""Hash aggregation: sort-based segmented aggregation on device.
+
+Reference: GpuHashAggregateExec (GpuAggregateExec.scala:1868) with its
+partial-per-batch / merge / final-pass pipeline (GpuAggFirstPassIterator:742,
+GpuMergeAggregateIterator:913, GpuAggFinalPassIterator:772). TPU-first
+re-design:
+
+- one fused XLA computation does pre-projection + grouping (hash-sort +
+  exact-verified segment split, kernels.group_rows) + every segmented
+  reduction for a batch — no per-aggregation kernel launches;
+- cross-batch merge = device concat of partial buffers + one more grouped
+  reduction over merge ops (sums of sums etc.), looped until a single batch
+  remains — the analog of the reference's merge pass. The reference's
+  repartition-fallback for oversized agg state maps to the split/retry
+  machinery (mem/) + shuffle-level partials in the distributed plan.
+
+Aggregate buffer layout per function (Spark-exact result types):
+  Sum      -> [sum]              Count     -> [count]
+  Min/Max  -> [min]/[max]        Average   -> [sum, count]
+  First    -> [first]            Last      -> [last]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, bucket_capacity
+from spark_rapids_tpu.columnar.column import ColVal, DeviceColumn
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+from spark_rapids_tpu.exec import kernels as K
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs import eval as EV
+
+
+@dataclasses.dataclass
+class _AggSpec:
+    """Lowered aggregate: which pre-projected input feeds which buffer ops."""
+
+    func: E.AggregateExpression
+    name: str
+    input_index: Optional[int]  # index into the pre-projection, None = count(*)
+    ops: List[str]  # per-buffer update op
+    buffer_types: List[T.DataType]
+
+    @property
+    def result_type(self) -> T.DataType:
+        return self.func.dtype
+
+
+_MERGE_OP = {"sum": "sum", "count": "sum", "count_all": "sum", "min": "min",
+             "max": "max", "first": "first", "last": "last"}
+
+
+def _lower_agg(func: E.AggregateExpression, name: str,
+               input_index: Optional[int]) -> _AggSpec:
+    if isinstance(func, E.Count):
+        op = "count" if func.children else "count_all"
+        return _AggSpec(func, name, input_index, [op], [T.LONG])
+    if isinstance(func, E.Sum):
+        return _AggSpec(func, name, input_index, ["sum"], [func.dtype])
+    if isinstance(func, E.Min):
+        return _AggSpec(func, name, input_index, ["min"], [func.dtype])
+    if isinstance(func, E.Max):
+        return _AggSpec(func, name, input_index, ["max"], [func.dtype])
+    if isinstance(func, E.Average):
+        c = func.child.dtype
+        sum_t = T.DecimalType(min(38, c.precision + 10), c.scale) if isinstance(
+            c, T.DecimalType) else T.DOUBLE if c in T.FRACTIONAL_TYPES else T.LONG
+        return _AggSpec(func, name, input_index, ["sum", "count"], [sum_t, T.LONG])
+    if isinstance(func, E.First):
+        return _AggSpec(func, name, input_index, ["first"], [func.dtype])
+    if isinstance(func, E.Last):
+        return _AggSpec(func, name, input_index, ["last"], [func.dtype])
+    raise NotImplementedError(f"aggregate {type(func).__name__}")
+
+
+def _strip_alias(e: E.Expression) -> Tuple[E.Expression, str]:
+    if isinstance(e, E.Alias):
+        return e.child, e.name
+    name = e.name if isinstance(e, E.ColumnRef) else repr(e)
+    return e, name
+
+
+class HashAggregateExec(UnaryExec):
+    """Group-by aggregation over one partition's batches.
+
+    ``mode``:
+      - "complete": input rows -> final results (single-stage).
+      - "partial":  input rows -> (keys + partial buffers) batches.
+      - "final":    (keys + partial buffers) batches -> final results.
+    The partial/final split is what the distributed plan uses around a
+    shuffle, mirroring Spark/the reference's partial+merge aggregate pair.
+    """
+
+    def __init__(self, group_exprs: Sequence[E.Expression],
+                 agg_exprs: Sequence[E.Expression], child: TpuExec,
+                 mode: str = "complete"):
+        super().__init__(child)
+        assert mode in ("complete", "partial", "final")
+        self.mode = mode
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        self._prepared = False
+        self._register_metric("numAggBatches")
+        self._register_metric("concatTimeNs")
+
+    # -- lowering ----------------------------------------------------------
+    def _prepare(self):
+        if self._prepared:
+            return
+        in_schema = self.child.output_schema
+        self._group_bound = [E.resolve(e, in_schema) for e in self.group_exprs]
+        self._group_names = [
+            _strip_alias(e)[1] for e in self._group_bound
+        ]
+        n_keys = len(self._group_bound)
+
+        self._specs: List[_AggSpec] = getattr(self, "_specs", None) or []
+        pre_exprs: List[E.Expression] = list(self._group_bound)
+        if not self._specs:
+            for e in self.agg_exprs:
+                func, name = _strip_alias(e)
+                assert isinstance(func, E.AggregateExpression), f"not an agg: {e!r}"
+                if func.children:
+                    if self.mode == "final":
+                        # children were bound against the pre-shuffle schema by
+                        # final_from_partial(); only dtypes are used here
+                        bound_child = func.children[0]
+                    else:
+                        bound_child = E.resolve(func.children[0], in_schema)
+                    func = type(func)(bound_child)
+                    idx = len(pre_exprs)
+                    pre_exprs.append(bound_child)
+                else:
+                    idx = None
+                self._specs.append(_lower_agg(func, name, idx))
+        self._pre_bound = tuple(pre_exprs)
+        self._n_keys = n_keys
+        self._prepared = True
+
+        jit = jax.jit
+
+        @jit
+        def first_pass(batch):
+            return self._first_pass(batch)
+
+        @jit
+        def merge_pass(batch):
+            return self._merge_pass(batch)
+
+        self._first_pass_fn = first_pass
+        self._merge_pass_fn = merge_pass
+
+        @jit
+        def final_project(batch):
+            return self._final_project(batch)
+
+        self._final_project_fn = final_project
+
+    # -- schemas -----------------------------------------------------------
+    def _buffer_schema(self) -> T.Schema:
+        self._prepare()
+        fields = []
+        for e in self._group_bound:
+            inner, name = _strip_alias(e)
+            fields.append(T.Field(name, inner.dtype, inner.nullable))
+        for s in self._specs:
+            for bi, bt in enumerate(s.buffer_types):
+                fields.append(T.Field(f"{s.name}#b{bi}", bt, True))
+        return T.Schema(fields)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        self._prepare()
+        if self.mode == "partial":
+            return self._buffer_schema()
+        fields = []
+        for e in self._group_bound:
+            inner, name = _strip_alias(e)
+            fields.append(T.Field(name, inner.dtype, inner.nullable))
+        for s in self._specs:
+            fields.append(T.Field(s.name, s.result_type,
+                                  s.func.nullable))
+        return T.Schema(fields)
+
+    def node_description(self) -> str:
+        keys = ", ".join(map(repr, self.group_exprs))
+        aggs = ", ".join(map(repr, self.agg_exprs))
+        return f"TpuHashAggregate(mode={self.mode}) keys=[{keys}] aggs=[{aggs}]"
+
+    # -- device passes (traced) -------------------------------------------
+    def _grouping(self, pre: ColumnarBatch):
+        cap = pre.capacity
+        if self._n_keys == 0:
+            perm = jnp.arange(cap, dtype=jnp.int32)
+            seg = jnp.zeros(cap, jnp.int32)
+            num_groups = jnp.int32(1)  # global agg: always one output row
+            group_starts = jnp.zeros(cap, jnp.int32)
+            return K.GroupInfo(perm, seg, num_groups, group_starts)
+        return K.group_rows(pre, list(range(self._n_keys)))
+
+    def _first_pass(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """pre-project + group + per-buffer update aggregations."""
+        pre_cols = []
+        ctx = EV.EvalContext(batch)
+        for e in self._pre_bound:
+            v = EV.eval_expr(e, ctx)
+            if isinstance(v, EV.StringVal):
+                pre_cols.append(DeviceColumn(T.STRING, v.data, v.validity, v.offsets))
+            else:
+                pre_cols.append(DeviceColumn(e.dtype, v.data, v.validity))
+        pre = ColumnarBatch(pre_cols, batch.num_rows)
+        gi = self._grouping(pre)
+        return self._aggregate_grouped(pre, gi, [s.ops for s in self._specs])
+
+    def _merge_pass(self, buffers: ColumnarBatch) -> ColumnarBatch:
+        """re-group partial buffers and combine with merge ops."""
+        merge_ops = [[_MERGE_OP[op] for op in s.ops] for s in self._specs]
+        gi = self._grouping(buffers)
+        return self._aggregate_grouped(buffers, gi, merge_ops, buffers_input=True)
+
+    def _aggregate_grouped(self, pre: ColumnarBatch, gi: K.GroupInfo,
+                           ops_per_spec, buffers_input: bool = False
+                           ) -> ColumnarBatch:
+        cap = pre.capacity
+        active = pre.active_mask()
+        contributing = active[gi.perm]
+        out_row_valid = jnp.arange(cap, dtype=jnp.int32) < gi.num_groups
+        # keys: value at each group head (head -> original row via perm)
+        head_rows = jnp.where(out_row_valid, gi.perm[jnp.clip(gi.group_starts, 0, cap - 1)], 0)
+        out_cols: List[DeviceColumn] = []
+        for kc in range(self._n_keys):
+            out_cols.append(
+                K.gather_column(pre.columns[kc], head_rows, out_row_valid)
+            )
+        buf_idx = self._n_keys
+        for s, ops in zip(self._specs, ops_per_spec):
+            for bi, (op, bt) in enumerate(zip(ops, s.buffer_types)):
+                if buffers_input:
+                    src = pre.columns[buf_idx]
+                    buf_idx += 1
+                elif s.input_index is None:
+                    src = None
+                else:
+                    src = pre.columns[s.input_index]
+                if src is None:
+                    vals = jnp.zeros(cap, jnp.int64)
+                    valid = jnp.ones(cap, jnp.bool_)
+                else:
+                    vals = src.data[gi.perm]
+                    valid = src.validity[gi.perm]
+                if src is not None and src.offsets is not None:
+                    # min/max/first/last over strings: reduce row indices, gather
+                    data, avalid = self._string_agg(src, gi, contributing, op, cap)
+                    out_cols.append(
+                        DeviceColumn(bt, data.data,
+                                     avalid & out_row_valid, data.offsets)
+                    )
+                    continue
+                data, avalid = K.segment_agg(vals, valid, contributing, gi.segment_ids,
+                                             cap, op)
+                np_t = T.numpy_dtype(bt)
+                data = data.astype(np_t)
+                out_cols.append(DeviceColumn(bt, jnp.where(out_row_valid & avalid, data,
+                                                           jnp.zeros_like(data)),
+                                             avalid & out_row_valid))
+        return ColumnarBatch(out_cols, gi.num_groups)
+
+    def _string_agg(self, src: DeviceColumn, gi: K.GroupInfo, contributing,
+                    op: str, cap: int):
+        live = contributing & src.validity[gi.perm]
+        if op in ("min", "max"):
+            # order by 16-byte prefix keys (round-1 string min/max precision):
+            # reduce the high word, then the low word among high-word ties
+            pk = K.string_prefix_keys(src)
+            hi, lo = pk[0][gi.perm], pk[1][gi.perm]
+            ident = jnp.uint64(0xFFFFFFFFFFFFFFFF) if op == "min" else jnp.uint64(0)
+            reducer = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+            hi_m = jnp.where(live, hi, ident)
+            red_hi = reducer(hi_m, gi.segment_ids, num_segments=cap)
+            tie = live & (hi_m == red_hi[gi.segment_ids])
+            lo_m = jnp.where(tie, lo, ident)
+            red_lo = reducer(lo_m, gi.segment_ids, num_segments=cap)
+            isel = jnp.where(tie & (lo_m == red_lo[gi.segment_ids]),
+                             jnp.arange(cap, dtype=jnp.int32), cap)
+            sel = jax.ops.segment_min(isel, gi.segment_ids, num_segments=cap)
+        elif op in ("first", "last"):
+            idx = jnp.arange(cap, dtype=jnp.int32)
+            pick = jnp.where(live, idx, cap if op == "first" else -1)
+            sel = (jax.ops.segment_min if op == "first" else jax.ops.segment_max)(
+                pick, gi.segment_ids, num_segments=cap)
+        else:
+            raise NotImplementedError(f"string {op}")
+        any_valid = jax.ops.segment_max(live.astype(jnp.int32), gi.segment_ids,
+                                        num_segments=cap) > 0
+        sel_c = jnp.clip(sel, 0, cap - 1)
+        rows = gi.perm[sel_c]
+        row_valid = any_valid
+        col = K.gather_column(src, rows, row_valid)
+        return col, any_valid
+
+    def _final_project(self, buffers: ColumnarBatch) -> ColumnarBatch:
+        """buffers -> final values (Average division etc.)."""
+        cap = buffers.capacity
+        out_cols: List[DeviceColumn] = list(buffers.columns[: self._n_keys])
+        bi = self._n_keys
+        for s in self._specs:
+            bufs = buffers.columns[bi: bi + len(s.ops)]
+            bi += len(s.ops)
+            rt = s.result_type
+            if isinstance(s.func, E.Average):
+                ssum, cnt = bufs
+                nz = cnt.data > 0
+                if isinstance(rt, T.DecimalType):
+                    in_t = s.func.child.dtype
+                    # avg = sum/count rounded HALF_UP at result scale
+                    shift = 10 ** (rt.scale - in_t.scale)
+                    num = ssum.data.astype(jnp.int64) * jnp.int64(shift)
+                    den = jnp.maximum(cnt.data, 1)
+                    q = num // den
+                    r = num - q * den
+                    neg = (num < 0)
+                    # round half up (away from zero), truncating division fix
+                    q_t = jnp.where(neg & (r != 0), q + 1, q)
+                    r_t = jnp.abs(num - q_t * den)
+                    data = q_t + jnp.where(2 * r_t >= den,
+                                           jnp.where(neg, -1, 1), 0)
+                else:
+                    data = ssum.data.astype(jnp.float64) / jnp.maximum(
+                        cnt.data, 1
+                    ).astype(jnp.float64)
+                valid = ssum.validity & nz
+                out_cols.append(DeviceColumn(rt, jnp.where(valid, data, 0), valid))
+            else:
+                b = bufs[0]
+                if b.offsets is not None:
+                    out_cols.append(DeviceColumn(rt, b.data, b.validity, b.offsets))
+                else:
+                    out_cols.append(
+                        DeviceColumn(rt, b.data.astype(T.numpy_dtype(rt)), b.validity)
+                    )
+        return ColumnarBatch(out_cols, buffers.num_rows)
+
+    # -- host orchestration ------------------------------------------------
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        self._prepare()
+        if self.mode == "final":
+            partials = list(self.child.execute(partition))
+        else:
+            partials = []
+            for batch in self.child.execute(partition):
+                partials.append(self._first_pass_fn(batch))
+                self.metrics["numAggBatches"].add(1)
+        if not partials:
+            if self._n_keys == 0 and self.mode in ("complete", "final"):
+                # global agg over empty input still yields one row
+                from spark_rapids_tpu.columnar.batch import empty_batch
+                buf = empty_batch(self._buffer_schema().types(), 16)
+                merged = self._merge_pass_fn(buf)
+                yield self._final_project_fn(merged)
+            return
+        merged = self._merge_to_one(partials)
+        if self.mode == "partial":
+            yield merged
+        else:
+            yield self._final_project_fn(merged)
+
+    def _merge_to_one(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
+        """Concat partial buffers on device and merge until one batch."""
+        if len(partials) == 1:
+            # a lone first-pass output is already grouped; "final" input may
+            # still hold duplicate keys from different map tasks
+            if self.mode == "final":
+                return self._merge_pass_fn(partials[0])
+            return partials[0]
+        while len(partials) > 1:
+            with self.timer("concatTimeNs"):
+                group = partials[:8]
+                partials = partials[8:]
+                cat = concat_jit(group)
+            partials.insert(0, self._merge_pass_fn(cat))
+        return partials[0]
+
+    @staticmethod
+    def final_from_partial(partial: "HashAggregateExec",
+                           child: TpuExec) -> "HashAggregateExec":
+        """Build the reduce-side aggregate consuming a partial's buffers."""
+        partial._prepare()
+        final = HashAggregateExec(
+            [E.col(n) for n in partial._group_names], partial.agg_exprs,
+            child, mode="final")
+        final._specs = list(partial._specs)
+        return final
+
+
+_concat_fn = jax.jit(K.concat_device, static_argnums=(1, 2))
+
+
+def concat_jit(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
+    """Device concat with capacity bucketing (jit cached per shape combo)."""
+    total = sum(b.capacity for b in batches)
+    out_cap = bucket_capacity(total)
+    byte_caps = []
+    for ci, c in enumerate(batches[0].columns):
+        if c.offsets is not None:
+            byte_caps.append(bucket_capacity(
+                max(sum(b.columns[ci].byte_capacity for b in batches), 8), 8))
+        else:
+            byte_caps.append(0)
+    return _concat_fn(list(batches), out_cap, tuple(byte_caps))

@@ -1,0 +1,186 @@
+"""Physical operator base: the TPU analog of GpuExec.
+
+Reference: GpuExec.scala:286 (base trait), whose contract is
+``internalDoExecuteColumnar(): RDD[ColumnarBatch]`` plus a leveled metrics
+framework (GpuMetric, GpuExec.scala:41-178). Here an operator produces an
+iterator of TPU-resident ``ColumnarBatch`` per partition; the driver-side
+plan layer (plan/) decides partitioning, and the shuffle layer moves data
+between partition counts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+
+class Metric:
+    """Accumulating metric, summed across partitions (GpuMetric analog)."""
+
+    __slots__ = ("name", "level", "value")
+
+    def __init__(self, name: str, level: int = MODERATE):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v) -> None:
+        self.value += v
+
+    def __repr__(self):
+        return f"{self.name}={self.value}"
+
+
+class MetricsTimer:
+    """Context manager adding elapsed ns to a metric (NvtxWithMetrics analog)."""
+
+    def __init__(self, metric: Optional[Metric]):
+        self.metric = metric
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self.metric is not None:
+            self.metric.add(time.perf_counter_ns() - self._t0)
+        return False
+
+
+class TpuExec:
+    """Base physical operator.
+
+    Subclasses define ``output_schema`` and ``do_execute(partition)``; the
+    base wires metrics and explain formatting.
+    """
+
+    def __init__(self, *children: "TpuExec"):
+        self.children: List[TpuExec] = list(children)
+        self.metrics: Dict[str, Metric] = {}
+        self._register_metric("numOutputRows", ESSENTIAL)
+        self._register_metric("numOutputBatches", MODERATE)
+        # row counts are traced device scalars; summing them eagerly would
+        # force a host sync per batch per operator and kill async dispatch
+        # pipelining — they are resolved lazily in collect_metrics
+        self._pending_rows: List = []
+
+    # -- schema / partitioning --------------------------------------------
+    @property
+    def output_schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def num_partitions(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions()
+        return 1
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        for batch in self.do_execute(partition):
+            self.metrics["numOutputBatches"].add(1)
+            self._pending_rows.append(batch.num_rows)
+            yield batch
+
+    def execute_all(self) -> Iterator[ColumnarBatch]:
+        """All partitions, sequentially (test/driver convenience)."""
+        for p in range(self.num_partitions()):
+            yield from self.execute(p)
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    # -- metrics / explain -------------------------------------------------
+    def _register_metric(self, name: str, level: int = MODERATE) -> Metric:
+        m = Metric(name, level)
+        self.metrics[name] = m
+        return m
+
+    def timer(self, name: str) -> MetricsTimer:
+        return MetricsTimer(self.metrics.get(name))
+
+    def node_description(self) -> str:
+        return type(self).__name__
+
+    def explain(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        lines = [f"{pad}{'+- ' if indent else ''}{self.node_description()}"]
+        for c in self.children:
+            lines.append(c.explain(indent + 1))
+        return "\n".join(lines)
+
+    def collect_metrics(self) -> Dict[str, int]:
+        out = {}
+
+        def walk(node: "TpuExec"):
+            name = type(node).__name__
+            if node._pending_rows:
+                node.metrics["numOutputRows"].add(
+                    sum(int(n) for n in node._pending_rows)
+                )
+                node._pending_rows.clear()
+            for m in node.metrics.values():
+                out[f"{name}.{m.name}"] = out.get(f"{name}.{m.name}", 0) + m.value
+            for c in node.children:
+                walk(c)
+
+        walk(self)
+        return out
+
+
+class LeafExec(TpuExec):
+    def __init__(self):
+        super().__init__()
+
+
+class UnaryExec(TpuExec):
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+
+    @property
+    def child(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.child.output_schema
+
+
+class BinaryExec(TpuExec):
+    def __init__(self, left: TpuExec, right: TpuExec):
+        super().__init__(left, right)
+
+    @property
+    def left(self) -> TpuExec:
+        return self.children[0]
+
+    @property
+    def right(self) -> TpuExec:
+        return self.children[1]
+
+
+class BatchSourceExec(LeafExec):
+    """Leaf producing batches from pre-built device/host data (tests, cache)."""
+
+    def __init__(self, batches_per_partition: Sequence[Sequence[ColumnarBatch]],
+                 schema: T.Schema):
+        super().__init__()
+        self._parts = [list(bs) for bs in batches_per_partition]
+        self._schema = schema
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        yield from self._parts[partition]

@@ -1,0 +1,587 @@
+"""Core device kernels: gather, sortable keys, hashing, segmented aggregation,
+join gather-maps.
+
+This module is the TPU-native replacement for the reference's cudf Table
+primitives (reference: ai.rapids.cudf.Table gather/orderBy/groupBy/join used
+throughout sql-plugin; SURVEY.md section 2.11 item 1). Instead of a C++ kernel
+per operation, every primitive here is a traced JAX function over statically
+shaped buffers, so XLA fuses chains of them into a few TPU kernels.
+
+Key design decisions (TPU-first):
+- All row movement is expressed as a *gather map* (an int32 index vector) plus
+  one `gather_batch` call — the same decomposition cudf uses (GatherMap), but
+  here the map computation and the gather both live in one XLA computation.
+- Ordering uses order-preserving bijections into uint64 ("sortable keys") +
+  `lexsort`, instead of comparator-based sorts: Spark null ordering and NaN
+  semantics become pure bit tricks (see `sortable_key`).
+- Grouping/joining use 64-bit mixed hashes with *exact verification*: hash
+  gives candidate equality classes, a verification pass compares the real key
+  columns so results never depend on hash quality (join verification is exact;
+  see `hash_keys`).
+- Variable-width (string) columns ride along as offsets+bytes; gathers
+  recompute offsets with a cumsum and move bytes with one flat gather.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, bucket_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+
+# ---------------------------------------------------------------------------
+# Gather
+# ---------------------------------------------------------------------------
+
+
+def _string_row_ids(offsets: jax.Array, nbytes: int) -> jax.Array:
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    return jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+
+
+def gather_column(
+    col: DeviceColumn,
+    indices: jax.Array,
+    row_valid: jax.Array,
+    out_byte_capacity: Optional[int] = None,
+) -> DeviceColumn:
+    """Gather rows of one column. ``indices`` has the output capacity;
+    ``row_valid`` marks live output rows (False rows produce null/zero).
+
+    Out-of-range or negative indices must be pre-clipped by the caller except
+    where ``row_valid`` is False (those gather row 0 and are masked).
+    """
+    safe_idx = jnp.where(row_valid, indices, 0).astype(jnp.int32)
+    validity = jnp.where(row_valid, col.validity[safe_idx], False)
+    if col.offsets is None:
+        data = col.data[safe_idx]
+        data = jnp.where(row_valid & validity, data, jnp.zeros_like(data))
+        return DeviceColumn(col.dtype, data, validity)
+    lens = col.offsets[1:] - col.offsets[:-1]
+    out_lens = jnp.where(row_valid, lens[safe_idx], 0)
+    out_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(out_lens).astype(jnp.int32)]
+    )
+    out_bytes = out_byte_capacity or col.data.shape[0]
+    rows = _string_row_ids(out_offsets, out_bytes)
+    rows = jnp.clip(rows, 0, indices.shape[0] - 1)
+    rel = jnp.arange(out_bytes, dtype=jnp.int32) - out_offsets[rows]
+    src = col.offsets[safe_idx[rows]] + rel
+    src = jnp.clip(src, 0, col.data.shape[0] - 1)
+    in_range = jnp.arange(out_bytes, dtype=jnp.int32) < out_offsets[-1]
+    data = jnp.where(in_range, col.data[src], jnp.uint8(0))
+    return DeviceColumn(col.dtype, data, validity, out_offsets)
+
+
+def gather_batch(
+    batch: ColumnarBatch,
+    indices: jax.Array,
+    num_rows: jax.Array,
+    out_byte_capacity: Optional[int] = None,
+) -> ColumnarBatch:
+    """Gather a whole batch into a new batch of capacity len(indices)."""
+    out_cap = indices.shape[0]
+    row_valid = jnp.arange(out_cap, dtype=jnp.int32) < num_rows
+    cols = [
+        gather_column(c, indices, row_valid, out_byte_capacity) for c in batch.columns
+    ]
+    return ColumnarBatch(cols, num_rows.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Sortable keys (order-preserving uint64 encodings)
+# ---------------------------------------------------------------------------
+
+_SIGN64 = np.uint64(1) << np.uint64(63)
+
+
+def _float_sortable(data: jax.Array) -> jax.Array:
+    """IEEE total order with Spark semantics: NaN greater than everything,
+    all NaN payloads equal, -0.0 == 0.0."""
+    d = data.astype(jnp.float64)
+    # canonicalize: all NaNs -> one positive qNaN; -0.0 -> +0.0
+    d = jnp.where(jnp.isnan(d), jnp.float64(np.nan), d)
+    d = jnp.where(d == 0.0, jnp.float64(0.0), d)
+    bits = jax.lax.bitcast_convert_type(d, jnp.int64)
+    u = jax.lax.bitcast_convert_type(d, jnp.uint64)
+    return jnp.where(bits < 0, ~u, u | jnp.uint64(_SIGN64))
+
+
+def _int_sortable(data: jax.Array) -> jax.Array:
+    x = data.astype(jnp.int64)
+    return jax.lax.bitcast_convert_type(x, jnp.uint64) ^ jnp.uint64(_SIGN64)
+
+
+def string_prefix_keys(col: DeviceColumn) -> List[jax.Array]:
+    """Two uint64 keys from the first 16 bytes, big-endian so integer order ==
+    byte-lexicographic order. Exact for strings that differ in the first 16
+    bytes; longer shared prefixes tie (documented round-1 limitation for
+    ORDER BY; grouping/joins use exact hashes + verification instead)."""
+    lens = col.offsets[1:] - col.offsets[:-1]
+    nbytes = col.data.shape[0]
+    keys = []
+    for word in range(2):
+        acc = jnp.zeros(col.capacity, jnp.uint64)
+        for b in range(8):
+            k = word * 8 + b
+            pos = jnp.clip(col.offsets[:-1] + k, 0, max(nbytes - 1, 0))
+            byte = jnp.where(
+                (k < lens) & (nbytes > 0),
+                col.data[pos] if nbytes > 0 else jnp.zeros(col.capacity, jnp.uint8),
+                jnp.uint8(0),
+            ).astype(jnp.uint64)
+            acc = (acc << jnp.uint64(8)) | byte
+        keys.append(acc)
+    return keys
+
+
+def sortable_keys(
+    col: DeviceColumn, ascending: bool = True, nulls_first: Optional[bool] = None
+) -> List[jax.Array]:
+    """Per-column lexsort keys, least-significant first within the column:
+    [data_key_lo, ..., data_key_hi, null_key]. Spark default null ordering:
+    NULLS FIRST for ascending, NULLS LAST for descending."""
+    if nulls_first is None:
+        nulls_first = ascending
+    dt = col.dtype
+    if dt in (T.STRING, T.BINARY):
+        data_keys = string_prefix_keys(col)  # [hi_word, lo_word]? build lo-first
+        data_keys = [data_keys[1], data_keys[0]]
+    elif dt in T.FRACTIONAL_TYPES:
+        data_keys = [_float_sortable(col.data)]
+    elif dt == T.BOOLEAN:
+        data_keys = [col.data.astype(jnp.uint64)]
+    else:
+        data_keys = [_int_sortable(col.data)]
+    if not ascending:
+        data_keys = [~k for k in data_keys]
+    # neutralize data key for nulls so ties are broken deterministically
+    data_keys = [jnp.where(col.validity, k, jnp.uint64(0)) for k in data_keys]
+    null_key = jnp.where(col.validity, jnp.uint64(1), jnp.uint64(0))
+    if not nulls_first:
+        null_key = ~null_key
+    return data_keys + [null_key]
+
+
+class SortSpec(NamedTuple):
+    column: int
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+def sort_indices(
+    batch: ColumnarBatch, specs: Sequence[SortSpec]
+) -> jax.Array:
+    """Stable lexicographic argsort of the live rows; padding rows sort last.
+
+    Replaces cudf ``Table.orderBy`` (reference GpuSortExec.scala:144 /
+    SortUtils.scala) with a single fused lexsort on bit-encoded keys.
+    """
+    active = batch.active_mask()
+    keys: List[jax.Array] = []
+    # lexsort: LAST key is primary -> emit least-significant spec first
+    for spec in reversed(list(specs)):
+        keys.extend(sortable_keys(batch.columns[spec.column], spec.ascending,
+                                  spec.nulls_first))
+    keys.append(jnp.where(active, jnp.uint64(0), jnp.uint64(1)))  # padding last
+    return jnp.lexsort(tuple(keys)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Hashing (splitmix64 mixing; polynomial rolling hash for strings)
+# ---------------------------------------------------------------------------
+
+
+def _splitmix64(x: jax.Array) -> jax.Array:
+    x = x + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def _string_hash(col: DeviceColumn) -> jax.Array:
+    """Order-dependent polynomial hash of each row's bytes (mod 2^64).
+
+    hash(row) = sum_k byte[k] * P^(len-1-rel_k); computed as a segment sum of
+    byte * P^(-rel) * P^(len-1) using modular inverse powers — instead we use
+    forward powers with a per-row normalization: sum byte*P^rel, then no
+    normalization needed since rows are compared whole (same rel ordering)."""
+    nbytes = col.data.shape[0]
+    cap = col.capacity
+    if nbytes == 0:
+        return jnp.zeros(cap, jnp.uint64)
+    rows = _string_row_ids(col.offsets, nbytes)
+    rows_c = jnp.clip(rows, 0, cap - 1)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - col.offsets[rows_c]
+    P = jnp.uint64(0x100000001B3)  # FNV prime
+    powers = _pow_table(P, nbytes)
+    contrib = (col.data.astype(jnp.uint64) + jnp.uint64(1)) * powers[
+        jnp.clip(rel, 0, nbytes - 1)
+    ]
+    in_range = jnp.arange(nbytes, dtype=jnp.int32) < col.offsets[-1]
+    contrib = jnp.where(in_range, contrib, jnp.uint64(0))
+    h = jax.ops.segment_sum(contrib, rows_c, num_segments=cap,
+                            indices_are_sorted=True)
+    lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.uint64)
+    return _splitmix64(h ^ (lens * jnp.uint64(0x9E3779B97F4A7C15)))
+
+
+def _pow_table(p: jax.Array, n: int) -> jax.Array:
+    """powers[k] = p^k mod 2^64, by log-depth doubling (n is static)."""
+    vals = jnp.ones(1, jnp.uint64)
+    stride = p
+    while vals.shape[0] < n:
+        vals = jnp.concatenate([vals, vals * stride])
+        stride = stride * stride
+    return vals[:n]
+
+
+def hash_keys(batch: ColumnarBatch, key_cols: Sequence[int]) -> jax.Array:
+    """64-bit combined hash of the key columns per row. Used for grouping and
+    join candidate generation; exactness comes from the verification pass
+    (`keys_equal`), not from this hash."""
+    h = jnp.zeros(batch.capacity, jnp.uint64)
+    for i in key_cols:
+        col = batch.columns[i]
+        if col.offsets is not None:
+            ch = _string_hash(col)
+        elif col.dtype in T.FRACTIONAL_TYPES:
+            # hash the canonical sortable form so NaN==NaN, -0.0==0.0
+            ch = _splitmix64(_float_sortable(col.data))
+        else:
+            ch = _splitmix64(_int_sortable(col.data))
+        ch = jnp.where(col.validity, ch, jnp.uint64(0xDEADBEEFCAFEBABE))
+        h = _splitmix64(h * jnp.uint64(31) + ch)
+    return h
+
+
+def keys_equal(
+    a: ColumnarBatch, a_idx: jax.Array, a_cols: Sequence[int],
+    b: ColumnarBatch, b_idx: jax.Array, b_cols: Sequence[int],
+) -> jax.Array:
+    """Exact null-safe equality of key tuples at gathered positions.
+
+    SQL equi-join semantics: NULL keys never match (callers pre-filter null
+    keys); here nulls compare equal only if both null (callers decide)."""
+    eq = jnp.ones(a_idx.shape[0], jnp.bool_)
+    for ai, bi in zip(a_cols, b_cols):
+        ca, cb = a.columns[ai], b.columns[bi]
+        va = ca.validity[a_idx]
+        vb = cb.validity[b_idx]
+        if ca.offsets is not None:
+            ceq = _string_eq_at(ca, a_idx, cb, b_idx)
+        elif ca.dtype in T.FRACTIONAL_TYPES:
+            ceq = _float_sortable(ca.data)[a_idx] == _float_sortable(cb.data)[b_idx]
+        else:
+            da = ca.data[a_idx]
+            db = cb.data[b_idx]
+            ceq = da.astype(jnp.int64) == db.astype(jnp.int64)
+        eq = eq & ((ceq & va & vb) | (~va & ~vb))
+    return eq
+
+
+def _string_eq_at(
+    ca: DeviceColumn, a_idx: jax.Array, cb: DeviceColumn, b_idx: jax.Array
+) -> jax.Array:
+    """Exact string equality at row pairs, via hash + 16-byte prefix.
+
+    Combines the 64-bit polynomial hash with both 16-byte prefixes; a false
+    positive requires simultaneous 64-bit hash collision AND identical
+    prefix/length — treated as exact for engine purposes."""
+    ha = _string_hash(ca)[a_idx]
+    hb = _string_hash(cb)[b_idx]
+    la = (ca.offsets[1:] - ca.offsets[:-1])[a_idx]
+    lb = (cb.offsets[1:] - cb.offsets[:-1])[b_idx]
+    pa = string_prefix_keys(ca)
+    pb = string_prefix_keys(cb)
+    eq = (ha == hb) & (la == lb)
+    for x, y in zip(pa, pb):
+        eq = eq & (x[a_idx] == y[b_idx])
+    return eq
+
+
+# ---------------------------------------------------------------------------
+# Filter compaction
+# ---------------------------------------------------------------------------
+
+
+def filter_indices(keep: jax.Array, active: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Order-preserving compaction map: indices of kept rows moved to front.
+
+    Returns (indices, n_kept). O(n) cumsum + scatter — the XLA-friendly
+    equivalent of cudf's stream compaction (Table.filter in the reference's
+    GpuFilterExec). Slots past n_kept point at row 0; callers mask them with
+    the returned count (gather_batch row_valid)."""
+    k = keep & active
+    cap = k.shape[0]
+    dst = jnp.cumsum(k.astype(jnp.int32)) - 1
+    out = jnp.zeros(cap, jnp.int32)
+    out = out.at[jnp.where(k, dst, cap)].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop"
+    )
+    return out, jnp.sum(k).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Group-by: sort-based segmented aggregation
+# ---------------------------------------------------------------------------
+
+
+class GroupInfo(NamedTuple):
+    """Result of grouping rows: a permutation placing rows in group order,
+    per-row segment ids (in permuted order), and the group count."""
+
+    perm: jax.Array  # (cap,) int32 — gather map into the input
+    segment_ids: jax.Array  # (cap,) int32 — group id per permuted row
+    num_groups: jax.Array  # int32 scalar
+    group_starts: jax.Array  # (cap,) int32 — permuted index of each group head
+
+
+def group_rows(batch: ColumnarBatch, key_cols: Sequence[int]) -> GroupInfo:
+    """Cluster live rows by key equality.
+
+    TPU-first replacement for cudf hash-groupby: sort by (hash, prefixes) then
+    split segments wherever the *exact* keys differ between neighbors — so
+    hash collisions create adjacent-but-separate groups, never merged ones.
+    """
+    cap = batch.capacity
+    active = batch.active_mask()
+    h = hash_keys(batch, key_cols)
+    keys: List[jax.Array] = [h]
+    for i in key_cols:
+        col = batch.columns[i]
+        if col.offsets is not None:
+            keys.extend(string_prefix_keys(col))
+    keys.append(jnp.where(active, jnp.uint64(0), jnp.uint64(1)))
+    perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
+    prev = jnp.concatenate([perm[:1], perm[:-1]])
+    neq = ~keys_equal(batch, perm, key_cols, batch, prev, key_cols)
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    perm_active = active[perm]
+    boundary = perm_active & ((idx == 0) | neq)
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg = jnp.clip(seg, 0, cap - 1)
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    # head position of each group (for gathering key values)
+    group_starts = jax.ops.segment_min(
+        jnp.where(boundary, idx, cap - 1), seg, num_segments=cap
+    ).astype(jnp.int32)
+    return GroupInfo(perm, seg, num_groups, group_starts)
+
+
+def segment_agg(
+    values: jax.Array,
+    validity: jax.Array,
+    contributing: jax.Array,
+    seg: jax.Array,
+    num_segments: int,
+    op: str,
+):
+    """One segmented aggregation. ``contributing`` masks rows that count.
+
+    Returns (agg_values, agg_validity). op in sum/count/min/max/first/last/
+    count_all/sum_sq (sum of squares, for variance)."""
+    live = contributing & validity
+    if op == "count_all":
+        data = jax.ops.segment_sum(
+            contributing.astype(jnp.int64), seg, num_segments=num_segments
+        )
+        return data, jnp.ones_like(data, jnp.bool_)
+    if op == "count":
+        data = jax.ops.segment_sum(
+            live.astype(jnp.int64), seg, num_segments=num_segments
+        )
+        return data, jnp.ones_like(data, jnp.bool_)
+    any_valid = (
+        jax.ops.segment_max(
+            live.astype(jnp.int32), seg, num_segments=num_segments
+        )
+        > 0
+    )
+    if op in ("sum", "sum_sq"):
+        v = values.astype(
+            jnp.float64 if jnp.issubdtype(values.dtype, jnp.floating) else jnp.int64
+        )
+        if op == "sum_sq":
+            v = v * v
+        v = jnp.where(live, v, jnp.zeros_like(v))
+        return jax.ops.segment_sum(v, seg, num_segments=num_segments), any_valid
+    if op in ("min", "max"):
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            # NaN-aware: encode to sortable, reduce, decode
+            enc = _float_sortable(values)
+            ident = jnp.uint64(0) if op == "max" else jnp.uint64(0xFFFFFFFFFFFFFFFF)
+            enc = jnp.where(live, enc, ident)
+            red = (jax.ops.segment_max if op == "max" else jax.ops.segment_min)(
+                enc, seg, num_segments=num_segments
+            )
+            dec = jnp.where(
+                red >= jnp.uint64(_SIGN64),
+                jax.lax.bitcast_convert_type(red ^ jnp.uint64(_SIGN64), jnp.float64),
+                jax.lax.bitcast_convert_type(~red, jnp.float64),
+            ).astype(values.dtype)
+            return dec, any_valid
+        ii = jnp.iinfo(values.dtype if values.dtype != jnp.bool_ else jnp.int8)
+        if values.dtype == jnp.bool_:
+            v = values.astype(jnp.int8)
+        else:
+            v = values
+        ident = ii.min if op == "max" else ii.max
+        v = jnp.where(live, v, jnp.full_like(v, ident))
+        red = (jax.ops.segment_max if op == "max" else jax.ops.segment_min)(
+            v, seg, num_segments=num_segments
+        )
+        if values.dtype == jnp.bool_:
+            red = red.astype(jnp.bool_)
+        return red, any_valid
+    if op in ("first", "last"):
+        idx = jnp.arange(values.shape[0], dtype=jnp.int32)
+        pick = jnp.where(live, idx, values.shape[0] if op == "first" else -1)
+        sel = (jax.ops.segment_min if op == "first" else jax.ops.segment_max)(
+            pick, seg, num_segments=num_segments
+        )
+        sel_c = jnp.clip(sel, 0, values.shape[0] - 1)
+        return values[sel_c], any_valid
+    raise NotImplementedError(op)
+
+
+# ---------------------------------------------------------------------------
+# Device concatenation (GpuCoalesceBatches concat, on device)
+# ---------------------------------------------------------------------------
+
+
+def concat_device(
+    batches: Sequence[ColumnarBatch],
+    out_capacity: int,
+    out_byte_capacities: Sequence[int],
+) -> ColumnarBatch:
+    """Concatenate batches entirely on device (no host round trip).
+
+    The reference concatenates on device via cudf Table.concatenate
+    (GpuCoalesceBatches.scala:160); here each input's live rows are scattered
+    to a running offset. Capacities are static; live row counts are traced.
+    """
+    ncols = len(batches[0].columns)
+    total_rows = jnp.int32(0)
+    starts = []
+    for b in batches:
+        starts.append(total_rows)
+        total_rows = total_rows + b.num_rows
+    out_cols: List[DeviceColumn] = []
+    for ci in range(ncols):
+        dtype = batches[0].columns[ci].dtype
+        is_string = batches[0].columns[ci].offsets is not None
+        if not is_string:
+            data = jnp.zeros(out_capacity, batches[0].columns[ci].data.dtype)
+            validity = jnp.zeros(out_capacity, jnp.bool_)
+            for b, st in zip(batches, starts):
+                c = b.columns[ci]
+                j = jnp.arange(c.capacity, dtype=jnp.int32)
+                live = j < b.num_rows
+                pos = jnp.where(live, st + j, out_capacity)  # OOB drops
+                data = data.at[pos].set(c.data, mode="drop")
+                validity = validity.at[pos].set(c.validity, mode="drop")
+            out_cols.append(DeviceColumn(dtype, data, validity))
+            continue
+        out_bytes = out_byte_capacities[ci]
+        lens_out = jnp.zeros(out_capacity, jnp.int32)
+        validity = jnp.zeros(out_capacity, jnp.bool_)
+        for b, st in zip(batches, starts):
+            c = b.columns[ci]
+            j = jnp.arange(c.capacity, dtype=jnp.int32)
+            live = j < b.num_rows
+            pos = jnp.where(live, st + j, out_capacity)
+            lens = c.offsets[1:] - c.offsets[:-1]
+            lens_out = lens_out.at[pos].set(lens, mode="drop")
+            validity = validity.at[pos].set(c.validity, mode="drop")
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens_out).astype(jnp.int32)]
+        )
+        data = jnp.zeros(out_bytes, jnp.uint8)
+        for b, st in zip(batches, starts):
+            c = b.columns[ci]
+            nbytes_in = c.data.shape[0]
+            if nbytes_in == 0:
+                continue
+            k = jnp.arange(nbytes_in, dtype=jnp.int32)
+            rows = _string_row_ids(c.offsets, nbytes_in)
+            rows_c = jnp.clip(rows, 0, c.capacity - 1)
+            live_byte = (rows_c < b.num_rows) & (k < c.offsets[-1]) & (rows >= 0)
+            dst_row = st + rows_c
+            dst = offsets[jnp.clip(dst_row, 0, out_capacity - 1)] + (
+                k - c.offsets[rows_c]
+            )
+            dst = jnp.where(live_byte, dst, out_bytes)
+            data = data.at[dst].set(c.data, mode="drop")
+        out_cols.append(DeviceColumn(dtype, data, validity, offsets))
+    return ColumnarBatch(out_cols, total_rows)
+
+
+# ---------------------------------------------------------------------------
+# Join gather maps (sorted-hash merge + exact verification)
+# ---------------------------------------------------------------------------
+
+
+class JoinHashes(NamedTuple):
+    """Build-side preprocessed state: hashes sorted with an order map."""
+
+    sorted_hash: jax.Array  # (cap_b,) uint64, invalid rows at the end
+    order: jax.Array  # (cap_b,) int32, original row of each sorted slot
+    valid: jax.Array  # (cap_b,) bool in sorted order
+
+
+def prepare_join_side(batch: ColumnarBatch, key_cols: Sequence[int]) -> JoinHashes:
+    h = hash_keys(batch, key_cols)
+    valid = batch.active_mask()
+    for i in key_cols:
+        valid = valid & batch.columns[i].validity  # SQL: null keys never match
+    # push invalid rows past every real hash, keeping the array globally
+    # sorted so searchsorted stays valid; candidates landing in the invalid
+    # tail are cut by the n_valid clamp in join_candidate_counts
+    hh = jnp.where(valid, h, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    order = jnp.lexsort((hh, ~valid)).astype(jnp.int32)
+    return JoinHashes(hh[order], order, valid[order])
+
+
+def join_candidate_counts(
+    probe: ColumnarBatch, probe_keys: Sequence[int], build: JoinHashes
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-probe-row candidate ranges in the sorted build hashes.
+
+    Returns (lo, cnt, probe_valid); total candidates = sum(cnt)."""
+    ph = hash_keys(probe, probe_keys)
+    pvalid = probe.active_mask()
+    for i in probe_keys:
+        pvalid = pvalid & probe.columns[i].validity
+    n_build_valid = jnp.sum(build.valid.astype(jnp.int32))
+    lo = jnp.searchsorted(build.sorted_hash, ph, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(build.sorted_hash, ph, side="right").astype(jnp.int32)
+    hi = jnp.minimum(hi, n_build_valid)
+    lo = jnp.minimum(lo, hi)
+    cnt = jnp.where(pvalid, hi - lo, 0)
+    return lo, cnt, pvalid
+
+
+def expand_candidates(
+    lo: jax.Array, cnt: jax.Array, out_capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Expand per-row candidate ranges into flat (probe_row, build_slot) pairs.
+
+    Returns (probe_idx, build_slot, pair_valid) of length out_capacity.
+    The reference's analog is the gather-map pair produced by cudf joins
+    (GpuHashJoin.scala:332 JoinGatherer)."""
+    ends = jnp.cumsum(cnt).astype(jnp.int32)
+    total = ends[-1] if cnt.shape[0] else jnp.int32(0)
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    probe_idx = jnp.searchsorted(ends, j, side="right").astype(jnp.int32)
+    probe_c = jnp.clip(probe_idx, 0, cnt.shape[0] - 1)
+    start = ends[probe_c] - cnt[probe_c]
+    build_slot = lo[probe_c] + (j - start)
+    pair_valid = j < total
+    return probe_c, build_slot, pair_valid

@@ -1,0 +1,233 @@
+"""Coalesce, limits, range, union.
+
+Reference: GpuCoalesceBatches (GpuCoalesceBatches.scala:160 — CoalesceGoal
+lattice TargetSize/RequireSingleBatch), limit.scala (GpuLocalLimitExec /
+GpuGlobalLimitExec / GpuTakeOrderedAndProjectExec), GpuRangeExec, UnionExec.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, bucket_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.base import LeafExec, TpuExec, UnaryExec
+from spark_rapids_tpu.exec import kernels as K
+from spark_rapids_tpu.exec.aggregate import concat_jit
+from spark_rapids_tpu.exec.sort import SortExec, SortOrder
+from spark_rapids_tpu.exec.project import ProjectExec
+from spark_rapids_tpu.exprs import expr as E
+
+
+class CoalesceBatchesExec(UnaryExec):
+    """Concatenate small batches up to a target row count (TargetSize goal);
+    ``require_single`` concatenates everything (RequireSingleBatch goal)."""
+
+    def __init__(self, child: TpuExec, target_rows: int = 1 << 20,
+                 require_single: bool = False):
+        super().__init__(child)
+        self.target_rows = target_rows
+        self.require_single = require_single
+        self._register_metric("concatTimeNs")
+
+    def node_description(self) -> str:
+        goal = "RequireSingleBatch" if self.require_single else (
+            f"TargetSize({self.target_rows})")
+        return f"TpuCoalesceBatches [{goal}]"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        pending: List[ColumnarBatch] = []
+        rows = 0
+        for b in self.child.execute(partition):
+            n = b.row_count()
+            if not self.require_single and rows and rows + n > self.target_rows:
+                yield self._flush(pending)
+                pending, rows = [], 0
+            pending.append(b)
+            rows += n
+        if pending:
+            yield self._flush(pending)
+
+    def _flush(self, pending: List[ColumnarBatch]) -> ColumnarBatch:
+        if len(pending) == 1:
+            return pending[0]
+        with self.timer("concatTimeNs"):
+            return concat_jit(pending)
+
+
+class LocalLimitExec(UnaryExec):
+    """Limit rows within each partition."""
+
+    def __init__(self, limit: int, child: TpuExec):
+        super().__init__(child)
+        self.limit = limit
+
+    def node_description(self) -> str:
+        return f"TpuLocalLimit {self.limit}"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        remaining = self.limit
+        for b in self.child.execute(partition):
+            if remaining <= 0:
+                return
+            n = b.row_count()
+            if n <= remaining:
+                remaining -= n
+                yield b
+            else:
+                yield _truncate(b, remaining)
+                return
+
+
+class GlobalLimitExec(UnaryExec):
+    """Limit across partitions (driver-side sequencing)."""
+
+    def __init__(self, limit: int, child: TpuExec, offset: int = 0):
+        super().__init__(child)
+        self.limit = limit
+        self.offset = offset
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_description(self) -> str:
+        return f"TpuGlobalLimit {self.limit} offset={self.offset}"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        assert partition == 0
+        to_skip = self.offset
+        remaining = self.limit
+        for p in range(self.child.num_partitions()):
+            for b in self.child.execute(p):
+                n = b.row_count()
+                if to_skip:
+                    if n <= to_skip:
+                        to_skip -= n
+                        continue
+                    b = _drop_head(b, to_skip)
+                    n -= to_skip
+                    to_skip = 0
+                if remaining <= 0:
+                    return
+                if n <= remaining:
+                    remaining -= n
+                    yield b
+                else:
+                    yield _truncate(b, remaining)
+                    return
+
+
+def take_ordered_and_project(orders: Sequence[SortOrder], limit: int,
+                             child: TpuExec,
+                             project: Optional[Sequence[E.Expression]] = None
+                             ) -> TpuExec:
+    """GpuTakeOrderedAndProjectExec analog: per-partition sort+limit, then a
+    single-partition merge sort + limit + optional projection."""
+    local = LocalLimitExec(limit, SortExec(orders, child))
+    merged = GlobalLimitExec(limit, SortExec(orders, _Gather(local)))
+    if project is not None:
+        return ProjectExec(project, merged)
+    return merged
+
+
+class _Gather(UnaryExec):
+    """Collapse all child partitions into one (driver-style gather)."""
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        for p in range(self.child.num_partitions()):
+            yield from self.child.execute(p)
+
+
+class RangeExec(LeafExec):
+    """start/end/step long range generated directly on device
+    (reference: GpuRangeExec in basicPhysicalOperators.scala)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 n_partitions: int = 1, target_batch_rows: int = 1 << 20):
+        super().__init__()
+        assert step != 0
+        self.start, self.end, self.step = start, end, step
+        self.n_partitions = n_partitions
+        self.target_batch_rows = target_batch_rows
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return T.Schema([T.Field("id", T.LONG, False)])
+
+    def num_partitions(self) -> int:
+        return self.n_partitions
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self.n_partitions)
+        lo = partition * per
+        hi = min(total, lo + per)
+        pos = lo
+        while pos < hi:
+            n = min(self.target_batch_rows, hi - pos)
+            cap = bucket_capacity(n)
+            idx = jnp.arange(cap, dtype=jnp.int64)
+            data = jnp.int64(self.start) + (jnp.int64(pos) + idx) * jnp.int64(self.step)
+            valid = idx < n
+            col = DeviceColumn(T.LONG, jnp.where(valid, data, 0), valid)
+            yield ColumnarBatch([col], jnp.int32(n))
+            pos += n
+
+
+class UnionExec(TpuExec):
+    """Concatenation of children outputs (GpuUnionExec): partitions of each
+    child become partitions of the union."""
+
+    def __init__(self, *children: TpuExec):
+        super().__init__(*children)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions() for c in self.children)
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        for c in self.children:
+            n = c.num_partitions()
+            if partition < n:
+                yield from c.execute(partition)
+                return
+            partition -= n
+
+
+_truncate_jit = jax.jit(
+    lambda b, n: ColumnarBatch(
+        [DeviceColumn(c.dtype,
+                      c.data,
+                      c.validity & (jnp.arange(c.capacity, dtype=jnp.int32) < n),
+                      c.offsets)
+         for c in b.columns],
+        jnp.minimum(b.num_rows, n).astype(jnp.int32),
+    )
+)
+
+
+def _truncate(b: ColumnarBatch, n: int) -> ColumnarBatch:
+    return _truncate_jit(b, jnp.int32(n))
+
+
+@jax.jit
+def _drop_head_jit(b: ColumnarBatch, k: jax.Array) -> ColumnarBatch:
+    cap = b.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32) + k
+    n = jnp.maximum(b.num_rows - k, 0)
+    return K.gather_batch(b, jnp.clip(idx, 0, cap - 1), n)
+
+
+def _drop_head(b: ColumnarBatch, k: int) -> ColumnarBatch:
+    return _drop_head_jit(b, jnp.int32(k))

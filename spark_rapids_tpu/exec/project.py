@@ -1,0 +1,87 @@
+"""Projection and filter operators.
+
+Reference: GpuProjectExec / GpuFilterExec (basicPhysicalOperators.scala:365,
+518). TPU-first: the bound expression tree AND the filter compaction lower
+into one jit-compiled XLA computation per capacity bucket — there is no
+per-expression kernel dispatch, XLA fuses the whole thing (this subsumes the
+reference's tiered-projection CSE, basicPhysicalOperators.scala:806).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import UnaryExec, TpuExec
+from spark_rapids_tpu.exec import kernels as K
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs import eval as EV
+
+
+class ProjectExec(UnaryExec):
+    def __init__(self, exprs: Sequence[E.Expression], child: TpuExec,
+                 ansi: bool = False):
+        super().__init__(child)
+        self.exprs = list(exprs)
+        self._bound = None
+        self._ansi = ansi
+        self._schema = None
+
+    def _bind(self):
+        if self._bound is None:
+            self._bound = tuple(
+                EV.bind_projection(self.exprs, self.child.output_schema)
+            )
+            self._schema = EV.output_schema(self._bound)
+            self._run = EV.compile_bound_projection(self._bound, self._ansi)
+        return self._bound
+
+    @property
+    def output_schema(self) -> T.Schema:
+        self._bind()
+        return self._schema
+
+    def node_description(self) -> str:
+        return f"TpuProject [{', '.join(map(repr, self.exprs))}]"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        self._bind()
+        for batch in self.child.execute(partition):
+            yield self._run(batch)
+
+
+class FilterExec(UnaryExec):
+    """Filter + compaction in one fused kernel."""
+
+    def __init__(self, condition: E.Expression, child: TpuExec,
+                 ansi: bool = False):
+        super().__init__(child)
+        self.condition = condition
+        self._bound = None
+        self._ansi = ansi
+
+    def _bind(self):
+        if self._bound is None:
+            self._bound = E.resolve(self.condition, self.child.output_schema)
+
+            @jax.jit
+            def run(batch):
+                ctx = EV.EvalContext(batch, self._ansi)
+                pred = EV.eval_expr(self._bound, ctx)
+                keep = pred.data & pred.validity
+                idx, n = K.filter_indices(keep, batch.active_mask())
+                return K.gather_batch(batch, idx, n)
+
+            self._run = run
+        return self._bound
+
+    def node_description(self) -> str:
+        return f"TpuFilter [{self.condition!r}]"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        self._bind()
+        for batch in self.child.execute(partition):
+            yield self._run(batch)

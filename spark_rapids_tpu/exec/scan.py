@@ -1,0 +1,229 @@
+"""Parquet scan: CPU-threadpool read/decode -> single device upload.
+
+Reference: GpuParquetScan.scala (3192 LoC) with three reader types
+(RapidsConf.scala:315): PERFILE, MULTITHREADED
+(MultiFileCloudParquetPartitionReader:2346 — threadpool reads+decodes host
+buffers while the task holds no device), COALESCING
+(MultiFileParquetPartitionReader:2144 — stitch row groups into one read).
+
+TPU mapping: Arrow C++ does the host decode (the reference decodes on device
+with libcudf; a Pallas decoder is future work — SURVEY.md §7.3), and the
+device is only touched for the final upload — the analog of the reference
+acquiring the GPU semaphore only after host buffers are ready
+(GpuParquetScan.scala:2266).
+
+Row-group pruning uses parquet footer statistics against simple predicates,
+the analog of the reference's predicate pushdown.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import dataclasses
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, batch_from_arrow
+from spark_rapids_tpu.exec.base import LeafExec
+from spark_rapids_tpu.exprs import expr as E
+
+
+@dataclasses.dataclass
+class RowGroupTask:
+    path: str
+    row_groups: List[int]
+
+
+def _stats_may_match(expr: E.Expression, stats_by_col) -> bool:
+    """Conservative row-group pruning: False only when stats PROVE no row can
+    match. Handles And/Or and col <op> literal."""
+    if isinstance(expr, E.And):
+        return (_stats_may_match(expr.left, stats_by_col)
+                and _stats_may_match(expr.right, stats_by_col))
+    if isinstance(expr, E.Or):
+        return (_stats_may_match(expr.left, stats_by_col)
+                or _stats_may_match(expr.right, stats_by_col))
+    if isinstance(expr, E.BinaryComparison):
+        col, litv, flipped = _col_lit(expr)
+        if col is None or col not in stats_by_col:
+            return True
+        mn, mx = stats_by_col[col]
+        if mn is None or mx is None:
+            return True
+        op = type(expr).__name__
+        if flipped:
+            flip = {"LessThan": "GreaterThan", "GreaterThan": "LessThan",
+                    "LessThanOrEqual": "GreaterThanOrEqual",
+                    "GreaterThanOrEqual": "LessThanOrEqual"}
+            op = flip.get(op, op)
+        try:
+            if op == "EqualTo":
+                return mn <= litv <= mx
+            if op == "LessThan":
+                return mn < litv
+            if op == "LessThanOrEqual":
+                return mn <= litv
+            if op == "GreaterThan":
+                return mx > litv
+            if op == "GreaterThanOrEqual":
+                return mx >= litv
+        except TypeError:
+            return True
+    return True
+
+
+def _col_lit(expr: E.BinaryComparison):
+    l, r = expr.left, expr.right
+    if isinstance(l, E.UnresolvedColumn) and isinstance(r, E.Literal):
+        return l.name, r.value, False
+    if isinstance(l, E.ColumnRef) and isinstance(r, E.Literal):
+        return l.name, r.value, False
+    if isinstance(r, E.UnresolvedColumn) and isinstance(l, E.Literal):
+        return r.name, l.value, True
+    if isinstance(r, E.ColumnRef) and isinstance(l, E.Literal):
+        return r.name, l.value, True
+    return None, None, False
+
+
+def _windowed_map(pool, fn, items, window: int):
+    """pool.map with a bounded in-flight window: keeps reads overlapped with
+    consumption without materializing every decoded table (the reference's
+    multithreaded reader similarly caps in-flight host buffers)."""
+    from collections import deque
+
+    items = iter(items)
+    inflight = deque()
+    try:
+        for it in items:
+            inflight.append(pool.submit(fn, it))
+            if len(inflight) >= window:
+                yield inflight.popleft().result()
+        while inflight:
+            yield inflight.popleft().result()
+    finally:
+        for f in inflight:
+            f.cancel()
+
+
+class ParquetScanExec(LeafExec):
+    """Scan parquet files into device batches.
+
+    Files are split across ``n_partitions``; within a partition, the reader
+    type decides the host-side strategy.
+    """
+
+    def __init__(self, paths: Sequence[str],
+                 columns: Optional[Sequence[str]] = None,
+                 predicate: Optional[E.Expression] = None,
+                 reader_type: str = "MULTITHREADED",
+                 reader_threads: int = 8,
+                 target_batch_rows: int = 1 << 20,
+                 n_partitions: int = 1,
+                 min_bucket: int = 1024):
+        super().__init__()
+        assert reader_type in ("PERFILE", "MULTITHREADED", "COALESCING")
+        self.paths = list(paths)
+        self.columns = list(columns) if columns is not None else None
+        self.predicate = predicate
+        self.reader_type = reader_type
+        self.reader_threads = reader_threads
+        self.target_batch_rows = target_batch_rows
+        self.n_partitions = n_partitions
+        self.min_bucket = min_bucket
+        self._schema: Optional[T.Schema] = None
+        self._register_metric("numRowGroups")
+        self._register_metric("numPrunedRowGroups")
+        self._register_metric("scanTimeNs")
+        self._register_metric("uploadTimeNs")
+
+    @property
+    def output_schema(self) -> T.Schema:
+        if self._schema is None:
+            arrow_schema = pq.read_schema(self.paths[0])
+            if self.columns is not None:
+                arrow_schema = pa.schema(
+                    [arrow_schema.field(c) for c in self.columns]
+                )
+            self._schema = T.Schema.from_arrow(arrow_schema)
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return self.n_partitions
+
+    def node_description(self) -> str:
+        cols = f" columns={self.columns}" if self.columns else ""
+        return (f"TpuParquetScan [{len(self.paths)} files,"
+                f" {self.reader_type}]{cols}")
+
+    # -- planning ----------------------------------------------------------
+    def _tasks_for_partition(self, partition: int) -> List[RowGroupTask]:
+        files = [p for i, p in enumerate(self.paths)
+                 if i % self.n_partitions == partition]
+        tasks = []
+        for path in files:
+            md = pq.ParquetFile(path).metadata
+            keep = []
+            for rg in range(md.num_row_groups):
+                self.metrics["numRowGroups"].add(1)
+                if self.predicate is not None and self._prune(md, rg):
+                    self.metrics["numPrunedRowGroups"].add(1)
+                    continue
+                keep.append(rg)
+            if keep:
+                tasks.append(RowGroupTask(path, keep))
+        return tasks
+
+    def _prune(self, md, rg_index: int) -> bool:
+        rg = md.row_group(rg_index)
+        stats_by_col = {}
+        for ci in range(rg.num_columns):
+            col = rg.column(ci)
+            st = col.statistics
+            name = col.path_in_schema
+            if st is not None and st.has_min_max:
+                stats_by_col[name] = (st.min, st.max)
+        return not _stats_may_match(self.predicate, stats_by_col)
+
+    # -- reading -----------------------------------------------------------
+    def _read_task(self, task: RowGroupTask) -> pa.Table:
+        f = pq.ParquetFile(task.path)
+        return f.read_row_groups(task.row_groups, columns=self.columns,
+                                 use_threads=False)
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        tasks = self._tasks_for_partition(partition)
+        if not tasks:
+            return
+        if self.reader_type == "PERFILE":
+            yield from self._upload(map(self._read_task, tasks))
+        elif self.reader_type == "MULTITHREADED":
+            with cf.ThreadPoolExecutor(self.reader_threads) as pool:
+                yield from self._upload(
+                    _windowed_map(pool, self._read_task, tasks,
+                                  window=self.reader_threads * 2)
+                )
+        else:  # COALESCING: one logical read of everything, then re-chunk
+            with self.timer("scanTimeNs"):
+                whole = pa.concat_tables(self._read_task(t) for t in tasks)
+            yield from self._upload(iter([whole]))
+
+    def _upload(self, tables) -> Iterator[ColumnarBatch]:
+        pending: List[pa.Table] = []
+        pending_rows = 0
+        for t in tables:
+            pending.append(t)
+            pending_rows += t.num_rows
+            while pending_rows >= self.target_batch_rows:
+                whole = pa.concat_tables(pending)
+                head = whole.slice(0, self.target_batch_rows)
+                rest = whole.slice(self.target_batch_rows)
+                with self.timer("uploadTimeNs"):
+                    yield batch_from_arrow(head, self.min_bucket)
+                pending = [rest] if rest.num_rows else []
+                pending_rows = rest.num_rows
+        if pending_rows > 0:
+            with self.timer("uploadTimeNs"):
+                yield batch_from_arrow(pa.concat_tables(pending), self.min_bucket)

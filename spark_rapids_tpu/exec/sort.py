@@ -1,0 +1,88 @@
+"""Sort operator.
+
+Reference: GpuSortExec (GpuSortExec.scala:144) with full/each-batch modes and
+an out-of-core path (:281). TPU-first: sort keys are order-preserving uint64
+encodings and the sort is one fused lexsort + gather (kernels.sort_indices);
+Spark null ordering and NaN totality are bit tricks, not comparators.
+
+The out-of-core path (sort chunks, split on boundaries, spill pending) plugs
+in at the mem/ layer; within-HBM sorts here handle one concatenated partition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+from spark_rapids_tpu.exec import kernels as K
+from spark_rapids_tpu.exec.aggregate import concat_jit
+from spark_rapids_tpu.exprs import expr as E
+
+
+@dataclasses.dataclass(frozen=True)
+class SortOrder:
+    child: E.Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None = Spark default for direction
+
+    def __repr__(self):
+        d = "ASC" if self.ascending else "DESC"
+        return f"{self.child!r} {d}"
+
+
+class SortExec(UnaryExec):
+    """Sorts each partition (total order per partition).
+
+    A global sort is a range-shuffle (shuffle/) followed by this."""
+
+    def __init__(self, orders: Sequence[SortOrder], child: TpuExec,
+                 each_batch: bool = False):
+        super().__init__(child)
+        self.orders = list(orders)
+        self.each_batch = each_batch
+        self._prepared = False
+        self._register_metric("sortTimeNs")
+
+    def _prepare(self):
+        if self._prepared:
+            return
+        schema = self.child.output_schema
+        self._specs = []
+        for o in self.orders:
+            bound = E.resolve(o.child, schema)
+            assert isinstance(bound, E.ColumnRef), (
+                "sort keys must be column refs; plan layer pre-projects"
+            )
+            self._specs.append(
+                K.SortSpec(bound.index, o.ascending, o.nulls_first)
+            )
+
+        @jax.jit
+        def run(batch):
+            idx = K.sort_indices(batch, self._specs)
+            return K.gather_batch(batch, idx, batch.num_rows)
+
+        self._run = run
+        self._prepared = True
+
+    def node_description(self) -> str:
+        return f"TpuSort [{', '.join(map(repr, self.orders))}]"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        self._prepare()
+        if self.each_batch:
+            for b in self.child.execute(partition):
+                with self.timer("sortTimeNs"):
+                    yield self._run(b)
+            return
+        batches = list(self.child.execute(partition))
+        if not batches:
+            return
+        with self.timer("sortTimeNs"):
+            whole = batches[0] if len(batches) == 1 else concat_jit(batches)
+            yield self._run(whole)

@@ -798,15 +798,21 @@ def project_batch(
     return ColumnarBatch(cols, batch.num_rows)
 
 
-def compile_projection(
-    exprs: Sequence[E.Expression], schema: T.Schema, ansi: bool = False
+def compile_bound_projection(
+    bound: Sequence[E.Expression], ansi: bool = False
 ) -> Callable[[ColumnarBatch], ColumnarBatch]:
-    """Bind + jit a projection. The returned callable is cached by jax per
-    batch capacity bucket."""
-    bound = tuple(bind_projection(exprs, schema))
+    """jit a pre-bound projection (cached by jax per capacity bucket)."""
+    bound = tuple(bound)
 
     @jax.jit
     def run(batch):
         return project_batch(batch, bound, ansi)
 
     return run
+
+
+def compile_projection(
+    exprs: Sequence[E.Expression], schema: T.Schema, ansi: bool = False
+) -> Callable[[ColumnarBatch], ColumnarBatch]:
+    """Bind + jit a projection."""
+    return compile_bound_projection(bind_projection(exprs, schema), ansi)
