@@ -87,6 +87,13 @@ class TpuExec:
         for batch in self.do_execute(partition):
             self.metrics["numOutputBatches"].add(1)
             self._pending_rows.append(batch.num_rows)
+            if len(self._pending_rows) >= 64:
+                # fold into the host counter; the early scalars are long done
+                # by now so this rarely blocks, and it bounds retained buffers
+                self.metrics["numOutputRows"].add(
+                    sum(int(n) for n in self._pending_rows)
+                )
+                self._pending_rows.clear()
             yield batch
 
     def execute_all(self) -> Iterator[ColumnarBatch]:

@@ -134,8 +134,9 @@ class HashJoinExec(BinaryExec):
             probe, build, jh, lkeys, pstr, bstr)
         total = int(total_dev)
         self.metrics["numCandidatePairs"].add(total)
-        # semi/anti/left need a slot per probe row even with zero candidates
-        extra = probe.capacity if self.join_type != "inner" else 0
+        # left/full append unmatched probe rows after the pairs; only they
+        # need the extra probe-capacity headroom
+        extra = probe.capacity if self.join_type in ("left", "full") else 0
         out_cap = bucket_capacity(max(total + extra, 1), 16)
         # exact byte-capacity upper bounds: candidate bytes (+ once-per-probe
         # input bytes for rows appended by left/full outer)

@@ -44,7 +44,9 @@ class CoalesceBatchesExec(UnaryExec):
         pending: List[ColumnarBatch] = []
         rows = 0
         for b in self.child.execute(partition):
-            n = b.row_count()
+            # use static capacity as the row upper bound: no host-device sync
+            # per batch (row_count() would stall async dispatch)
+            n = b.capacity
             if not self.require_single and rows and rows + n > self.target_rows:
                 yield self._flush(pending)
                 pending, rows = [], 0

@@ -12,6 +12,7 @@ in at the mem/ layer; within-HBM sorts here handle one concatenated partition.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Iterator, List, Optional, Sequence
 
 import jax
@@ -22,6 +23,12 @@ from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
 from spark_rapids_tpu.exec import kernels as K
 from spark_rapids_tpu.exec.aggregate import concat_jit
 from spark_rapids_tpu.exprs import expr as E
+
+
+@partial(jax.jit, static_argnums=1)
+def _sort_run(batch: ColumnarBatch, specs):
+    idx = K.sort_indices(batch, specs)
+    return K.gather_batch(batch, idx, batch.num_rows)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,13 +68,10 @@ class SortExec(UnaryExec):
             self._specs.append(
                 K.SortSpec(bound.index, o.ascending, o.nulls_first)
             )
-
-        @jax.jit
-        def run(batch):
-            idx = K.sort_indices(batch, self._specs)
-            return K.gather_batch(batch, idx, batch.num_rows)
-
-        self._run = run
+        specs = tuple(self._specs)
+        # module-level jit + hashable static specs: same-shaped sorts share
+        # one compiled kernel across operator instances
+        self._run = lambda batch: _sort_run(batch, specs)
         self._prepared = True
 
     def node_description(self) -> str:

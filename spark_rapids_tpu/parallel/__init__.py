@@ -1,0 +1,15 @@
+"""Device-mesh parallelism: partition-parallel execution + ICI collectives.
+
+The reference's parallelism inventory (SURVEY.md §2.8): partition-parallel
+tasks, all-to-all shuffle, broadcast. TPU-native mapping: a
+``jax.sharding.Mesh`` over chips, ``shard_map`` for partition-parallel
+operator execution, and ``jax.lax.all_to_all`` over ICI for co-scheduled
+exchange — replacing the reference's UCX/RDMA transport for the in-slice
+case (UCX shuffle: SURVEY.md §2.8; shuffle-plugin/.../UCX.scala).
+"""
+
+from spark_rapids_tpu.parallel.mesh import device_mesh, shard_batch  # noqa: F401
+from spark_rapids_tpu.parallel.exchange import (  # noqa: F401
+    all_to_all_by_key,
+    distributed_agg_step,
+)

@@ -1,0 +1,198 @@
+"""ICI all-to-all exchange + distributed aggregation step.
+
+The TPU-native shuffle for co-scheduled stages: instead of serializing
+batches to host shuffle files (the MULTITHREADED path in shuffle/), a stage
+that fits one mesh runs as a single SPMD program where repartitioning is
+``jax.lax.all_to_all`` over ICI — the role UCX plays in the reference
+(shuffle-plugin/.../UCXShuffleTransport; SURVEY.md §2.8 "TPU-native
+equivalent").
+
+Round-1 scope: fixed-width columns (strings ride the host shuffle path);
+per-target capacity equals local capacity, so the exchange buffer is n_dev x
+local_cap — safe (a device can receive at most every row) but n_dev-times
+oversized; tightening via count-prefixed variable windows is future work,
+mirroring the reference's bounce-buffer windowing (BufferSendState).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec import kernels as K
+
+
+def all_to_all_by_key(cols: Sequence[jax.Array], valids: Sequence[jax.Array],
+                      num_rows: jax.Array, key_hash: jax.Array,
+                      axis: str, n_dev: int):
+    """Inside shard_map: route each live row to device ``hash % n_dev``.
+
+    ``cols``/``valids`` are local (local_cap,) arrays; returns
+    (new_cols, new_valids, new_num_rows) with local capacity n_dev*local_cap,
+    rows front-packed in (source_device, original_order)."""
+    local_cap = cols[0].shape[0]
+    live = jnp.arange(local_cap, dtype=jnp.int32) < num_rows
+    target = (key_hash % jnp.uint64(n_dev)).astype(jnp.int32)
+    # per-target compaction maps
+    idx_rows = []
+    counts = []
+    for t in range(n_dev):
+        idx_t, cnt_t = K.filter_indices(target == t, live)
+        idx_rows.append(idx_t)
+        counts.append(cnt_t)
+    idx = jnp.stack(idx_rows)  # (n_dev, local_cap)
+    cnt = jnp.stack(counts)  # (n_dev,)
+    slot_live = jnp.arange(local_cap, dtype=jnp.int32)[None, :] < cnt[:, None]
+
+    recv_cnt = jax.lax.all_to_all(cnt, axis, 0, 0, tiled=True)  # (n_dev,)
+    out_cols, out_valids = [], []
+    flat_live = None
+    for data, valid in zip(cols, valids):
+        send = jnp.where(slot_live, data[idx], jnp.zeros_like(data)[None, :1])
+        send_v = jnp.where(slot_live, valid[idx], False)
+        recv = jax.lax.all_to_all(send, axis, 0, 0)  # (n_dev, local_cap)
+        recv_v = jax.lax.all_to_all(send_v, axis, 0, 0)
+        if flat_live is None:
+            flat_live = (jnp.arange(local_cap, dtype=jnp.int32)[None, :]
+                         < recv_cnt[:, None]).reshape(-1)
+        out_cols.append(recv.reshape(-1))
+        out_valids.append(recv_v.reshape(-1))
+    # compact received rows to the front
+    cidx, total = K.filter_indices(flat_live, jnp.ones_like(flat_live))
+    row_valid = jnp.arange(flat_live.shape[0], dtype=jnp.int32) < total
+    out_cols = [jnp.where(row_valid, c[cidx], jnp.zeros_like(c[:1]))
+                for c in out_cols]
+    out_valids = [jnp.where(row_valid, v[cidx], False) for v in out_valids]
+    return out_cols, out_valids, total
+
+
+_SEG_OPS = {"sum", "count", "count_all", "min", "max"}
+
+
+def _local_partial_agg(batch: ColumnarBatch, n_keys: int,
+                       ops: Sequence[Tuple[int, str]]) -> ColumnarBatch:
+    """Group local rows, produce keys + one buffer column per op."""
+    cap = batch.capacity
+    if n_keys == 0:
+        gi = K.GroupInfo(jnp.arange(cap, dtype=jnp.int32),
+                         jnp.zeros(cap, jnp.int32), jnp.int32(1),
+                         jnp.zeros(cap, jnp.int32))
+    else:
+        gi = K.group_rows(batch, list(range(n_keys)))
+    active = batch.active_mask()
+    contributing = active[gi.perm]
+    out_valid = jnp.arange(cap, dtype=jnp.int32) < gi.num_groups
+    head_rows = jnp.where(out_valid,
+                          gi.perm[jnp.clip(gi.group_starts, 0, cap - 1)], 0)
+    out_cols: List[DeviceColumn] = [
+        K.gather_column(batch.columns[i], head_rows, out_valid)
+        for i in range(n_keys)
+    ]
+    for col_i, op in ops:
+        assert op in _SEG_OPS, op
+        src = batch.columns[col_i]
+        data, avalid = K.segment_agg(src.data[gi.perm], src.validity[gi.perm],
+                                     contributing, gi.segment_ids, cap, op)
+        out_cols.append(DeviceColumn(
+            T.LONG if op in ("count", "count_all") else src.dtype,
+            jnp.where(out_valid & avalid, data, jnp.zeros_like(data)),
+            avalid & out_valid))
+    return ColumnarBatch(out_cols, gi.num_groups)
+
+
+_MERGE = {"sum": "sum", "count": "sum", "count_all": "sum", "min": "min",
+          "max": "max"}
+
+
+def distributed_agg_step(mesh: Mesh, batch: ColumnarBatch, n_keys: int,
+                         ops: Sequence[Tuple[int, str]], axis: str = "dp"):
+    """One SPMD group-by step: local partial agg -> all-to-all by key hash ->
+    local merge. The compiled program contains the whole pipeline; XLA
+    schedules the ICI collective against compute.
+
+    ``batch`` must be row-sharded over ``mesh`` (parallel.mesh.shard_batch).
+    Returns a row-sharded batch of merged (keys + buffers); each group lives
+    on exactly one device (hash-routed), so concatenating partitions yields
+    the global result without further merging.
+    """
+    n_dev = mesh.devices.size
+    ops = list(ops)
+    n_bufs = len(ops)
+    merge_ops = [(n_keys + i, _MERGE[op]) for i, (_, op) in enumerate(ops)]
+
+    def step(col_datas, col_valids, num_rows):
+        local_cols = [
+            DeviceColumn(c.dtype, d, v)
+            for c, d, v in zip(batch.columns, col_datas, col_valids)
+        ]
+        local = ColumnarBatch(local_cols, num_rows[0])
+        part = _local_partial_agg(local, n_keys, ops)
+        if n_keys == 0:
+            # global agg: tree-reduce buffers with psum/pmin/pmax
+            outs, valids = [], []
+            for (_, op), c in zip(ops, part.columns):
+                red = {"sum": jax.lax.psum, "count": jax.lax.psum,
+                       "count_all": jax.lax.psum,
+                       "min": jax.lax.pmin, "max": jax.lax.pmax}[op]
+                outs.append(red(jnp.where(c.validity, c.data,
+                                          _identity(op, c.data)), axis))
+                valids.append(jax.lax.pmax(
+                    c.validity[: 1].astype(jnp.int32), axis) > 0)
+            # one live row on device 0 only
+            dev = jax.lax.axis_index(axis)
+            n_out = jnp.where(dev == 0, 1, 0).astype(jnp.int32)
+            return (tuple(o for o in outs),
+                    tuple(jnp.broadcast_to(v, o.shape) for v, o in
+                          zip(valids, outs)),
+                    n_out[None])
+        kh = K.hash_keys(part, list(range(n_keys)))
+        datas = [c.data for c in part.columns]
+        vals = [c.validity for c in part.columns]
+        ex_cols, ex_valids, ex_n = all_to_all_by_key(
+            datas, vals, part.num_rows, kh, axis, n_dev)
+        ex_batch = ColumnarBatch(
+            [DeviceColumn(c.dtype, d, v)
+             for c, d, v in zip(part.columns, ex_cols, ex_valids)],
+            ex_n)
+        merged = _local_partial_agg(ex_batch, n_keys, merge_ops)
+        return (tuple(c.data for c in merged.columns),
+                tuple(c.validity for c in merged.columns),
+                merged.num_rows[None])
+
+    spec_cols = tuple(P(axis) for _ in batch.columns)
+    fn = shard_map(
+        step, mesh=mesh,
+        in_specs=(spec_cols, spec_cols, P(axis)),
+        out_specs=(tuple(P(axis) for _ in range(n_keys + n_bufs)),
+                   tuple(P(axis) for _ in range(n_keys + n_bufs)),
+                   P(axis)),
+        check_vma=False,
+    )
+    datas = tuple(c.data for c in batch.columns)
+    valids = tuple(c.validity for c in batch.columns)
+    out_d, out_v, out_n = jax.jit(fn)(datas, valids, batch.num_rows)
+    dtypes = ([batch.columns[i].dtype for i in range(n_keys)]
+              + [T.LONG if op in ("count", "count_all")
+                 else batch.columns[ci].dtype for ci, op in ops])
+    cols = [DeviceColumn(dt, d, v) for dt, d, v in zip(dtypes, out_d, out_v)]
+    return ColumnarBatch(cols, out_n)
+
+
+def _identity(op: str, data: jax.Array):
+    if op in ("sum", "count", "count_all"):
+        return jnp.zeros_like(data)
+    if op == "min":
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            return jnp.full_like(data, jnp.inf)
+        return jnp.full_like(data, jnp.iinfo(data.dtype).max)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return jnp.full_like(data, -jnp.inf)
+    return jnp.full_like(data, jnp.iinfo(data.dtype).min)

@@ -1,0 +1,60 @@
+"""Mesh construction and row-sharded batches.
+
+A query stage runs partition-parallel over the ``dp`` mesh axis: each device
+holds one partition of every batch (rows sharded along axis 0). This is the
+TPU-native analog of Spark executor task parallelism (the reference binds one
+GPU per executor and runs `concurrentGpuTasks` tasks on it; on TPU the mesh
+IS the executor pool and XLA overlaps compute across it).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+
+
+def device_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        assert len(devs) >= n_devices, (
+            f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def shard_batch(batch: ColumnarBatch, mesh: Mesh, axis: str = "dp"
+                ) -> ColumnarBatch:
+    """Shard a batch's rows across the mesh (fixed-width columns only).
+
+    num_rows becomes a per-device vector of local row counts, sharded so each
+    device sees its own count inside shard_map.
+    """
+    n = mesh.devices.size
+    cap = batch.capacity
+    assert cap % n == 0, f"capacity {cap} not divisible by mesh size {n}"
+    row_sharding = NamedSharding(mesh, P(axis))
+    cols: List[DeviceColumn] = []
+    for c in batch.columns:
+        assert c.offsets is None, (
+            "string columns ride the host shuffle path in round 1"
+        )
+        cols.append(DeviceColumn(
+            c.dtype,
+            jax.device_put(c.data, row_sharding),
+            jax.device_put(c.validity, row_sharding),
+        ))
+    # local live-row counts: rows are front-packed globally, so device d holds
+    # clamp(num_rows - d*local_cap, 0, local_cap) live rows
+    local_cap = cap // n
+    total = int(batch.num_rows)
+    counts = np.clip(total - np.arange(n) * local_cap, 0, local_cap)
+    num_rows = jax.device_put(counts.astype(np.int32), row_sharding)
+    return ColumnarBatch(cols, num_rows)
