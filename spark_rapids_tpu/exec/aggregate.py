@@ -401,10 +401,13 @@ class HashAggregateExec(UnaryExec):
 _concat_fn = jax.jit(K.concat_device, static_argnums=(1, 2))
 
 
-def concat_jit(batches: Sequence[ColumnarBatch]) -> ColumnarBatch:
-    """Device concat with capacity bucketing (jit cached per shape combo)."""
-    total = sum(b.capacity for b in batches)
-    out_cap = bucket_capacity(total)
+def concat_jit(batches: Sequence[ColumnarBatch],
+               out_capacity: Optional[int] = None) -> ColumnarBatch:
+    """Device concat with capacity bucketing (jit cached per shape combo).
+
+    ``out_capacity`` may be smaller than the capacity sum when the caller
+    knows the live row total (coalesce compaction)."""
+    out_cap = out_capacity or bucket_capacity(sum(b.capacity for b in batches))
     byte_caps = []
     for ci, c in enumerate(batches[0].columns):
         if c.offsets is not None:

@@ -44,22 +44,26 @@ class CoalesceBatchesExec(UnaryExec):
         pending: List[ColumnarBatch] = []
         rows = 0
         for b in self.child.execute(partition):
-            # use static capacity as the row upper bound: no host-device sync
-            # per batch (row_count() would stall async dispatch)
-            n = b.capacity
+            # coalescing decisions need real row counts (sparse batches keep
+            # their full static capacity); the sync is the price of the
+            # operator, and output capacity shrinks to the live rows below
+            n = b.row_count()
             if not self.require_single and rows and rows + n > self.target_rows:
-                yield self._flush(pending)
+                yield self._flush(pending, rows)
                 pending, rows = [], 0
             pending.append(b)
             rows += n
         if pending:
-            yield self._flush(pending)
+            yield self._flush(pending, rows)
 
-    def _flush(self, pending: List[ColumnarBatch]) -> ColumnarBatch:
-        if len(pending) == 1:
+    def _flush(self, pending: List[ColumnarBatch], rows: int) -> ColumnarBatch:
+        if len(pending) == 1 and pending[0].capacity <= 2 * bucket_capacity(
+                max(rows, 1)):
             return pending[0]
         with self.timer("concatTimeNs"):
-            return concat_jit(pending)
+            # out capacity = bucket of the LIVE rows: also compacts sparse
+            # filter/join outputs (GpuCoalesceBatches sizing behavior)
+            return concat_jit(pending, out_capacity=bucket_capacity(max(rows, 1)))
 
 
 class LocalLimitExec(UnaryExec):

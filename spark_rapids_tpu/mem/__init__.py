@@ -1,0 +1,26 @@
+"""Memory & resilience runtime (SURVEY.md §2.6 — the largest architectural
+delta vs the reference).
+
+The reference hooks RMM's allocation-failure callback (DeviceMemoryEventHandler)
+to spill and retry. XLA owns TPU HBM and offers no such callback, so the same
+capability is built the other way around: every framework-held batch is
+*accounted* in a framework pool (pool.py), operators hold SpillableBatch
+handles instead of raw batches (spill.py), and when accounting exceeds budget
+the pool spills handles device->host->disk and/or throws retryable OOM into
+the retry state machine (retry.py) — same recoverable-OOM design as
+RmmRapidsRetryIterator.scala, different trigger.
+"""
+
+from spark_rapids_tpu.mem.pool import (  # noqa: F401
+    HbmPool,
+    RetryOOM,
+    SplitAndRetryOOM,
+    get_pool,
+    set_pool,
+)
+from spark_rapids_tpu.mem.spill import (  # noqa: F401
+    SpillableBatch,
+    SpillFramework,
+)
+from spark_rapids_tpu.mem.retry import with_retry, with_retry_no_split  # noqa: F401
+from spark_rapids_tpu.mem.semaphore import TaskSemaphore  # noqa: F401

@@ -1,0 +1,165 @@
+"""HBM accounting pool with retryable OOM and test fault injection.
+
+Reference: GpuDeviceManager.scala (RMM pool init, :152-501),
+DeviceMemoryEventHandler.scala:37 (alloc-failure -> spill -> retry
+escalation), RmmSpark OOM injection (jni; used by tests via
+forceRetryOOM/forceSplitAndRetryOOM and RapidsConf.scala:2753 OomInjectionConf).
+
+TPU design: XLA owns physical HBM; this pool tracks the *framework's logical
+footprint* (live accounted batches). `allocate` is called by batch-holding
+code (SpillableBatch registration, operator scratch reservations). On budget
+exhaustion it first asks the spill framework to free accounted bytes
+(device->host->disk cascade), then throws `RetryOOM` — recoverable by design
+via mem.retry, exactly like the reference's GpuRetryOOM path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+
+class RetryOOM(RuntimeError):
+    """Allocation failed but may succeed after spilling/rolling back and
+    retrying the same inputs (reference: GpuRetryOOM)."""
+
+
+class SplitAndRetryOOM(RuntimeError):
+    """Allocation failed and the input must be split before retrying
+    (reference: GpuSplitAndRetryOOM)."""
+
+
+class CpuRetryOOM(RetryOOM):
+    """Host-memory flavor (reference: CpuRetryOOM)."""
+
+
+class OomInjector:
+    """Deterministic OOM injection for tests (RmmSpark.forceRetryOOM analog):
+    after `skip` allocations, throw `count` OOMs of the given kind."""
+
+    def __init__(self, kind: str = "RETRY", skip: int = 0, count: int = 1):
+        assert kind in ("RETRY", "SPLIT")
+        self.kind = kind
+        self.skip = skip
+        self.count = count
+
+    def on_alloc(self) -> None:
+        if self.skip > 0:
+            self.skip -= 1
+            return
+        if self.count > 0:
+            self.count -= 1
+            if self.kind == "RETRY":
+                raise RetryOOM("injected retry OOM")
+            raise SplitAndRetryOOM("injected split-and-retry OOM")
+
+
+class HbmPool:
+    """Thread-safe logical HBM accounting.
+
+    ``spill_fn(bytes_needed) -> bytes_freed`` is installed by the
+    SpillFramework; the pool escalates: spill -> synchronize -> RetryOOM
+    (mirroring OOMRetryState escalation in DeviceMemoryEventHandler:53-105).
+    """
+
+    def __init__(self, limit_bytes: int):
+        self.limit = int(limit_bytes)
+        self._used = 0
+        self._lock = threading.Lock()
+        self._spill_fn: Optional[Callable[[int], int]] = None
+        self._injector: Optional[OomInjector] = None
+        # watermarks (GpuTaskMetrics maxDeviceMemoryBytes analog)
+        self.max_used = 0
+        self.alloc_count = 0
+        self.oom_count = 0
+        self.spill_request_count = 0
+
+    # -- wiring ------------------------------------------------------------
+    def set_spill_fn(self, fn: Optional[Callable[[int], int]]) -> None:
+        self._spill_fn = fn
+
+    def set_injector(self, injector: Optional[OomInjector]) -> None:
+        self._injector = injector
+
+    # -- accounting --------------------------------------------------------
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def free(self) -> int:
+        return self.limit - self._used
+
+    def allocate(self, nbytes: int) -> None:
+        """Account nbytes; spill then raise RetryOOM if over budget."""
+        with self._lock:
+            self.alloc_count += 1
+            if self._injector is not None:
+                self._injector.on_alloc()
+            if self._used + nbytes <= self.limit:
+                self._used += nbytes
+                self.max_used = max(self.max_used, self._used)
+                return
+            needed = self._used + nbytes - self.limit
+        # spill outside the lock (spill does host/disk I/O)
+        freed = 0
+        if self._spill_fn is not None:
+            self.spill_request_count += 1
+            freed = self._spill_fn(needed)
+        with self._lock:
+            if self._used + nbytes <= self.limit:
+                self._used += nbytes
+                self.max_used = max(self.max_used, self._used)
+                return
+            self.oom_count += 1
+            raise RetryOOM(
+                f"HBM pool exhausted: need {nbytes}, used {self._used}, "
+                f"limit {self.limit}, spill freed {freed}")
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._used -= nbytes
+            assert self._used >= 0, "pool accounting underflow"
+
+
+_default_pool: Optional[HbmPool] = None
+_pool_lock = threading.Lock()
+
+
+def _detect_hbm_bytes() -> int:
+    """Best-effort per-chip HBM size; defaults to 16 GiB (v5e class)."""
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        stats = d.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16 << 30
+
+
+def get_pool(conf=None) -> HbmPool:
+    """Process-wide pool; sized from ``conf`` on first call (startup-only,
+    like spark.rapids.memory.gpu.allocFraction in the reference)."""
+    global _default_pool
+    with _pool_lock:
+        if _default_pool is None:
+            from spark_rapids_tpu.config import conf as C
+
+            if conf is None:
+                conf = C.RapidsConf()
+            max_bytes = C.HBM_POOL_BYTES.get(conf)
+            if max_bytes:
+                limit = int(max_bytes)
+            else:
+                limit = int(_detect_hbm_bytes() * C.HBM_POOL_FRACTION.get(conf))
+            _default_pool = HbmPool(limit)
+        return _default_pool
+
+
+def set_pool(pool: Optional[HbmPool]) -> None:
+    global _default_pool
+    with _pool_lock:
+        _default_pool = pool

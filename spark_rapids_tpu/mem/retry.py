@@ -1,0 +1,111 @@
+"""OOM retry / split-and-retry state machine.
+
+Reference: RmmRapidsRetryIterator.scala:33-197 — `withRetry` wraps operator
+code over spillable inputs; `GpuRetryOOM` rolls back and retries the same
+input after spilling; `GpuSplitAndRetryOOM` splits the input (usually in
+half by rows) and retries the pieces; attempts are bounded.
+
+The TPU pool raises the same exceptions from accounting (mem/pool.py), and
+the split is a device kernel (halve rows by gather).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, List, Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec import kernels as K
+from spark_rapids_tpu.mem.pool import RetryOOM, SplitAndRetryOOM
+from spark_rapids_tpu.mem.spill import SpillableBatch, SpillFramework
+
+A = TypeVar("A")
+B = TypeVar("B")
+
+DEFAULT_MAX_ATTEMPTS = 32
+
+
+def split_batch_half(batch: ColumnarBatch) -> List[ColumnarBatch]:
+    """Split a batch into two halves by row (the default splitter; reference:
+    RmmRapidsRetryIterator splitSpillableInHalfByRows)."""
+    n = int(batch.num_rows)
+    if n <= 1:
+        raise SplitAndRetryOOM("cannot split a single-row batch further")
+    k = n // 2
+    cap = batch.capacity
+    first = _take_range(batch, 0, k, cap)
+    second = _take_range(batch, k, n - k, cap)
+    return [first, second]
+
+
+def _take_range(batch: ColumnarBatch, start: int, count: int, cap: int
+                ) -> ColumnarBatch:
+    idx = jnp.arange(cap, dtype=jnp.int32) + start
+    return K.gather_batch(batch, jnp.clip(idx, 0, cap - 1), jnp.int32(count))
+
+
+def with_retry(
+    inputs: Iterable[SpillableBatch],
+    fn: Callable[[ColumnarBatch], B],
+    framework: Optional[SpillFramework] = None,
+    split_fn: Callable[[ColumnarBatch], List[ColumnarBatch]] = split_batch_half,
+    max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+) -> Iterator[B]:
+    """Run ``fn`` over spillable inputs with OOM retry/split-retry.
+
+    Inputs are SpillableBatch handles; each is materialized (pinned) only for
+    the duration of its attempt, so peers stay spillable while one is being
+    processed — the core trick of the reference's design.
+    """
+    for handle in inputs:
+        work: List[object] = [handle]  # SpillableBatch | ColumnarBatch
+        while work:
+            item = work.pop(0)
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    if isinstance(item, SpillableBatch):
+                        with item as batch:
+                            result = fn(batch)
+                        item.close()
+                    else:
+                        result = fn(item)
+                    yield result
+                    break
+                except SplitAndRetryOOM:
+                    if isinstance(item, SpillableBatch):
+                        with item as batch:
+                            pieces = split_fn(batch)
+                        item.close()
+                    else:
+                        pieces = split_fn(item)
+                    # wrap pieces as spillable so the pending half can spill
+                    if framework is not None:
+                        pieces = [SpillableBatch(p, framework) for p in pieces]
+                    work = list(pieces) + work
+                    item = work.pop(0)
+                    attempts = 0
+                except RetryOOM:
+                    if attempts >= max_attempts:
+                        raise
+                    # the pool already spilled what it could; loop retries
+                    # the same input (it re-materializes on get())
+                    continue
+
+
+def with_retry_no_split(
+    inputs: Iterable[SpillableBatch],
+    fn: Callable[[ColumnarBatch], B],
+    max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+) -> Iterator[B]:
+    """Retry-only wrapper for code that cannot handle split inputs
+    (reference: withRetryNoSplit)."""
+
+    def no_split(batch: ColumnarBatch) -> List[ColumnarBatch]:
+        raise SplitAndRetryOOM("operation does not support split-retry")
+
+    yield from with_retry(inputs, fn, framework=None, split_fn=no_split,
+                          max_attempts=max_attempts)

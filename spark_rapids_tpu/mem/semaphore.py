@@ -1,0 +1,75 @@
+"""Device concurrency governor.
+
+Reference: GpuSemaphore.scala:100-120 — limits tasks concurrently holding the
+GPU (spark.rapids.sql.concurrentGpuTasks), with priority given to the
+longest-waiting task (PrioritySemaphore). Same role here for a TPU chip:
+scan/shuffle host work runs unthrottled; device compute sections acquire.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class TaskSemaphore:
+    """Priority semaphore: FIFO by first-wait time (longest waiting first)."""
+
+    def __init__(self, permits: int = 2):
+        self._permits = permits
+        self._cv = threading.Condition()
+        self._waiters: Dict[int, float] = {}  # task_id -> first wait time
+        self._holders: Dict[int, int] = {}  # task_id -> acquire count
+        self.total_wait_ns = 0
+        self.max_waiters = 0
+
+    def acquire(self, task_id: int) -> None:
+        t0 = time.perf_counter_ns()
+        with self._cv:
+            if task_id in self._holders:  # reentrant per task
+                self._holders[task_id] += 1
+                return
+            self._waiters.setdefault(task_id, t0)
+            self.max_waiters = max(self.max_waiters, len(self._waiters))
+            while not self._may_enter(task_id):
+                self._cv.wait()
+            del self._waiters[task_id]
+            self._holders[task_id] = 1
+            self.total_wait_ns += time.perf_counter_ns() - t0
+
+    def _may_enter(self, task_id: int) -> bool:
+        if len(self._holders) >= self._permits:
+            return False
+        # longest-waiting first (priority by first-wait timestamp)
+        oldest = min(self._waiters, key=self._waiters.get)
+        return oldest == task_id or len(self._holders) + len(self._waiters) <= self._permits
+
+    def release(self, task_id: int) -> None:
+        with self._cv:
+            if task_id not in self._holders:
+                return
+            self._holders[task_id] -= 1
+            if self._holders[task_id] <= 0:
+                del self._holders[task_id]
+                self._cv.notify_all()
+
+    def held_by(self, task_id: int) -> bool:
+        with self._cv:
+            return task_id in self._holders
+
+    class _Ctx:
+        def __init__(self, sem: "TaskSemaphore", task_id: int):
+            self.sem = sem
+            self.task_id = task_id
+
+        def __enter__(self):
+            self.sem.acquire(self.task_id)
+            return self
+
+        def __exit__(self, *exc):
+            self.sem.release(self.task_id)
+            return False
+
+    def held(self, task_id: int) -> "TaskSemaphore._Ctx":
+        return TaskSemaphore._Ctx(self, task_id)

@@ -1,0 +1,262 @@
+"""Spill framework: device -> host -> disk cascade over batch handles.
+
+Reference: spill/SpillFramework.scala (1742 LoC; design comment :47-151):
+stores own *handles*; a handle is spillable while no one holds a reference
+to its materialized form; spill never blocks the whole store (I/O happens
+outside store locks); disk tier via block files.
+
+TPU adaptation: "device buffer" is a jax Array pytree (the ColumnarBatch);
+spilling to host = np.asarray snapshot + dropping the device reference
+(XLA frees HBM when the last reference dies); disk = arrow IPC file. The
+host tier has its own budget and cascades to disk, like SpillableHostStore.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.mem.pool import HbmPool
+
+DEVICE, HOST, DISK = "DEVICE", "HOST", "DISK"
+
+
+class SpillableBatch:
+    """Handle for a batch that can move between memory tiers.
+
+    Operators hold these instead of raw batches (reference:
+    SpillableColumnarBatch.scala) so that everything in-flight is spillable.
+    ``get()`` materializes on device (re-accounting in the pool) and pins the
+    handle (unspillable) until ``unpin()``; ``close()`` releases everything.
+    """
+
+    def __init__(self, batch: ColumnarBatch, framework: "SpillFramework"):
+        self._fw = framework
+        self._state = DEVICE
+        self._device: Optional[ColumnarBatch] = batch
+        self._host: Optional[dict] = None
+        self._disk_path: Optional[str] = None
+        self._dtypes = [c.dtype for c in batch.columns]
+        self._nbytes = batch.nbytes() + 4
+        self._pins = 0
+        self._closed = False
+        self._lock = threading.RLock()
+        self._mat_lock = threading.Lock()  # serializes concurrent unspills
+        framework._register(self)
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def spillable(self) -> bool:
+        with self._lock:
+            return self._state == DEVICE and self._pins == 0 and not self._closed
+
+    # -- materialize -------------------------------------------------------
+    def get(self) -> ColumnarBatch:
+        """Materialize on device and pin until unpin()."""
+        with self._lock:
+            assert not self._closed
+            self._pins += 1
+            if self._state == DEVICE:
+                return self._device
+        # unspill outside the handle lock (does I/O + pool accounting); if it
+        # fails (e.g. RetryOOM from the pool) the pin MUST be released or the
+        # handle becomes permanently unspillable
+        try:
+            self._fw._unspill(self)
+        except BaseException:
+            self.unpin()
+            raise
+        with self._lock:
+            assert self._state == DEVICE
+            return self._device
+
+    def unpin(self) -> None:
+        with self._lock:
+            self._pins -= 1
+            assert self._pins >= 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            state = self._state
+            self._device = None
+            self._host = None
+        self._fw._deregister(self, state)
+        if self._disk_path and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)
+
+    def __enter__(self):
+        return self.get()
+
+    def __exit__(self, *exc):
+        self.unpin()
+        return False
+
+
+class SpillFramework:
+    """Owns the tier stores and the pool spill callback."""
+
+    def __init__(self, pool: HbmPool, host_limit_bytes: int = 8 << 30,
+                 spill_dir: str = "/tmp/srtpu_spill"):
+        self.pool = pool
+        self.host_limit = host_limit_bytes
+        self.host_used = 0
+        self.spill_dir = spill_dir
+        self._handles: List[SpillableBatch] = []
+        self._lock = threading.Lock()
+        self.spilled_to_host_count = 0
+        self.spilled_to_disk_count = 0
+        self.unspilled_count = 0
+        pool.set_spill_fn(self.spill_device_bytes)
+
+    # -- registration ------------------------------------------------------
+    def _register(self, h: SpillableBatch) -> None:
+        self.pool.allocate(h.nbytes)
+        with self._lock:
+            self._handles.append(h)
+
+    def _deregister(self, h: SpillableBatch, state: str) -> None:
+        with self._lock:
+            if h in self._handles:
+                self._handles.remove(h)
+        if state == DEVICE:
+            self.pool.release(h.nbytes)
+        elif state == HOST:
+            with self._lock:
+                self.host_used -= h.nbytes
+
+    # -- spill cascade -----------------------------------------------------
+    def spill_device_bytes(self, needed: int) -> int:
+        """Pool callback: spill oldest spillable device handles to host/disk
+        until `needed` accounted bytes are freed."""
+        freed = 0
+        while freed < needed:
+            with self._lock:
+                victim = next((h for h in self._handles if h.spillable()), None)
+            if victim is None:
+                break
+            freed += self._spill_one(victim)
+        return freed
+
+    def _spill_one(self, h: SpillableBatch) -> int:
+        with h._lock:
+            if not h.spillable():
+                return 0
+            batch = h._device
+            # device -> host snapshot
+            host = {
+                "num_rows": int(batch.num_rows),
+                "cols": [
+                    (np.asarray(c.data), np.asarray(c.validity),
+                     None if c.offsets is None else np.asarray(c.offsets))
+                    for c in batch.columns
+                ],
+            }
+            h._device = None
+            h._host = host
+            h._state = HOST
+        self.pool.release(h.nbytes)
+        self.spilled_to_host_count += 1
+        with self._lock:
+            self.host_used += h.nbytes
+            over = self.host_used - self.host_limit
+        if over > 0:
+            self._cascade_to_disk(over)
+        return h.nbytes
+
+    def _cascade_to_disk(self, needed: int) -> None:
+        freed = 0
+        while freed < needed:
+            with self._lock:
+                # pinned handles are mid-materialization (get() in flight):
+                # stealing their host copy would corrupt accounting
+                victim = next(
+                    (h for h in self._handles
+                     if h._state == HOST and h._pins == 0), None)
+            if victim is None:
+                return
+            freed += self._host_to_disk(victim)
+
+    def _host_to_disk(self, h: SpillableBatch) -> int:
+        with h._lock:
+            if h._state != HOST or h._pins > 0:
+                return 0
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, f"{uuid.uuid4().hex}.spill.npz")
+            cols = h._host["cols"]
+            arrays = {"num_rows": np.int64(h._host["num_rows"]),
+                      "ncols": np.int64(len(cols))}
+            for i, (data, valid, offsets) in enumerate(cols):
+                arrays[f"d{i}"] = data
+                arrays[f"v{i}"] = valid
+                if offsets is not None:
+                    arrays[f"o{i}"] = offsets
+            with open(path, "wb") as f:
+                np.savez(f, **arrays)
+            h._host = None
+            h._disk_path = path
+            h._state = DISK
+        self.spilled_to_disk_count += 1
+        with self._lock:
+            self.host_used -= h.nbytes
+        return h.nbytes
+
+    # -- unspill -----------------------------------------------------------
+    def _unspill(self, h: SpillableBatch) -> None:
+        import jax.numpy as jnp
+
+        with h._mat_lock:  # a concurrent get() may have already materialized
+            with h._lock:
+                if h._state == DEVICE:
+                    return
+                if h._state == DISK:
+                    self._disk_to_host_locked(h)
+                assert h._state == HOST
+                host = h._host
+            # account device bytes BEFORE materializing (may itself spill
+            # others; the handle is pinned so it cannot become its own victim)
+            self.pool.allocate(h.nbytes)
+            cols = [
+                DeviceColumn(dt, jnp.asarray(d), jnp.asarray(v),
+                             None if o is None else jnp.asarray(o))
+                for dt, (d, v, o) in zip(h._dtypes, host["cols"])
+            ]
+            batch = ColumnarBatch(cols, jnp.int32(host["num_rows"]))
+            with h._lock:
+                h._device = batch
+                h._host = None
+                h._state = DEVICE
+            with self._lock:
+                self.host_used -= h.nbytes
+            self.unspilled_count += 1
+
+    def _disk_to_host_locked(self, h: SpillableBatch) -> None:
+        with np.load(h._disk_path) as z:
+            num_rows = int(z["num_rows"])
+            ncols = int(z["ncols"])
+            cols = [
+                (z[f"d{i}"], z[f"v{i}"],
+                 z[f"o{i}"] if f"o{i}" in z.files else None)
+                for i in range(ncols)
+            ]
+        os.unlink(h._disk_path)
+        h._disk_path = None
+        h._host = {"num_rows": num_rows, "cols": cols}
+        h._state = HOST
+        with self._lock:
+            self.host_used += h.nbytes
