@@ -1,0 +1,25 @@
+"""Columnar shuffle (SURVEY.md §2.8, L7).
+
+Three modes, mirroring RapidsShuffleManagerMode (RapidsConf.scala:1767):
+- MULTITHREADED (default): device-partitioned batches are serialized to a
+  kudo-style host wire format by a thread pool and written to local shuffle
+  files with a partition index; readers fetch + concat on host and upload
+  once (GpuShuffleCoalesceExec pattern). Works everywhere.
+- ICI: co-scheduled stages exchange over the device mesh with
+  jax.lax.all_to_all (parallel/exchange.py) — the UCX analog.
+- CACHE_ONLY: partitions stay as device batches in-process (tests, local
+  mode; the analog of the reference's GPU-resident RapidsCachingWriter).
+"""
+
+from spark_rapids_tpu.shuffle.partition import (  # noqa: F401
+    HashPartitioner,
+    RangePartitioner,
+    RoundRobinPartitioner,
+    SinglePartitioner,
+)
+from spark_rapids_tpu.shuffle.serializer import (  # noqa: F401
+    deserialize_table,
+    serialize_batch,
+)
+from spark_rapids_tpu.shuffle.manager import ShuffleManager  # noqa: F401
+from spark_rapids_tpu.shuffle.exchange_exec import ShuffleExchangeExec  # noqa: F401

@@ -1,0 +1,69 @@
+"""Shuffle exchange operator.
+
+Reference: GpuShuffleExchangeExecBase.scala:329 (write side:
+prepareBatchShuffleDependency -> GpuPartitioning slice -> serializer) and
+GpuShuffleCoalesceExec.scala:49 (read side: host-concat serialized tables to
+target size, upload once).
+
+Execution model: the exchange materializes all map outputs on first read
+(stage boundary, like Spark), then each output partition reads+merges its
+blocks and uploads one device batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, batch_from_arrow
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+from spark_rapids_tpu.shuffle.manager import ShuffleManager, get_manager
+from spark_rapids_tpu.shuffle.partition import Partitioner
+
+
+class ShuffleExchangeExec(UnaryExec):
+    def __init__(self, partitioner: Partitioner, child: TpuExec,
+                 manager: Optional[ShuffleManager] = None,
+                 target_batch_rows: int = 1 << 20):
+        super().__init__(child)
+        self.partitioner = partitioner
+        self.manager = manager or get_manager()
+        self.target_batch_rows = target_batch_rows
+        self._reg = None
+        self._written = False
+        self._write_lock = threading.Lock()
+        self._register_metric("writeTimeNs")
+        self._register_metric("readTimeNs")
+
+    def num_partitions(self) -> int:
+        return self.partitioner.num_partitions
+
+    def node_description(self) -> str:
+        return (f"TpuShuffleExchange {type(self.partitioner).__name__}"
+                f"({self.partitioner.num_partitions})")
+
+    def _ensure_written(self) -> None:
+        with self._write_lock:
+            if self._written:
+                return
+            self._reg = self.manager.register(
+                self.child.output_schema, self.partitioner.num_partitions)
+            with self.timer("writeTimeNs"):
+                for p in range(self.child.num_partitions()):
+                    batches = list(self.child.execute(p))
+                    if batches:
+                        self.manager.write_map_output(
+                            self._reg, self.partitioner, batches)
+            self._written = True
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        self._ensure_written()
+        with self.timer("readTimeNs"):
+            table = self.manager.read_partition(self._reg, partition)
+        if table is None or table.num_rows == 0:
+            return
+        # re-chunk to target batch size, one upload per chunk
+        for start in range(0, table.num_rows, self.target_batch_rows):
+            chunk = table.slice(start, self.target_batch_rows)
+            yield batch_from_arrow(chunk)

@@ -1,0 +1,160 @@
+"""Multithreaded shuffle manager: per-map-task files with partition index.
+
+Reference: RapidsShuffleInternalManagerBase.scala — MULTITHREADED mode
+(RapidsShuffleThreadedWriterBase:237-291 slot-model writer pool,
+RapidsShuffleThreadedReaderBase:574 reader pool) writing standard Spark
+shuffle files. Same file layout idea here: one data file per (shuffle, map)
+plus an in-memory index of partition offsets; a threadpool serializes
+partition slices concurrently (the "slots"), and readers fetch blocks for a
+reduce partition across all map outputs.
+
+CACHE_ONLY mode keeps serialized blocks in memory (tests/local mode, and the
+moral analog of the reference's GPU-resident cache for in-process reuse).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import threading
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.shuffle.partition import Partitioner
+from spark_rapids_tpu.shuffle.serializer import merge_tables, serialize_table
+
+
+class _MapOutput:
+    __slots__ = ("path", "index", "cached")
+
+    def __init__(self, path: Optional[str], index: Dict[int, Tuple[int, int]],
+                 cached: Optional[Dict[int, bytes]]):
+        self.path = path
+        self.index = index  # partition -> (offset, length)
+        self.cached = cached
+
+
+class ShuffleRegistration:
+    def __init__(self, shuffle_id: int, schema: T.Schema, n_reduce: int):
+        self.shuffle_id = shuffle_id
+        self.schema = schema
+        self.n_reduce = n_reduce
+        self.map_outputs: List[_MapOutput] = []
+        self.lock = threading.Lock()
+
+
+class ShuffleManager:
+    """Process-wide shuffle service (driver+executor in one for local mode;
+    the DCN block service generalizes this across hosts)."""
+
+    def __init__(self, local_dir: str = "/tmp/srtpu_shuffle",
+                 writer_threads: int = 4, reader_threads: int = 4,
+                 codec: str = "none", cache_only: bool = False):
+        self.local_dir = local_dir
+        self.codec = codec
+        self.cache_only = cache_only
+        self._regs: Dict[int, ShuffleRegistration] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._write_pool = cf.ThreadPoolExecutor(writer_threads)
+        self._read_pool = cf.ThreadPoolExecutor(reader_threads)
+        self.bytes_written = 0
+        self.blocks_written = 0
+
+    def register(self, schema: T.Schema, n_reduce: int) -> ShuffleRegistration:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            reg = ShuffleRegistration(sid, schema, n_reduce)
+            self._regs[sid] = reg
+            return reg
+
+    # -- write side --------------------------------------------------------
+    def write_map_output(self, reg: ShuffleRegistration,
+                         partitioner: Partitioner,
+                         batches: List[ColumnarBatch]) -> None:
+        """One map task: partition every batch on device, serialize slices in
+        the writer pool, write one data file (or cache blocks in memory)."""
+        per_part: Dict[int, List[pa.Table]] = {}
+        for b in batches:
+            for pid, tbl in partitioner.split(b, reg.schema):
+                per_part.setdefault(pid, []).append(tbl)
+
+        def ser(item):
+            pid, tables = item
+            t = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+            return pid, serialize_table(t, self.codec)
+
+        blocks = list(self._write_pool.map(ser, sorted(per_part.items())))
+        index: Dict[int, Tuple[int, int]] = {}
+        if self.cache_only:
+            cached = {pid: blob for pid, blob in blocks}
+            out = _MapOutput(None, index, cached)
+        else:
+            os.makedirs(self.local_dir, exist_ok=True)
+            path = os.path.join(
+                self.local_dir, f"shuffle_{reg.shuffle_id}_{uuid.uuid4().hex}.data")
+            off = 0
+            with open(path, "wb") as f:
+                for pid, blob in blocks:
+                    f.write(blob)
+                    index[pid] = (off, len(blob))
+                    off += len(blob)
+            self.bytes_written += off
+            out = _MapOutput(path, index, None)
+        self.blocks_written += len(blocks)
+        with reg.lock:
+            reg.map_outputs.append(out)
+
+    # -- read side ---------------------------------------------------------
+    def read_partition(self, reg: ShuffleRegistration,
+                       partition: int) -> Optional[pa.Table]:
+        """Fetch partition blocks from all map outputs (reader pool) and
+        host-merge them into one arrow table (single upload by the caller)."""
+
+        def fetch(mo: _MapOutput) -> Optional[bytes]:
+            if mo.cached is not None:
+                return mo.cached.get(partition)
+            loc = mo.index.get(partition)
+            if loc is None:
+                return None
+            with open(mo.path, "rb") as f:
+                f.seek(loc[0])
+                return f.read(loc[1])
+
+        with reg.lock:
+            outputs = list(reg.map_outputs)
+        blocks = [b for b in self._read_pool.map(fetch, outputs)
+                  if b is not None]
+        return merge_tables(blocks, reg.schema)
+
+    def cleanup(self, reg: ShuffleRegistration) -> None:
+        with reg.lock:
+            for mo in reg.map_outputs:
+                if mo.path and os.path.exists(mo.path):
+                    os.unlink(mo.path)
+            reg.map_outputs.clear()
+        with self._lock:
+            self._regs.pop(reg.shuffle_id, None)
+
+
+_default_manager: Optional[ShuffleManager] = None
+_mgr_lock = threading.Lock()
+
+
+def get_manager() -> ShuffleManager:
+    global _default_manager
+    with _mgr_lock:
+        if _default_manager is None:
+            _default_manager = ShuffleManager()
+        return _default_manager
+
+
+def set_manager(m: Optional[ShuffleManager]) -> None:
+    global _default_manager
+    with _mgr_lock:
+        _default_manager = m

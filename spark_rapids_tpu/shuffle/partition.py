@@ -1,0 +1,162 @@
+"""Device-side partitioning for shuffle writes.
+
+Reference: GpuPartitioning.scala:64-72 — hash computed on GPU, then one
+contiguousSplit slices the batch into N partition tables.
+
+TPU design: partition ids are computed on device, rows are sorted by
+partition id (one fused kernel), per-partition counts come back with the
+sorted batch in one transfer, and the host slices the arrow form — the
+shuffle write path is host-bound anyway (it's about to serialize), so the
+device does exactly one sort-gather pass.
+"""
+
+from __future__ import annotations
+
+from functools import partial as _partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, batch_to_arrow
+from spark_rapids_tpu.exec import kernels as K
+
+
+class Partitioner:
+    num_partitions: int = 1
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        """Traced: per-row target partition in [0, num_partitions)."""
+        raise NotImplementedError
+
+    def split(self, batch: ColumnarBatch, schema: T.Schema
+              ) -> List[Tuple[int, pa.Table]]:
+        """Device sort by partition + host slice. Returns non-empty
+        (partition_id, arrow_table) pairs."""
+        sorted_batch, counts = _sort_by_partition(
+            batch, self, self.num_partitions)
+        counts = np.asarray(counts)
+        table = batch_to_arrow(sorted_batch, schema)
+        out = []
+        start = 0
+        for p in range(self.num_partitions):
+            c = int(counts[p])
+            if c > 0:
+                out.append((p, table.slice(start, c)))
+            start += c
+        return out
+
+
+@_partial(jax.jit, static_argnums=(1, 2))
+def _sort_by_partition(batch: ColumnarBatch, partitioner: "Partitioner",
+                       n_parts: int):
+    pid = partitioner.partition_ids(batch)
+    active = batch.active_mask()
+    pid = jnp.where(active, pid, n_parts)  # padding rows sort last
+    order = jnp.argsort(pid, stable=True).astype(jnp.int32)
+    sorted_batch = K.gather_batch(batch, order, batch.num_rows)
+    counts = jax.ops.segment_sum(
+        jnp.where(active, 1, 0), jnp.clip(pid, 0, n_parts),
+        num_segments=n_parts + 1)[:n_parts]
+    return sorted_batch, counts
+
+
+class HashPartitioner(Partitioner):
+    """Hash of key columns mod n (GpuHashPartitioningBase analog; the hash is
+    the engine's 64-bit mixed hash, null keys -> partition of the null
+    constant, matching Spark's null-goes-to-one-partition behavior)."""
+
+    def __init__(self, key_cols: Sequence[int], num_partitions: int):
+        self.key_cols = tuple(key_cols)
+        self.num_partitions = num_partitions
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.key_cols, self.num_partitions))
+
+    def __eq__(self, other):
+        return (type(other) is HashPartitioner
+                and other.key_cols == self.key_cols
+                and other.num_partitions == self.num_partitions)
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        h = K.hash_keys(batch, list(self.key_cols))
+        return (h % jnp.uint64(self.num_partitions)).astype(jnp.int32)
+
+
+class RoundRobinPartitioner(Partitioner):
+    def __init__(self, num_partitions: int, start: int = 0):
+        self.num_partitions = num_partitions
+        self.start = start
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.num_partitions, self.start))
+
+    def __eq__(self, other):
+        return (type(other) is RoundRobinPartitioner
+                and other.num_partitions == self.num_partitions
+                and other.start == self.start)
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        i = jnp.arange(batch.capacity, dtype=jnp.int32)
+        return (i + self.start) % self.num_partitions
+
+
+class SinglePartitioner(Partitioner):
+    num_partitions = 1
+
+    def __hash__(self):
+        return hash(type(self).__name__)
+
+    def __eq__(self, other):
+        return type(other) is SinglePartitioner
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        return jnp.zeros(batch.capacity, jnp.int32)
+
+
+class RangePartitioner(Partitioner):
+    """Boundary-based range partitioning for global sort
+    (GpuRangePartitioner analog: sample-based bounds computed by the plan
+    layer, then a device searchsorted per row).
+
+    Round-1 scope: single numeric/date/timestamp sort key, ascending.
+    """
+
+    def __init__(self, bounds: np.ndarray, key_col: int,
+                 ascending: bool = True):
+        self.bounds = np.asarray(bounds)
+        self.key_col = key_col
+        self.ascending = ascending
+        self.num_partitions = len(self.bounds) + 1
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.key_col, self.ascending,
+                     self.bounds.tobytes()))
+
+    def __eq__(self, other):
+        return (type(other) is RangePartitioner
+                and other.key_col == self.key_col
+                and other.ascending == self.ascending
+                and np.array_equal(other.bounds, self.bounds))
+
+    def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
+        col = batch.columns[self.key_col]
+        data = col.data
+        if not self.ascending:
+            data = -data
+        pid = jnp.searchsorted(
+            jnp.asarray(self.bounds), data, side="right").astype(jnp.int32)
+        # nulls first: partition 0
+        return jnp.where(col.validity, pid, 0)
+
+    @staticmethod
+    def from_sample(values: np.ndarray, num_partitions: int,
+                    key_col: int, ascending: bool = True) -> "RangePartitioner":
+        qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
+        bounds = np.quantile(values, qs) if len(values) else np.zeros(0)
+        if not ascending:
+            bounds = -bounds[::-1]
+        return RangePartitioner(np.asarray(bounds), key_col, ascending)

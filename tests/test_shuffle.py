@@ -1,0 +1,159 @@
+"""Shuffle tests: serializer roundtrip/merge, partitioners, end-to-end
+shuffled queries (hash-partitioned aggregation, range-partitioned sort).
+
+Mirrors the reference's shuffle suites run without a cluster (SURVEY.md §4:
+RapidsShuffleClientSuite et al. test the protocol against mocks; here the
+manager runs both sides in-process)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.exec import (
+    BatchSourceExec, HashAggregateExec, SortExec, SortOrder,
+)
+from spark_rapids_tpu.exprs.expr import Count, Sum, col
+from spark_rapids_tpu.shuffle import (
+    HashPartitioner,
+    RangePartitioner,
+    RoundRobinPartitioner,
+    ShuffleExchangeExec,
+    SinglePartitioner,
+)
+from spark_rapids_tpu.shuffle.manager import ShuffleManager
+from spark_rapids_tpu.shuffle.serializer import (
+    deserialize_table, merge_tables, serialize_table,
+)
+
+
+def table_rand(n, seed=0, with_strings=True, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "k": pa.array(rng.integers(0, 23, n), pa.int64()),
+        "f": pa.array(rng.random(n) * 100, pa.float64()),
+    }
+    if with_strings:
+        s = [None if (with_nulls and i % 13 == 0) else f"val{i % 41}"
+             for i in range(n)]
+        cols["s"] = pa.array(s, pa.string())
+    return pa.table(cols)
+
+
+@pytest.mark.parametrize("codec", ["none", "zlib"])
+def test_serializer_roundtrip(codec):
+    t = table_rand(500, seed=3)
+    schema = T.Schema.from_arrow(t.schema)
+    wire = serialize_table(t, codec)
+    back, pos = deserialize_table(wire, schema)
+    assert pos == len(wire)
+    assert back.to_pylist() == t.to_pylist()
+
+
+def test_serializer_roundtrip_dates_decimals():
+    import decimal as d
+    t = pa.table({
+        "d": pa.array([0, 9000, None], pa.int32()).cast(pa.date32()),
+        "ts": pa.array([0, 123456789, None], pa.int64()).cast(
+            pa.timestamp("us", tz="UTC")),
+        "dec": pa.array([d.Decimal("1.23"), None, d.Decimal("-99.99")],
+                        pa.decimal128(9, 2)),
+        "b": pa.array([True, None, False], pa.bool_()),
+    })
+    schema = T.Schema.from_arrow(t.schema)
+    wire = serialize_table(t)
+    back, _ = deserialize_table(wire, schema)
+    assert back.to_pylist() == t.to_pylist()
+
+
+def test_merge_tables():
+    t1 = table_rand(100, seed=1)
+    t2 = table_rand(50, seed=2)
+    schema = T.Schema.from_arrow(t1.schema)
+    merged = merge_tables([serialize_table(t1) + serialize_table(t2)], schema)
+    assert merged.to_pylist() == t1.to_pylist() + t2.to_pylist()
+
+
+def test_hash_partitioner_split():
+    t = table_rand(300, seed=5)
+    schema = T.Schema.from_arrow(t.schema)
+    b = batch_from_arrow(t, min_bucket=16)
+    parts = HashPartitioner([0], 7).split(b, schema)
+    all_rows = [r for _, tbl in parts for r in tbl.to_pylist()]
+    assert sorted(map(repr, all_rows)) == sorted(map(repr, t.to_pylist()))
+    # same key always lands in the same partition
+    key_to_pid = {}
+    for pid, tbl in parts:
+        for r in tbl.to_pylist():
+            assert key_to_pid.setdefault(r["k"], pid) == pid
+
+
+def test_round_robin_and_single():
+    t = table_rand(64, seed=6, with_strings=False)
+    schema = T.Schema.from_arrow(t.schema)
+    b = batch_from_arrow(t, min_bucket=16)
+    parts = RoundRobinPartitioner(4).split(b, schema)
+    sizes = {pid: tbl.num_rows for pid, tbl in parts}
+    assert sizes == {0: 16, 1: 16, 2: 16, 3: 16}
+    [(pid, tbl)] = SinglePartitioner().split(b, schema)
+    assert pid == 0 and tbl.num_rows == 64
+
+
+@pytest.mark.parametrize("cache_only", [False, True])
+def test_shuffled_aggregation(tmp_path, cache_only):
+    """partial agg -> hash shuffle -> final agg == single-node result."""
+    rng = np.random.default_rng(8)
+    n = 5000
+    keys = rng.integers(0, 97, n)
+    vals = rng.integers(-1000, 1000, n)
+    t = pa.table({"k": pa.array(keys, pa.int64()),
+                  "v": pa.array(vals, pa.int64())})
+    schema = T.Schema.from_arrow(t.schema)
+    # two map partitions
+    batches = [
+        [batch_from_arrow(t.slice(0, 2500), min_bucket=512)],
+        [batch_from_arrow(t.slice(2500), min_bucket=512)],
+    ]
+    src = BatchSourceExec(batches, schema)
+    partial = HashAggregateExec([col("k")],
+                                [Sum(col("v")).alias("s"),
+                                 Count(col("v")).alias("c")],
+                                src, mode="partial")
+    mgr = ShuffleManager(local_dir=str(tmp_path), cache_only=cache_only,
+                         codec="zlib")
+    shuffled = ShuffleExchangeExec(HashPartitioner([0], 5), partial,
+                                   manager=mgr)
+    final = HashAggregateExec.final_from_partial(partial, shuffled)
+    got = {}
+    for p in range(final.num_partitions()):
+        for b in final.execute(p):
+            for r in batch_to_arrow(b, final.output_schema).to_pylist():
+                assert r["k"] not in got
+                got[r["k"]] = (r["s"], r["c"])
+    expected = {}
+    for k, v in zip(keys, vals):
+        s, c = expected.get(int(k), (0, 0))
+        expected[int(k)] = (s + int(v), c + 1)
+    assert got == expected
+    assert final.num_partitions() == 5
+
+
+def test_range_partitioned_global_sort(tmp_path):
+    rng = np.random.default_rng(9)
+    vals = rng.integers(-10000, 10000, 3000)
+    t = pa.table({"x": pa.array(vals, pa.int64())})
+    schema = T.Schema.from_arrow(t.schema)
+    src = BatchSourceExec(
+        [[batch_from_arrow(t.slice(0, 1500), min_bucket=256)],
+         [batch_from_arrow(t.slice(1500), min_bucket=256)]], schema)
+    sample = rng.choice(vals, 200)
+    part = RangePartitioner.from_sample(sample, 4, key_col=0)
+    mgr = ShuffleManager(local_dir=str(tmp_path))
+    node = SortExec([SortOrder(col("x"))],
+                    ShuffleExchangeExec(part, src, manager=mgr))
+    got = []
+    for p in range(node.num_partitions()):
+        got.extend(r["x"] for b in node.execute(p)
+                   for r in batch_to_arrow(b, node.output_schema).to_pylist())
+    assert got == sorted(vals.tolist())
