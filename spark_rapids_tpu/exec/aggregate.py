@@ -214,6 +214,12 @@ class HashAggregateExec(UnaryExec):
                 pre_cols.append(DeviceColumn(T.STRING, v.data, v.validity, v.offsets))
             else:
                 pre_cols.append(DeviceColumn(e.dtype, v.data, v.validity))
+        if not pre_cols:
+            # global count(*)-only aggregation has no pre-projected columns;
+            # a placeholder column carries the batch capacity through grouping
+            pre_cols.append(DeviceColumn(
+                T.BOOLEAN, jnp.zeros(batch.capacity, jnp.bool_),
+                jnp.zeros(batch.capacity, jnp.bool_)))
         pre = ColumnarBatch(pre_cols, batch.num_rows)
         gi = self._grouping(pre)
         return self._aggregate_grouped(pre, gi, [s.ops for s in self._specs])

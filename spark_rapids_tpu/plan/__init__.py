@@ -1,0 +1,19 @@
+"""Plan rewrite layer (SURVEY.md §2.3, L2).
+
+The reference's heart is GpuOverrides.scala: wrap the physical plan in a
+RapidsMeta tree, tag each node with reasons it can't run on device, convert
+what can, insert transitions, and explain the result. Same architecture
+here over this framework's logical plan:
+
+  logical plan -> Meta tree (tag) -> TpuExec / CpuExec tree (+transitions)
+
+with per-operator CPU fallback (cpu.py executes the same contract on host
+arrow data) and NOT_ON_TPU/ALL explain output.
+"""
+
+from spark_rapids_tpu.plan.logical import (  # noqa: F401
+    Aggregate, Filter, InMemoryScan, Join, Limit, LogicalPlan,
+    ParquetScan, Project, Sort,
+)
+from spark_rapids_tpu.plan.overrides import Overrides, explain  # noqa: F401
+from spark_rapids_tpu.plan.dataframe import DataFrame, read_parquet, from_arrow  # noqa: F401

@@ -1,0 +1,509 @@
+"""CPU fallback operators.
+
+Reference architecture: operators the plugin can't run on GPU stay as Spark's
+own CPU execs, and transitions (GpuColumnarToRowExec / GpuRowToColumnarExec)
+bridge the two worlds (GpuTransitionOverrides.scala:46-116). Standalone,
+this module IS the CPU engine: numpy/pandas implementations of the same
+operator contract, exchanging host arrow tables with device operators at
+explicit transition points (device batch <-> arrow is already the columnar
+core's interop path, so transitions are cheap).
+
+Values are (numpy_array, valid_mask) pairs mirroring the device
+representation, with the same null/NaN rules as exprs/eval.py.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec, BinaryExec
+from spark_rapids_tpu.exec.sort import SortOrder
+from spark_rapids_tpu.exprs import expr as E
+
+
+# ---------------------------------------------------------------------------
+# host value representation + expression interpreter
+# ---------------------------------------------------------------------------
+
+
+def _col_np(table: pa.Table, i: int) -> Tuple[np.ndarray, np.ndarray]:
+    arr = table.column(i).combine_chunks()
+    valid = np.asarray(arr.is_valid()) if arr.null_count else np.ones(
+        len(arr), np.bool_)
+    dt = T.from_arrow_type(arr.type)
+    if dt == T.DATE:
+        vals = np.asarray(arr.fill_null(0).cast(pa.int32()))
+    elif dt == T.TIMESTAMP:
+        vals = np.asarray(arr.fill_null(0).cast(pa.int64()))
+    elif dt in (T.STRING, T.BINARY):
+        vals = np.array(arr.fill_null("").to_pylist(), dtype=object)
+    elif isinstance(dt, T.DecimalType):
+        vals = np.array([int(v.scaleb(dt.scale)) if v is not None else 0
+                         for v in arr.to_pylist()], dtype=np.int64)
+    elif dt == T.BOOLEAN:
+        vals = np.asarray(arr.fill_null(False))
+    else:
+        vals = np.asarray(arr.fill_null(0)).astype(T.numpy_dtype(dt))
+    return vals, valid
+
+
+def cpu_eval(expr: E.Expression, table: pa.Table,
+             schema: T.Schema) -> Tuple[np.ndarray, np.ndarray]:
+    """Evaluate a bound expression over a host table -> (values, valid)."""
+    n = table.num_rows
+    ones = np.ones(n, np.bool_)
+
+    def ev(e):
+        return cpu_eval(e, table, schema)
+
+    if isinstance(expr, E.Alias):
+        return ev(expr.child)
+    if isinstance(expr, E.ColumnRef):
+        return _col_np(table, expr.index)
+    if isinstance(expr, E.Literal):
+        if expr.value is None:
+            return np.zeros(n), np.zeros(n, np.bool_)
+        v = expr.value
+        if expr.dtype == T.DATE:
+            import datetime
+            if isinstance(v, datetime.date):
+                v = (v - datetime.date(1970, 1, 1)).days
+        if isinstance(expr.dtype, T.DecimalType):
+            import decimal
+            v = int(decimal.Decimal(v).scaleb(expr.dtype.scale))
+        if expr.dtype == T.STRING:
+            return np.array([v] * n, dtype=object), ones
+        return np.full(n, v), ones
+    if isinstance(expr, E.Cast):
+        d, m = ev(expr.child)
+        return _cpu_cast(d, m, expr.child.dtype, expr.to)
+    if isinstance(expr, E.BinaryArithmetic):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        m = ma & mb
+        if isinstance(expr, E.Add):
+            return a + b, m
+        if isinstance(expr, E.Subtract):
+            return a - b, m
+        if isinstance(expr, E.Multiply):
+            return a * b, m
+        if isinstance(expr, E.Divide):
+            bf = b.astype(np.float64)
+            if (expr.left.dtype in T.FRACTIONAL_TYPES
+                    or expr.right.dtype in T.FRACTIONAL_TYPES):
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    return a.astype(np.float64) / bf, m
+            zero = b == 0
+            with np.errstate(divide="ignore", invalid="ignore"):
+                out = a.astype(np.float64) / np.where(zero, 1.0, bf)
+            return out, m & ~zero
+        if isinstance(expr, E.Remainder):
+            zero = (b == 0) | (np.isnan(b) if b.dtype.kind == "f" else False)
+            safe = np.where(zero, 1, b)
+            out = np.fmod(a, safe)
+            return out, m & ~zero
+        raise NotImplementedError(f"cpu {type(expr).__name__}")
+    if isinstance(expr, E.BinaryComparison):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        m = ma & mb
+        if expr.left.dtype in (T.STRING, T.BINARY):
+            cmp = {"EqualTo": lambda: _obj_eq(a, b),
+                   "LessThan": lambda: _obj_cmp(a, b, "<"),
+                   "GreaterThan": lambda: _obj_cmp(a, b, ">"),
+                   "LessThanOrEqual": lambda: _obj_cmp(a, b, "<="),
+                   "GreaterThanOrEqual": lambda: _obj_cmp(a, b, ">="),
+                   }[type(expr).__name__]()
+            return cmp, m
+        fa = a.astype(np.float64) if a.dtype.kind == "f" or b.dtype.kind == "f" else a
+        fb = b.astype(fa.dtype) if hasattr(b, "astype") else b
+        if isinstance(expr, E.EqualTo):
+            eq = (fa == fb) | (_isnan(fa) & _isnan(fb))
+            return eq, m
+        if isinstance(expr, E.LessThan):
+            return _nan_lt(fa, fb), m
+        if isinstance(expr, E.GreaterThan):
+            return _nan_lt(fb, fa), m
+        if isinstance(expr, E.LessThanOrEqual):
+            return ~_nan_lt(fb, fa), m
+        if isinstance(expr, E.GreaterThanOrEqual):
+            return ~_nan_lt(fa, fb), m
+    if isinstance(expr, E.And):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        valid = (ma & mb) | (ma & ~a) | (mb & ~b)
+        return a & b & ma & mb, valid
+    if isinstance(expr, E.Or):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        valid = (ma & mb) | (ma & a) | (mb & b)
+        return (a & ma) | (b & mb), valid
+    if isinstance(expr, E.Not):
+        a, m = ev(expr.child)
+        return ~a.astype(np.bool_), m
+    if isinstance(expr, E.IsNull):
+        _, m = ev(expr.child)
+        return ~m, ones
+    if isinstance(expr, E.IsNotNull):
+        _, m = ev(expr.child)
+        return m, ones
+    if isinstance(expr, E.Coalesce):
+        vals = [ev(c) for c in expr.children]
+        out, mask = vals[-1]
+        out = out.copy()
+        mask = mask.copy()
+        for v, mv in reversed(vals[:-1]):
+            out = np.where(mv, v, out)
+            mask = mv | mask
+        return out, mask
+    if isinstance(expr, E.If):
+        p, mp = ev(expr.children[0])
+        t, mt = ev(expr.children[1])
+        f, mf = ev(expr.children[2])
+        take = p & mp
+        return np.where(take, t, f), np.where(take, mt, mf)
+    if isinstance(expr, E.In):
+        v, mv = ev(expr.value)
+        hit = np.zeros(n, np.bool_)
+        any_null = False
+        for item in expr.items:
+            iv, mi = ev(item)
+            hit |= (v == iv) & mi
+            any_null |= not mi.all()
+        return hit, mv & (hit | (not any_null))
+    if isinstance(expr, (E.Year, E.Month, E.DayOfMonth, E.Quarter,
+                         E.DayOfWeek, E.DayOfYear)):
+        d, m = ev(expr.child)
+        days = (d // 86_400_000_000 if expr.child.dtype == T.TIMESTAMP
+                else d).astype("datetime64[D]")
+        if isinstance(expr, E.DayOfWeek):
+            return ((d.astype(np.int64) + 4) % 7 + 7) % 7 + 1, m
+        Y = days.astype("datetime64[Y]")
+        if isinstance(expr, E.Year):
+            return Y.astype(int) + 1970, m
+        M = days.astype("datetime64[M]")
+        if isinstance(expr, E.Month):
+            return (M.astype(int) % 12) + 1, m
+        if isinstance(expr, E.Quarter):
+            return ((M.astype(int) % 12) // 3) + 1, m
+        if isinstance(expr, E.DayOfMonth):
+            return (days - M).astype(int) + 1, m
+        return (days - Y).astype(int) + 1, m
+    if isinstance(expr, E.Length):
+        s, m = ev(expr.child)
+        return np.array([len(x) for x in s]), m
+    if isinstance(expr, (E.Upper, E.Lower)):
+        s, m = ev(expr.child)
+        f = str.upper if isinstance(expr, E.Upper) else str.lower
+        return np.array([f(x) for x in s], dtype=object), m
+    if isinstance(expr, (E.StartsWith, E.EndsWith, E.Contains)):
+        s, m = ev(expr.left)
+        p, mp = ev(expr.right)
+        if isinstance(expr, E.StartsWith):
+            out = np.array([a.startswith(b) for a, b in zip(s, p)])
+        elif isinstance(expr, E.EndsWith):
+            out = np.array([a.endswith(b) for a, b in zip(s, p)])
+        else:
+            out = np.array([b in a for a, b in zip(s, p)])
+        return out, m & mp
+    if isinstance(expr, E.Substring):
+        s, m = ev(expr.child)
+        pos, ln = expr.pos, expr.length
+        def sub(x):
+            start = pos - 1 if pos > 0 else (len(x) + pos if pos < 0 else 0)
+            start = max(start, 0)
+            return x[start: max(start, 0) + ln] if pos >= 0 else x[start: start + ln]
+        return np.array([sub(x) for x in s], dtype=object), m
+    raise NotImplementedError(f"cpu eval {type(expr).__name__}")
+
+
+def _isnan(a):
+    return np.isnan(a) if getattr(a, "dtype", None) is not None and a.dtype.kind == "f" else np.zeros(np.shape(a), np.bool_)
+
+
+def _nan_lt(a, b):
+    if getattr(a, "dtype", None) is not None and a.dtype.kind == "f":
+        return np.where(np.isnan(a), False, np.where(_isnan(b), ~np.isnan(a), a < b))
+    return a < b
+
+
+def _obj_eq(a, b):
+    return np.array([x == y for x, y in zip(a, b)])
+
+
+def _obj_cmp(a, b, op):
+    import operator
+    f = {"<": operator.lt, ">": operator.gt, "<=": operator.le,
+         ">=": operator.ge}[op]
+    return np.array([f(x, y) for x, y in zip(a, b)])
+
+
+def _cpu_cast(d, m, src: T.DataType, dst: T.DataType):
+    if src == dst:
+        return d, m
+    if dst == T.BOOLEAN:
+        return d != 0, m
+    if dst in T.INTEGRAL_TYPES:
+        np_t = T.numpy_dtype(dst)
+        if d.dtype.kind == "f":
+            info = np.iinfo(np_t)
+            hi = float(2 ** (info.bits - 1))
+            out = np.where(np.isnan(d), 0,
+                           np.where(d >= hi, info.max,
+                                    np.where(d < -hi, info.min,
+                                             np.trunc(np.nan_to_num(d))))).astype(np_t)
+            return out, m
+        return d.astype(np_t), m
+    if dst in (T.FLOAT, T.DOUBLE):
+        return d.astype(T.numpy_dtype(dst)), m
+    if dst == T.TIMESTAMP and src == T.DATE:
+        return d.astype(np.int64) * 86_400_000_000, m
+    if dst == T.DATE and src == T.TIMESTAMP:
+        return (d // 86_400_000_000).astype(np.int32), m
+    raise NotImplementedError(f"cpu cast {src}->{dst}")
+
+
+def _values_to_arrow(vals: np.ndarray, valid: np.ndarray,
+                     dt: T.DataType) -> pa.Array:
+    mask = None if valid.all() else ~valid
+    if dt == T.STRING:
+        py = [None if (mask is not None and mask[i]) else str(vals[i])
+              for i in range(len(vals))]
+        return pa.array(py, pa.string())
+    if isinstance(dt, T.DecimalType):
+        import decimal
+        scale = decimal.Decimal(1).scaleb(-dt.scale)
+        py = [None if (mask is not None and mask[i])
+              else decimal.Decimal(int(vals[i])) * scale for i in range(len(vals))]
+        return pa.array(py, dt.arrow_type())
+    if dt == T.DATE:
+        return pa.array(np.asarray(vals).astype(np.int32), pa.int32(),
+                        mask=mask).cast(pa.date32())
+    if dt == T.TIMESTAMP:
+        return pa.array(np.asarray(vals).astype(np.int64), pa.int64(),
+                        mask=mask).cast(pa.timestamp("us", tz="UTC"))
+    return pa.array(np.asarray(vals).astype(T.numpy_dtype(dt)),
+                    dt.arrow_type(), mask=mask)
+
+
+# ---------------------------------------------------------------------------
+# CPU operators (host-table contract + device interop via base execute())
+# ---------------------------------------------------------------------------
+
+
+class CpuExec(TpuExec):
+    """Base CPU operator: runs on host arrow tables; `do_execute` uploads to
+    device only when a device operator consumes it (the HostToDevice
+    transition)."""
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        raise NotImplementedError
+
+    def _child_host(self, child: TpuExec, partition: int) -> Iterator[pa.Table]:
+        """Consume a child as host tables: direct when it's a CpuExec, via
+        DeviceToHost transition otherwise."""
+        if isinstance(child, CpuExec):
+            yield from child.execute_host(partition)
+        else:
+            schema = child.output_schema
+            for b in child.execute(partition):
+                yield batch_to_arrow(b, schema)
+
+    def do_execute(self, partition: int):
+        for t in self.execute_host(partition):
+            yield batch_from_arrow(t)
+
+
+class CpuInMemoryScanExec(CpuExec):
+    """Host table scan for plans whose types can't live on device (e.g.
+    decimal precision > 18 in round 1)."""
+
+    def __init__(self, table: pa.Table):
+        TpuExec.__init__(self)
+        self.table = table
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return T.Schema.from_arrow(self.table.schema)
+
+    def node_description(self):
+        return f"CpuInMemoryScan[{self.table.num_rows} rows]"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        yield self.table
+
+
+class CpuParquetScanExec(CpuExec):
+    def __init__(self, paths: Sequence[str],
+                 columns: Optional[Sequence[str]] = None):
+        TpuExec.__init__(self)
+        self.paths = list(paths)
+        self.columns = list(columns) if columns is not None else None
+
+    @property
+    def output_schema(self) -> T.Schema:
+        import pyarrow.parquet as pq
+
+        s = pq.read_schema(self.paths[0])
+        if self.columns is not None:
+            s = pa.schema([s.field(c) for c in self.columns])
+        return T.Schema.from_arrow(s)
+
+    def node_description(self):
+        return f"CpuParquetScan[{len(self.paths)} files]"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        import pyarrow.parquet as pq
+
+        for p in self.paths:
+            yield pq.read_table(p, columns=self.columns)
+
+
+class CpuUnionExec(CpuExec):
+    def __init__(self, *children: TpuExec):
+        TpuExec.__init__(self, *children)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.children[0].output_schema
+
+    def num_partitions(self):
+        return sum(c.num_partitions() for c in self.children)
+
+    def node_description(self):
+        return "CpuUnion"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        for c in self.children:
+            n = c.num_partitions()
+            if partition < n:
+                yield from self._child_host(c, partition)
+                return
+            partition -= n
+
+
+class CpuProjectExec(CpuExec, UnaryExec):
+    def __init__(self, exprs: Sequence[E.Expression], child: TpuExec):
+        UnaryExec.__init__(self, child)
+        self.exprs = list(exprs)
+        self._bound = None
+
+    def _bind(self):
+        if self._bound is None:
+            from spark_rapids_tpu.exprs import eval as EV
+
+            self._bound = [E.resolve(e, self.child.output_schema)
+                           for e in self.exprs]
+            self._schema = EV.output_schema(self._bound)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        self._bind()
+        return self._schema
+
+    def node_description(self):
+        return f"CpuProject [{', '.join(map(repr, self.exprs))}]"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        self._bind()
+        in_schema = self.child.output_schema
+        for t in self._child_host(self.child, partition):
+            arrays = []
+            for e, f in zip(self._bound, self._schema):
+                vals, valid = cpu_eval(e, t, in_schema)
+                arrays.append(_values_to_arrow(vals, valid, f.dtype))
+            yield pa.table(arrays, schema=self._schema.to_arrow())
+
+
+class CpuFilterExec(CpuExec, UnaryExec):
+    def __init__(self, condition: E.Expression, child: TpuExec):
+        UnaryExec.__init__(self, child)
+        self.condition = condition
+        self._bound = None
+
+    def node_description(self):
+        return f"CpuFilter [{self.condition!r}]"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        if self._bound is None:
+            self._bound = E.resolve(self.condition, self.child.output_schema)
+        schema = self.child.output_schema
+        for t in self._child_host(self.child, partition):
+            vals, valid = cpu_eval(self._bound, t, schema)
+            keep = vals.astype(np.bool_) & valid
+            yield t.filter(pa.array(keep))
+
+
+class CpuSortExec(CpuExec, UnaryExec):
+    """Global sort on host: collects every child partition (the CPU path has
+    no range exchange) and honors Spark null ordering (ASC -> NULLS FIRST)."""
+
+    def __init__(self, orders: Sequence[SortOrder], child: TpuExec):
+        UnaryExec.__init__(self, child)
+        self.orders = list(orders)
+
+    def num_partitions(self):
+        return 1
+
+    def node_description(self):
+        return f"CpuSort {self.orders}"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        import pyarrow.compute as pc
+
+        tables = [t for p in range(self.child.num_partitions())
+                  for t in self._child_host(self.child, p)]
+        if not tables:
+            return
+        t = pa.concat_tables(tables)
+        # arrow exposes one null_placement for all keys; Spark's default is
+        # per-direction (ASC NULLS FIRST / DESC NULLS LAST) — sort key by key,
+        # least significant first, relying on stable sorting
+        idx = None
+        for o in reversed(self.orders):
+            b = E.resolve(o.child, self.child.output_schema)
+            assert isinstance(b, E.ColumnRef)
+            nulls_first = (o.nulls_first if o.nulls_first is not None
+                           else o.ascending)
+            cur = t if idx is None else t.take(idx)
+            order = pc.sort_indices(
+                cur.column(b.index),
+                sort_keys=[("", "ascending" if o.ascending else "descending")],
+                null_placement="at_start" if nulls_first else "at_end",
+            )
+            idx = order if idx is None else idx.take(order)
+        yield t.take(idx)
+
+
+class CpuLimitExec(CpuExec, UnaryExec):
+    def __init__(self, n: int, child: TpuExec, offset: int = 0):
+        UnaryExec.__init__(self, child)
+        self.n = n
+        self.offset = offset
+
+    def num_partitions(self):
+        return 1
+
+    def node_description(self):
+        return f"CpuLimit {self.n}"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        remaining = self.n
+        to_skip = self.offset
+        for p in range(self.child.num_partitions()):
+            for t in self._child_host(self.child, p):
+                if to_skip:
+                    if t.num_rows <= to_skip:
+                        to_skip -= t.num_rows
+                        continue
+                    t = t.slice(to_skip)
+                    to_skip = 0
+                if remaining <= 0:
+                    return
+                if t.num_rows <= remaining:
+                    remaining -= t.num_rows
+                    yield t
+                else:
+                    yield t.slice(0, remaining)
+                    return

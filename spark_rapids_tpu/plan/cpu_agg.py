@@ -1,0 +1,244 @@
+"""CPU fallback aggregate and join (pandas-backed).
+
+These carry queries whose aggregation/join shapes the device engine can't
+take yet (the reference keeps such nodes on CPU Spark; SURVEY.md §2.3
+willNotWorkOnGpu flow)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.base import BinaryExec, TpuExec, UnaryExec
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.plan.cpu import CpuExec, cpu_eval, _values_to_arrow
+
+
+class CpuAggregateExec(CpuExec, UnaryExec):
+    def __init__(self, group_exprs: Sequence[E.Expression],
+                 agg_exprs: Sequence[E.Expression], child: TpuExec):
+        UnaryExec.__init__(self, child)
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        from spark_rapids_tpu.exec.aggregate import _strip_alias
+
+        cs = self.child.output_schema
+        fields = []
+        for e in self.group_exprs:
+            b = E.resolve(e, cs)
+            inner, name = _strip_alias(b)
+            fields.append(T.Field(name, inner.dtype, inner.nullable))
+        for e in self.agg_exprs:
+            func, name = _strip_alias(e)
+            b = E.resolve(func, cs)
+            fields.append(T.Field(name, b.dtype, b.nullable))
+        return T.Schema(fields)
+
+    def num_partitions(self):
+        return 1
+
+    def node_description(self):
+        return f"CpuAggregate keys={self.group_exprs} aggs={self.agg_exprs}"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        import pandas as pd
+        from spark_rapids_tpu.exec.aggregate import _strip_alias
+
+        cs = self.child.output_schema
+        tables = []
+        for p in range(self.child.num_partitions()):
+            tables.extend(self._child_host(self.child, p))
+        if not tables:
+            tables = [cs.to_arrow().empty_table()]
+        t = pa.concat_tables(tables)
+        # evaluate group keys + agg inputs as columns
+        key_names, cols, masks = [], {}, {}
+        for i, e in enumerate(self.group_exprs):
+            b = E.resolve(e, cs)
+            _, name = _strip_alias(b)
+            vals, valid = cpu_eval(b, t, cs)
+            key_names.append(name)
+            cols[name] = vals
+            masks[name] = valid
+        agg_inputs = []
+        for j, e in enumerate(self.agg_exprs):
+            func, name = _strip_alias(e)
+            bound = type(func)(E.resolve(func.children[0], cs)) if func.children \
+                else func
+            if func.children:
+                vals, valid = cpu_eval(bound.children[0], t, cs)
+            else:
+                vals = np.ones(t.num_rows)
+                valid = np.ones(t.num_rows, np.bool_)
+            agg_inputs.append((bound, name, vals, valid))
+
+        n = t.num_rows
+        groups = {}
+        order = []
+        for r in range(n):
+            key = tuple(
+                None if not masks[k][r] else
+                (cols[k][r].item() if hasattr(cols[k][r], "item") else cols[k][r])
+                for k in key_names)
+            if key not in groups:
+                groups[key] = len(order)
+                order.append(key)
+        if not key_names and not order:
+            groups[()] = 0
+            order.append(())
+        gid = np.array([groups[tuple(
+            None if not masks[k][r] else
+            (cols[k][r].item() if hasattr(cols[k][r], "item") else cols[k][r])
+            for k in key_names)] for r in range(n)], dtype=np.int64) \
+            if n else np.zeros(0, np.int64)
+        ng = len(order)
+
+        out_arrays: List[pa.Array] = []
+        schema = self.output_schema
+        for i, kname in enumerate(key_names):
+            vals = [order[g][i] for g in range(ng)]
+            out_arrays.append(pa.array(vals, schema[i].dtype.arrow_type()
+                                       if schema[i].dtype in (T.STRING,)
+                                       else None))
+            if out_arrays[-1].type != schema[i].dtype.arrow_type():
+                out_arrays[-1] = out_arrays[-1].cast(schema[i].dtype.arrow_type())
+        for (bound, name, vals, valid), f in zip(
+                agg_inputs, list(schema)[len(key_names):]):
+            out = []
+            for g in range(ng):
+                sel = (gid == g) & valid
+                sel_any = (gid == g)
+                if isinstance(bound, E.Count):
+                    out.append(int(sel.sum()) if bound.children
+                               else int(sel_any.sum()))
+                elif isinstance(bound, E.Sum):
+                    out.append(vals[sel].sum() if sel.any() else None)
+                elif isinstance(bound, E.Min):
+                    out.append(vals[sel].min() if sel.any() else None)
+                elif isinstance(bound, E.Max):
+                    out.append(vals[sel].max() if sel.any() else None)
+                elif isinstance(bound, E.Average):
+                    out.append(float(vals[sel].mean()) if sel.any() else None)
+                elif isinstance(bound, (E.First, E.Last)):
+                    idxs = np.nonzero(sel)[0]
+                    out.append(vals[idxs[0 if isinstance(bound, E.First)
+                                         else -1]] if len(idxs) else None)
+                else:
+                    raise NotImplementedError(type(bound).__name__)
+            out_arrays.append(pa.array(
+                [None if v is None else
+                 (v.item() if hasattr(v, "item") else v) for v in out]
+            ).cast(f.dtype.arrow_type()))
+        yield pa.table(out_arrays, schema=schema.to_arrow())
+
+
+class CpuJoinExec(CpuExec, BinaryExec):
+    def __init__(self, left_keys, right_keys, join_type: str,
+                 left: TpuExec, right: TpuExec,
+                 condition: Optional[E.Expression] = None):
+        BinaryExec.__init__(self, left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+
+    @property
+    def output_schema(self) -> T.Schema:
+        ls, rs = self.left.output_schema, self.right.output_schema
+        if self.join_type in ("left_semi", "left_anti"):
+            return T.Schema(list(ls))
+        lf = [T.Field(f.name, f.dtype,
+                      f.nullable or self.join_type in ("right", "full"))
+              for f in ls]
+        rf = [T.Field(f.name, f.dtype,
+                      f.nullable or self.join_type in ("left", "full"))
+              for f in rs]
+        return T.Schema(lf + rf)
+
+    def num_partitions(self):
+        return 1
+
+    def node_description(self):
+        return f"CpuJoin {self.join_type}"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        """Positional (tuple-based) join: duplicate column names between the
+        sides must not collide, so rows are value tuples, never dicts."""
+        ls, rs = self.left.output_schema, self.right.output_schema
+        def rows_of(side, n_parts):
+            out = []
+            for p in range(n_parts):
+                for t in side(p):
+                    # positional read: to_pylist() would collapse duplicate
+                    # column names (joins of joins)
+                    cols = [c.to_pylist() for c in t.columns]
+                    out.extend(zip(*cols) if cols else [])
+            return out
+
+        lrows = rows_of(lambda p: self._child_host(self.left, p),
+                        self.left.num_partitions())
+        rrows = rows_of(lambda p: self._child_host(self.right, p),
+                        self.right.num_partitions())
+        lk = [self._key_index(k, ls) for k in self.left_keys]
+        rk = [self._key_index(k, rs) for k in self.right_keys]
+        rindex = {}
+        for i, rr in enumerate(rrows):
+            key = tuple(rr[j] for j in rk)
+            if all(v is not None for v in key):
+                rindex.setdefault(key, []).append(i)
+        lnull = (None,) * len(ls)
+        rnull = (None,) * len(rs)
+        out = []
+        rmatched = [False] * len(rrows)
+        pair_schema = T.Schema(list(ls) + list(rs))
+        for lr in lrows:
+            key = tuple(lr[j] for j in lk)
+            cand = rindex.get(key, []) if all(v is not None for v in key) else []
+            matches = []
+            for i in cand:
+                if self.condition is not None and not self._cond(
+                        lr + rrows[i], pair_schema):
+                    continue
+                matches.append(i)
+            for i in matches:
+                rmatched[i] = True
+            if self.join_type == "left_semi":
+                if matches:
+                    out.append(lr)
+            elif self.join_type == "left_anti":
+                if not matches:
+                    out.append(lr)
+            elif matches:
+                out.extend(lr + rrows[i] for i in matches)
+            elif self.join_type in ("left", "full"):
+                out.append(lr + rnull)
+        if self.join_type in ("right", "full"):
+            for i, rr in enumerate(rrows):
+                if not rmatched[i]:
+                    out.append(lnull + rr)
+        schema = self.output_schema
+        arrays = [
+            pa.array([row[i] for row in out], f.dtype.arrow_type())
+            for i, f in enumerate(schema)
+        ]
+        yield pa.table(arrays, schema=schema.to_arrow())
+
+    def _cond(self, row: tuple, pair_schema: T.Schema) -> bool:
+        arrays = [pa.array([v], f.dtype.arrow_type())
+                  for v, f in zip(row, pair_schema)]
+        t = pa.table(arrays, schema=pair_schema.to_arrow())
+        bound = E.resolve(self.condition, pair_schema)
+        vals, valid = cpu_eval(bound, t, pair_schema)
+        return bool(vals[0]) and bool(valid[0])
+
+    @staticmethod
+    def _key_index(k: E.Expression, schema: T.Schema) -> int:
+        b = E.resolve(k, schema)
+        assert isinstance(b, E.ColumnRef)
+        return b.index

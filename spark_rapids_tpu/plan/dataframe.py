@@ -1,0 +1,154 @@
+"""DataFrame front-end: the user-facing API over the plan layer.
+
+The reference has no front-end (Spark provides it); standalone, this thin
+builder gives tests/benchmarks and users an ergonomic way to express the
+same plans Spark would hand the plugin. It mirrors the PySpark column-API
+subset that the reference accelerates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.exec.sort import SortOrder
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.plan import logical as L
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan,
+                 conf: Optional[C.RapidsConf] = None,
+                 shuffle_partitions: int = 4):
+        self.plan = plan
+        self.conf = conf
+        self.shuffle_partitions = shuffle_partitions
+
+    def _with(self, plan: L.LogicalPlan) -> "DataFrame":
+        return DataFrame(plan, self.conf, self.shuffle_partitions)
+
+    # -- builders ----------------------------------------------------------
+    def select(self, *exprs) -> "DataFrame":
+        exprs = [E.col(e) if isinstance(e, str) else e for e in exprs]
+        return self._with(L.Project(list(exprs), self.plan))
+
+    def filter(self, condition: E.Expression) -> "DataFrame":
+        return self._with(L.Filter(condition, self.plan))
+
+    where = filter
+
+    def group_by(self, *keys) -> "GroupedDataFrame":
+        keys = [E.col(k) if isinstance(k, str) else k for k in keys]
+        return GroupedDataFrame(self, list(keys))
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedDataFrame(self, []).agg(*aggs)
+
+    def sort(self, *orders, limit: Optional[int] = None) -> "DataFrame":
+        os_: List[SortOrder] = []
+        for o in orders:
+            if isinstance(o, str):
+                os_.append(SortOrder(E.col(o)))
+            elif isinstance(o, SortOrder):
+                os_.append(o)
+            else:
+                os_.append(SortOrder(o))
+        return self._with(L.Sort(os_, self.plan, limit=limit))
+
+    order_by = sort
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             left_on=None, right_on=None,
+             condition: Optional[E.Expression] = None) -> "DataFrame":
+        if on is not None:
+            if isinstance(on, str):
+                on = [on]
+            left_keys = [E.col(c) for c in on]
+            right_keys = [E.col(c) for c in on]
+        else:
+            mk = lambda ks: [E.col(k) if isinstance(k, str) else k
+                             for k in (ks if isinstance(ks, (list, tuple)) else [ks])]
+            left_keys = mk(left_on)
+            right_keys = mk(right_on)
+        return self._with(L.Join(self.plan, other.plan, left_keys, right_keys,
+                                 how, condition))
+
+    def limit(self, n: int, offset: int = 0) -> "DataFrame":
+        return self._with(L.Limit(n, self.plan, offset))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._with(L.Union([self.plan, other.plan]))
+
+    # -- execution ---------------------------------------------------------
+    @property
+    def schema(self) -> T.Schema:
+        return self.plan.schema
+
+    def physical_plan(self):
+        from spark_rapids_tpu.plan.overrides import Overrides
+
+        return Overrides(self.conf, self.shuffle_partitions).apply(self.plan)
+
+    def explain(self) -> str:
+        from spark_rapids_tpu.plan.overrides import Overrides, explain
+
+        meta = Overrides(self.conf, self.shuffle_partitions).wrap_and_tag(
+            self.plan)
+        return explain(meta, "ALL")
+
+    def to_arrow(self) -> pa.Table:
+        from spark_rapids_tpu.columnar.batch import batch_to_arrow
+        from spark_rapids_tpu.plan.cpu import CpuExec
+        from spark_rapids_tpu.shuffle import ShuffleExchangeExec
+
+        node = self.physical_plan()
+        schema = node.output_schema
+        tables = []
+        try:
+            if isinstance(node, CpuExec):
+                for p in range(node.num_partitions()):
+                    tables.extend(node.execute_host(p))
+            else:
+                for p in range(node.num_partitions()):
+                    for b in node.execute(p):
+                        tables.append(batch_to_arrow(b, schema))
+        finally:
+            # release shuffle files/blocks now that output is materialized
+            def walk(n):
+                if isinstance(n, ShuffleExchangeExec):
+                    n.cleanup()
+                for c in n.children:
+                    walk(c)
+
+            walk(node)
+        if not tables:
+            return schema.to_arrow().empty_table()
+        return pa.concat_tables(tables)
+
+    def collect(self) -> List[dict]:
+        return self.to_arrow().to_pylist()
+
+
+class GroupedDataFrame:
+    def __init__(self, df: DataFrame, keys: List[E.Expression]):
+        self.df = df
+        self.keys = keys
+
+    def agg(self, *aggs) -> DataFrame:
+        return self.df._with(
+            L.Aggregate(self.keys, list(aggs), self.df.plan))
+
+
+def read_parquet(paths, columns=None, predicate=None,
+                 conf: Optional[C.RapidsConf] = None) -> DataFrame:
+    if isinstance(paths, str):
+        paths = [paths]
+    return DataFrame(L.ParquetScan(list(paths), columns, predicate), conf)
+
+
+def from_arrow(table: pa.Table, conf: Optional[C.RapidsConf] = None,
+               batch_rows: int = 1 << 20) -> DataFrame:
+    return DataFrame(L.InMemoryScan(table, batch_rows), conf)

@@ -1,0 +1,203 @@
+"""Logical plan nodes.
+
+The reference plugs into Spark's Catalyst plans; standalone, this framework
+carries its own small logical algebra with the same operator vocabulary
+(the Exec rule list at GpuOverrides.scala:4182-4523). The plan layer only
+holds structure + schemas; execution strategy (device/CPU, shuffle
+insertion) is decided by overrides.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs import eval as EV
+from spark_rapids_tpu.exec.sort import SortOrder
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.name()
+
+
+@dataclasses.dataclass
+class ParquetScan(LogicalPlan):
+    paths: List[str]
+    columns: Optional[List[str]] = None
+    predicate: Optional[E.Expression] = None  # pushed-down (stats pruning)
+
+    @property
+    def schema(self) -> T.Schema:
+        import pyarrow.parquet as pq
+
+        s = pq.read_schema(self.paths[0])
+        if self.columns is not None:
+            s = pa.schema([s.field(c) for c in self.columns])
+        return T.Schema.from_arrow(s)
+
+    def describe(self):
+        return f"ParquetScan[{len(self.paths)} files]"
+
+
+@dataclasses.dataclass
+class InMemoryScan(LogicalPlan):
+    table: pa.Table
+    batch_rows: int = 1 << 20
+
+    @property
+    def schema(self) -> T.Schema:
+        return T.Schema.from_arrow(self.table.schema)
+
+    def describe(self):
+        return f"InMemoryScan[{self.table.num_rows} rows]"
+
+
+@dataclasses.dataclass
+class Project(LogicalPlan):
+    exprs: List[E.Expression]
+    child: LogicalPlan
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def schema(self) -> T.Schema:
+        bound = [E.resolve(e, self.child.schema) for e in self.exprs]
+        return EV.output_schema(bound)
+
+    def describe(self):
+        return f"Project{self.exprs}"
+
+
+@dataclasses.dataclass
+class Filter(LogicalPlan):
+    condition: E.Expression
+    child: LogicalPlan
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.child.schema
+
+    def describe(self):
+        return f"Filter[{self.condition!r}]"
+
+
+@dataclasses.dataclass
+class Aggregate(LogicalPlan):
+    group_exprs: List[E.Expression]
+    agg_exprs: List[E.Expression]
+    child: LogicalPlan
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def schema(self) -> T.Schema:
+        from spark_rapids_tpu.exec.aggregate import _strip_alias
+
+        fields = []
+        for e in self.group_exprs:
+            b = E.resolve(e, self.child.schema)
+            inner, name = _strip_alias(b)
+            fields.append(T.Field(name, inner.dtype, inner.nullable))
+        for e in self.agg_exprs:
+            func, name = _strip_alias(e)
+            bound = E.resolve(func, self.child.schema)
+            fields.append(T.Field(name, bound.dtype, bound.nullable))
+        return T.Schema(fields)
+
+    def describe(self):
+        return f"Aggregate[keys={self.group_exprs}, aggs={self.agg_exprs}]"
+
+
+@dataclasses.dataclass
+class Sort(LogicalPlan):
+    orders: List[SortOrder]
+    child: LogicalPlan
+    is_global: bool = True
+    limit: Optional[int] = None  # top-k fusion (TakeOrderedAndProject)
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.child.schema
+
+    def describe(self):
+        return f"Sort{self.orders}"
+
+
+@dataclasses.dataclass
+class Join(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    left_keys: List[E.Expression]
+    right_keys: List[E.Expression]
+    join_type: str = "inner"
+    condition: Optional[E.Expression] = None
+
+    def __post_init__(self):
+        self.children = (self.left, self.right)
+
+    @property
+    def schema(self) -> T.Schema:
+        ls, rs = self.left.schema, self.right.schema
+        if self.join_type in ("left_semi", "left_anti"):
+            return T.Schema(list(ls))
+        lf = [T.Field(f.name, f.dtype,
+                      f.nullable or self.join_type in ("right", "full"))
+              for f in ls]
+        rf = [T.Field(f.name, f.dtype,
+                      f.nullable or self.join_type in ("left", "full"))
+              for f in rs]
+        return T.Schema(lf + rf)
+
+    def describe(self):
+        return f"Join[{self.join_type}]"
+
+
+@dataclasses.dataclass
+class Limit(LogicalPlan):
+    n: int
+    child: LogicalPlan
+    offset: int = 0
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.child.schema
+
+    def describe(self):
+        return f"Limit[{self.n}]"
+
+
+@dataclasses.dataclass
+class Union(LogicalPlan):
+    inputs: List[LogicalPlan]
+
+    def __post_init__(self):
+        self.children = tuple(self.inputs)
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.inputs[0].schema

@@ -1,0 +1,337 @@
+"""Plan rewrite: tag -> convert -> explain, with CPU fallback.
+
+Reference: GpuOverrides.scala (apply -> wrapAndTagPlan -> tag -> explain ->
+doConvertPlan; :4541-4908) and the RapidsMeta wrapper tree
+(RapidsMeta.scala:84 — willNotWorkOnGpu reason accumulation), plus
+TypeChecks.scala per-operator type matrices and GpuTransitionOverrides
+transition insertion. Same pipeline over the standalone logical plan:
+
+  LogicalPlan -> PlanMeta tree --tag--> device-or-CPU decision per node
+             --convert--> TpuExec/CpuExec tree (transitions implicit in
+             CpuExec) --> explain string (NONE | NOT_ON_TPU | ALL)
+
+Distribution: when a node's input has multiple partitions, the converter
+inserts shuffle exchanges (hash for aggregate/join, range for global sort) —
+the standalone analog of Spark's EnsureRequirements + the reference's
+post-shuffle coalesce.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.exec import (
+    CoalesceBatchesExec, FilterExec, GlobalLimitExec, HashAggregateExec,
+    HashJoinExec, ParquetScanExec, ProjectExec, SortExec, UnionExec,
+)
+from spark_rapids_tpu.exec.base import BatchSourceExec, TpuExec
+from spark_rapids_tpu.exec.sort import SortOrder
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.cpu import (
+    CpuExec, CpuFilterExec, CpuLimitExec, CpuProjectExec, CpuSortExec,
+)
+from spark_rapids_tpu.shuffle import (
+    HashPartitioner, RangePartitioner, ShuffleExchangeExec, SinglePartitioner,
+)
+
+
+# ---------------------------------------------------------------------------
+# device support matrices (TypeChecks-lite)
+# ---------------------------------------------------------------------------
+
+_DEVICE_EXPRS = (
+    E.ColumnRef, E.UnresolvedColumn, E.Literal, E.Alias, E.Cast,
+    E.Add, E.Subtract, E.Multiply, E.Divide, E.IntegralDivide, E.Remainder,
+    E.Pmod, E.UnaryMinus, E.Abs,
+    E.EqualTo, E.EqualNullSafe, E.LessThan, E.LessThanOrEqual, E.GreaterThan,
+    E.GreaterThanOrEqual, E.And, E.Or, E.Not, E.IsNull, E.IsNotNull, E.IsNaN,
+    E.Coalesce, E.If, E.CaseWhen, E.In,
+    E.Sqrt, E.Floor, E.Ceil, E.Round, E.Exp, E.Log, E.Pow,
+    E.Year, E.Month, E.DayOfMonth, E.DayOfWeek, E.DayOfYear, E.Quarter,
+    E.DateAdd, E.DateSub, E.DateDiff,
+    E.Length, E.Upper, E.Lower, E.StartsWith, E.EndsWith, E.Contains,
+    E.Substring,
+    E.Sum, E.Count, E.Min, E.Max, E.Average, E.First, E.Last,
+)
+
+
+def _check_dtype(dt: T.DataType) -> Optional[str]:
+    if isinstance(dt, T.DecimalType) and dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+        return f"decimal precision {dt.precision} > 18 not on device yet"
+    return None
+
+
+def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
+    """Reasons this expression can't run on device (empty = supported)."""
+    reasons: List[str] = []
+
+    def walk(e: E.Expression):
+        if not isinstance(e, _DEVICE_EXPRS):
+            reasons.append(f"expression {type(e).__name__} not on device")
+            return
+        try:
+            bound = E.resolve(e, schema)
+            r = _check_dtype(bound.dtype)
+            if r:
+                reasons.append(r)
+            # string ordering comparisons are CPU-only in round 1
+            if isinstance(bound, (E.LessThan, E.LessThanOrEqual,
+                                  E.GreaterThan, E.GreaterThanOrEqual)):
+                if bound.left.dtype in (T.STRING, T.BINARY):
+                    reasons.append("string ordering comparison not on device")
+        except (TypeError, KeyError, NotImplementedError) as ex:
+            reasons.append(str(ex))
+        for c in e.children:
+            walk(c)
+        if isinstance(e, E.In):
+            for it in e.items:
+                walk(it)
+
+    walk(expr)
+    return reasons
+
+
+# ---------------------------------------------------------------------------
+# meta tree
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PlanMeta:
+    node: L.LogicalPlan
+    children: List["PlanMeta"]
+    reasons: List[str] = dataclasses.field(default_factory=list)
+
+    def will_not_work(self, reason: str) -> None:
+        self.reasons.append(reason)
+
+    @property
+    def can_run_on_device(self) -> bool:
+        return not self.reasons
+
+
+class Overrides:
+    """The rewrite rule (GpuOverrides analog)."""
+
+    def __init__(self, conf: Optional[C.RapidsConf] = None,
+                 shuffle_partitions: int = 4):
+        self.conf = conf or C.RapidsConf()
+        self.shuffle_partitions = shuffle_partitions
+
+    # -- tag ---------------------------------------------------------------
+    def wrap_and_tag(self, plan: L.LogicalPlan) -> PlanMeta:
+        meta = PlanMeta(plan, [self.wrap_and_tag(c) for c in plan.children])
+        if not C.SQL_ENABLED.get(self.conf):
+            meta.will_not_work("spark.rapids.tpu.sql.enabled is false")
+            return meta
+        self._tag(meta)
+        return meta
+
+    def _tag(self, meta: PlanMeta) -> None:
+        node = meta.node
+        child_schema = (node.children[0].schema if node.children else None)
+        # every device node must be able to HOLD its output types on device
+        # (TypeChecks: the output type matrix applies to all operators)
+        for f in node.schema:
+            r = _check_dtype(f.dtype)
+            if r:
+                meta.will_not_work(r)
+        if isinstance(node, L.Project):
+            for e in node.exprs:
+                for r in check_expr(e, child_schema):
+                    meta.will_not_work(r)
+        elif isinstance(node, L.Filter):
+            for r in check_expr(node.condition, child_schema):
+                meta.will_not_work(r)
+        elif isinstance(node, L.Aggregate):
+            for e in list(node.group_exprs) + list(node.agg_exprs):
+                for r in check_expr(e, child_schema):
+                    meta.will_not_work(r)
+        elif isinstance(node, L.Sort):
+            for o in node.orders:
+                for r in check_expr(o.child, child_schema):
+                    meta.will_not_work(r)
+        elif isinstance(node, L.Join):
+            for e, s in ([(k, node.left.schema) for k in node.left_keys]
+                         + [(k, node.right.schema) for k in node.right_keys]):
+                for r in check_expr(e, s):
+                    meta.will_not_work(r)
+            if node.condition is not None:
+                pair = T.Schema(list(node.left.schema) + list(node.right.schema))
+                for r in check_expr(node.condition, pair):
+                    meta.will_not_work(r)
+
+    # -- convert -----------------------------------------------------------
+    def apply(self, plan: L.LogicalPlan) -> TpuExec:
+        meta = self.wrap_and_tag(plan)
+        ex = self._convert(meta)
+        mode = C.EXPLAIN.get(self.conf)
+        if mode != "NONE":
+            print(explain(meta, mode))
+        return ex
+
+    def _convert(self, meta: PlanMeta) -> TpuExec:
+        node = meta.node
+        on_dev = meta.can_run_on_device
+        if not on_dev and not C.CPU_FALLBACK_ENABLED.get(self.conf):
+            raise NotImplementedError(
+                f"{node.describe()} can't run on device: {meta.reasons}")
+        kids = [self._convert(c) for c in meta.children]
+
+        if isinstance(node, L.ParquetScan):
+            if not on_dev:
+                from spark_rapids_tpu.plan.cpu import CpuParquetScanExec
+
+                return CpuParquetScanExec(node.paths, node.columns)
+            return ParquetScanExec(node.paths, columns=node.columns,
+                                   predicate=node.predicate)
+        if isinstance(node, L.InMemoryScan):
+            if not on_dev:
+                from spark_rapids_tpu.plan.cpu import CpuInMemoryScanExec
+
+                return CpuInMemoryScanExec(node.table)
+            from spark_rapids_tpu.columnar.batch import batch_from_arrow
+
+            t = node.table
+            batches = [batch_from_arrow(t.slice(i, node.batch_rows))
+                       for i in range(0, max(t.num_rows, 1), node.batch_rows)]
+            return BatchSourceExec([batches], node.schema)
+        if isinstance(node, L.Project):
+            return (ProjectExec(node.exprs, kids[0]) if on_dev
+                    else CpuProjectExec(node.exprs, kids[0]))
+        if isinstance(node, L.Filter):
+            return (FilterExec(node.condition, kids[0]) if on_dev
+                    else CpuFilterExec(node.condition, kids[0]))
+        if isinstance(node, L.Aggregate):
+            return self._convert_aggregate(node, kids[0], on_dev)
+        if isinstance(node, L.Sort):
+            return self._convert_sort(node, kids[0], on_dev)
+        if isinstance(node, L.Join):
+            return self._convert_join(node, kids, on_dev)
+        if isinstance(node, L.Limit):
+            return (GlobalLimitExec(node.n, kids[0], offset=node.offset)
+                    if on_dev else CpuLimitExec(node.n, kids[0], node.offset))
+        if isinstance(node, L.Union):
+            if not on_dev:
+                from spark_rapids_tpu.plan.cpu import CpuUnionExec
+
+                return CpuUnionExec(*kids)
+            return UnionExec(*kids)
+        raise NotImplementedError(type(node).__name__)
+
+    def _convert_aggregate(self, node: L.Aggregate, child: TpuExec,
+                           on_dev: bool) -> TpuExec:
+        if not on_dev:
+            from spark_rapids_tpu.plan.cpu_agg import CpuAggregateExec
+
+            return CpuAggregateExec(node.group_exprs, node.agg_exprs, child)
+        if child.num_partitions() == 1:
+            return HashAggregateExec(node.group_exprs, node.agg_exprs, child,
+                                     mode="complete")
+        partial = HashAggregateExec(node.group_exprs, node.agg_exprs, child,
+                                    mode="partial")
+        n_keys = len(node.group_exprs)
+        if n_keys == 0:
+            exchange = ShuffleExchangeExec(SinglePartitioner(), partial)
+        else:
+            exchange = ShuffleExchangeExec(
+                HashPartitioner(list(range(n_keys)), self.shuffle_partitions),
+                partial)
+        return HashAggregateExec.final_from_partial(partial, exchange)
+
+    def _convert_sort(self, node: L.Sort, child: TpuExec,
+                      on_dev: bool) -> TpuExec:
+        if not on_dev:
+            return CpuSortExec(node.orders, child)
+        if node.limit is not None:
+            from spark_rapids_tpu.exec.misc import take_ordered_and_project
+
+            return take_ordered_and_project(node.orders, node.limit, child)
+        if node.is_global and child.num_partitions() > 1:
+            child = self._range_exchange(node, child)
+        return SortExec(node.orders, child)
+
+    def _range_exchange(self, node: L.Sort, child: TpuExec) -> TpuExec:
+        """Sample the first sort key to build range bounds (GpuRangePartitioner
+        sample-based bounds)."""
+        first = node.orders[0]
+        bound = E.resolve(first.child, child.output_schema)
+        assert isinstance(bound, E.ColumnRef)
+        if bound.dtype in (T.STRING, T.BINARY) or len(node.orders) > 1:
+            # fall back to a single partition merge for non-range-able keys
+            return ShuffleExchangeExec(SinglePartitioner(), child)
+        from spark_rapids_tpu.columnar.batch import batch_to_arrow
+
+        samples = []
+        for p in range(child.num_partitions()):
+            for b in child.execute(p):
+                t = batch_to_arrow(b, child.output_schema)
+                col = t.column(bound.index).drop_null().to_numpy(
+                    zero_copy_only=False)
+                if len(col):
+                    samples.append(np.random.default_rng(0).choice(
+                        col, min(len(col), 256)))
+                break  # sample only the first batch per partition
+        values = np.concatenate(samples) if samples else np.zeros(0)
+        part = RangePartitioner.from_sample(
+            values, self.shuffle_partitions, bound.index, first.ascending,
+            first.nulls_first)
+        return ShuffleExchangeExec(part, child)
+
+    def _convert_join(self, node: L.Join, kids: List[TpuExec],
+                      on_dev: bool) -> TpuExec:
+        left, right = kids
+        if not on_dev:
+            from spark_rapids_tpu.plan.cpu_agg import CpuJoinExec
+
+            return CpuJoinExec(node.left_keys, node.right_keys,
+                               node.join_type, left, right, node.condition)
+        if left.num_partitions() > 1:
+            # shuffled join: co-partition both sides by key hash
+            lk = [self._key_index(k, node.left.schema) for k in node.left_keys]
+            rk = [self._key_index(k, node.right.schema) for k in node.right_keys]
+            left = ShuffleExchangeExec(
+                HashPartitioner(lk, self.shuffle_partitions), left)
+            right = ShuffleExchangeExec(
+                HashPartitioner(rk, self.shuffle_partitions), right)
+        elif right.num_partitions() > 1:
+            # broadcast-style: collapse the build side into the stream's
+            # single partition (GpuBroadcastHashJoin analog)
+            right = ShuffleExchangeExec(SinglePartitioner(), right)
+        return HashJoinExec(node.left_keys, node.right_keys, node.join_type,
+                            left, right, condition=node.condition)
+
+    @staticmethod
+    def _key_index(k: E.Expression, schema: T.Schema) -> int:
+        b = E.resolve(k, schema)
+        assert isinstance(b, E.ColumnRef)
+        return b.index
+
+
+# ---------------------------------------------------------------------------
+# explain
+# ---------------------------------------------------------------------------
+
+
+def explain(meta: PlanMeta, mode: str = "ALL") -> str:
+    """Render the tag decisions (spark.rapids.sql.explain analog)."""
+    lines: List[str] = []
+
+    def walk(m: PlanMeta, depth: int):
+        mark = "*" if m.can_run_on_device else "!"
+        if mode == "ALL" or not m.can_run_on_device:
+            line = f"{'  ' * depth}{mark} {m.node.describe()}"
+            if m.reasons:
+                line += "  cannot run on TPU because " + "; ".join(m.reasons)
+            lines.append(line)
+        for c in m.children:
+            walk(c, depth + 1)
+
+    walk(meta, 0)
+    return "\n".join(lines) if lines else "(entire plan runs on TPU)"

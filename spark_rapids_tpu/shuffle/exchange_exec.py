@@ -57,6 +57,15 @@ class ShuffleExchangeExec(UnaryExec):
                             self._reg, self.partitioner, batches)
             self._written = True
 
+    def cleanup(self) -> None:
+        """Release shuffle files/blocks (called by the session once the
+        query's output is consumed; Spark's ContextCleaner analog)."""
+        with self._write_lock:
+            if self._reg is not None:
+                self.manager.cleanup(self._reg)
+                self._reg = None
+                self._written = False
+
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._ensure_written()
         with self.timer("readTimeNs"):

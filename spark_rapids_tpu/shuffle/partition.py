@@ -126,20 +126,23 @@ class RangePartitioner(Partitioner):
     """
 
     def __init__(self, bounds: np.ndarray, key_col: int,
-                 ascending: bool = True):
+                 ascending: bool = True, nulls_first: Optional[bool] = None):
         self.bounds = np.asarray(bounds)
         self.key_col = key_col
         self.ascending = ascending
+        # Spark default: ASC NULLS FIRST / DESC NULLS LAST
+        self.nulls_first = ascending if nulls_first is None else nulls_first
         self.num_partitions = len(self.bounds) + 1
 
     def __hash__(self):
         return hash((type(self).__name__, self.key_col, self.ascending,
-                     self.bounds.tobytes()))
+                     self.nulls_first, self.bounds.tobytes()))
 
     def __eq__(self, other):
         return (type(other) is RangePartitioner
                 and other.key_col == self.key_col
                 and other.ascending == self.ascending
+                and other.nulls_first == self.nulls_first
                 and np.array_equal(other.bounds, self.bounds))
 
     def partition_ids(self, batch: ColumnarBatch) -> jax.Array:
@@ -149,14 +152,16 @@ class RangePartitioner(Partitioner):
             data = -data
         pid = jnp.searchsorted(
             jnp.asarray(self.bounds), data, side="right").astype(jnp.int32)
-        # nulls first: partition 0
-        return jnp.where(col.validity, pid, 0)
+        null_pid = 0 if self.nulls_first else self.num_partitions - 1
+        return jnp.where(col.validity, pid, null_pid)
 
     @staticmethod
     def from_sample(values: np.ndarray, num_partitions: int,
-                    key_col: int, ascending: bool = True) -> "RangePartitioner":
+                    key_col: int, ascending: bool = True,
+                    nulls_first: Optional[bool] = None) -> "RangePartitioner":
         qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
         bounds = np.quantile(values, qs) if len(values) else np.zeros(0)
         if not ascending:
             bounds = -bounds[::-1]
-        return RangePartitioner(np.asarray(bounds), key_col, ascending)
+        return RangePartitioner(np.asarray(bounds), key_col, ascending,
+                                nulls_first)

@@ -180,6 +180,14 @@ def test_global_agg():
     }])
 
 
+def test_global_count_star_only():
+    # regression: no group keys AND no agg inputs -> pre-projection had zero
+    # columns and collapsed every buffer to capacity 0
+    t = pa.table({"x": pa.array([1, 2, 3, 4, 5], pa.int64())})
+    node = HashAggregateExec([], [Count().alias("n")], source(t, batch_rows=2))
+    assert_same(run(node), [{"n": 5}])
+
+
 def test_global_agg_empty_input():
     t = pa.table({"x": pa.array([], pa.int64())})
     node = HashAggregateExec(

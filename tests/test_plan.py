@@ -1,0 +1,207 @@
+"""Plan layer tests: DataFrame API end-to-end, tagging/fallback decisions,
+explain output, CPU fallback correctness vs device results.
+
+The fallback-assertion pattern mirrors the reference's
+assert_gpu_fallback_collect (integration_tests asserts.py:479-617)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exprs.expr import (
+    Average, Count, Max, Min, Sum, col, lit,
+)
+from spark_rapids_tpu.plan import DataFrame, from_arrow, read_parquet
+from spark_rapids_tpu.plan.cpu import CpuExec, CpuFilterExec, CpuSortExec
+from spark_rapids_tpu.plan.overrides import Overrides, check_expr, explain
+
+
+def sample_table(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 10, n), pa.int64()),
+        "v": pa.array(rng.random(n) * 100, pa.float64()),
+        "s": pa.array([f"name{i % 5}" if i % 11 else None for i in range(n)],
+                      pa.string()),
+    })
+
+
+def test_dataframe_end_to_end():
+    t = sample_table()
+    df = (from_arrow(t)
+          .filter(col("v") > 50.0)
+          .group_by("k")
+          .agg(Sum(col("v")).alias("sv"), Count().alias("n"))
+          .sort("k"))
+    got = df.collect()
+    import collections
+    acc = collections.defaultdict(lambda: [0.0, 0])
+    for k, v in zip(t.column("k").to_pylist(), t.column("v").to_pylist()):
+        if v > 50.0:
+            acc[k][0] += v
+            acc[k][1] += 1
+    assert [r["k"] for r in got] == sorted(acc)
+    for r in got:
+        assert r["sv"] == pytest.approx(acc[r["k"]][0], rel=1e-12)
+        assert r["n"] == acc[r["k"]][1]
+
+
+def test_whole_plan_on_device():
+    df = (from_arrow(sample_table()).filter(col("v") > 10.0)
+          .select(col("k"), (col("v") * 2.0).alias("v2")))
+    out = df.explain()
+    assert "cannot run on TPU" not in out
+    assert all(line.lstrip().startswith("*") for line in out.splitlines())
+
+
+def test_string_ordering_falls_back():
+    """String < comparisons are CPU-only in round 1: the filter node must be
+    tagged and converted to a CpuFilterExec, and results must still be right."""
+    t = sample_table(200)
+    df = from_arrow(t).filter(col("s") > lit("name2"))
+    ex = df.physical_plan()
+    assert isinstance(ex, CpuFilterExec)
+    exp = [r for r in t.to_pylist() if r["s"] is not None and r["s"] > "name2"]
+    got = df.collect()
+    assert len(got) == len(exp)
+    assert "cannot run on TPU" in df.explain()
+
+
+def test_sql_disabled_runs_all_cpu():
+    conf = RapidsConf({"spark.rapids.tpu.sql.enabled": False})
+    t = sample_table(100)
+    df = DataFrame(from_arrow(t).filter(col("v") > 50.0).plan, conf)
+    ex = df.physical_plan()
+    assert isinstance(ex, CpuExec)
+    assert len(df.collect()) == sum(
+        1 for v in t.column("v").to_pylist() if v > 50.0)
+
+
+def test_fallback_disabled_raises():
+    conf = RapidsConf({"spark.rapids.tpu.sql.fallback.enabled": False})
+    df = DataFrame(
+        from_arrow(sample_table(50)).filter(col("s") > lit("a")).plan, conf)
+    with pytest.raises(NotImplementedError):
+        df.physical_plan()
+
+
+def test_cpu_aggregate_matches_device():
+    t = sample_table(500, seed=3)
+    dev = (from_arrow(t).group_by("k")
+           .agg(Sum(col("v")).alias("s"), Average(col("v")).alias("a"),
+                Min(col("v")).alias("mn"), Max(col("v")).alias("mx"),
+                Count().alias("n")))
+    got_dev = sorted(dev.collect(), key=lambda r: r["k"])
+    from spark_rapids_tpu.plan.cpu_agg import CpuAggregateExec
+
+    node = dev.physical_plan()
+    cpu_node = CpuAggregateExec([col("k")],
+                                [Sum(col("v")).alias("s"),
+                                 Average(col("v")).alias("a"),
+                                 Min(col("v")).alias("mn"),
+                                 Max(col("v")).alias("mx"),
+                                 Count().alias("n")],
+                                node.children[0])
+    got_cpu = sorted(
+        (r for t2 in cpu_node.execute_host(0) for r in t2.to_pylist()),
+        key=lambda r: r["k"])
+    assert len(got_dev) == len(got_cpu)
+    for a, b in zip(got_dev, got_cpu):
+        assert a["k"] == b["k"] and a["n"] == b["n"]
+        for c in ("s", "a", "mn", "mx"):
+            assert a[c] == pytest.approx(b[c], rel=1e-9)
+
+
+def test_join_via_dataframe_with_shuffle():
+    rng = np.random.default_rng(5)
+    left = pa.table({"k": pa.array(rng.integers(0, 50, 900), pa.int64()),
+                     "lv": pa.array(np.arange(900), pa.int64())})
+    right = pa.table({"k2": pa.array(np.arange(50), pa.int64()),
+                      "rv": pa.array(np.arange(50) * 10, pa.int64())})
+    # small batch_rows -> multiple partitions? partitions stay 1 source-side;
+    # exercise the shuffled-join path by raising left partitions via union
+    l1 = from_arrow(left.slice(0, 450))
+    l2 = from_arrow(left.slice(450))
+    df = (l1.union(l2)
+          .join(from_arrow(right), left_on="k", right_on="k2", how="inner"))
+    got = df.collect()
+    assert len(got) == 900
+    for r in got:
+        assert r["rv"] == r["k"] * 10
+
+
+def test_parquet_df(tmp_path):
+    import pyarrow.parquet as pq
+    t = sample_table(300, seed=9)
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(t, p)
+    df = (read_parquet(p, columns=["k", "v"])
+          .filter(col("k").eq(3))
+          .agg(Count().alias("n")))
+    expected = sum(1 for k in t.column("k").to_pylist() if k == 3)
+    assert df.collect() == [{"n": expected}]
+
+
+def test_top_k_fusion():
+    t = sample_table(400, seed=11)
+    from spark_rapids_tpu.exec.sort import SortOrder
+    df = from_arrow(t).select("v").sort(SortOrder(col("v"), ascending=False),
+                                        limit=5)
+    got = [r["v"] for r in df.collect()]
+    assert got == sorted(t.column("v").to_pylist(), reverse=True)[:5]
+
+
+def test_cpu_join_shared_column_names():
+    """Regression: outer-join fallback must not collide same-named columns."""
+    from spark_rapids_tpu.plan.cpu_agg import CpuJoinExec
+    from spark_rapids_tpu.exec import BatchSourceExec
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+
+    left = pa.table({"k": pa.array([1, 2], pa.int64()),
+                     "a": pa.array([10, 20], pa.int64())})
+    right = pa.table({"k": pa.array([1, 3], pa.int64()),
+                      "b": pa.array([100, 300], pa.int64())})
+    mk = lambda t: BatchSourceExec([[batch_from_arrow(t, 16)]],
+                                   T.Schema.from_arrow(t.schema))
+    node = CpuJoinExec([col("k")], [col("k")], "full", mk(left), mk(right))
+    rows = [tuple(vals) for t2 in node.execute_host(0)
+            for vals in zip(*[c.to_pylist() for c in t2.columns])]
+    assert sorted(rows, key=repr) == sorted(
+        [(1, 10, 1, 100), (2, 20, None, None), (None, None, 3, 300)],
+        key=repr)
+
+
+def test_decimal128_scan_falls_back():
+    import decimal
+    t = pa.table({"d": pa.array([decimal.Decimal(10**20), None],
+                                pa.decimal128(25, 0))})
+    df = from_arrow(t)
+    ex = df.physical_plan()
+    assert isinstance(ex, CpuExec)
+    got = df.collect()
+    assert got[0]["d"] == decimal.Decimal(10**20)  # value survives exactly
+    assert "decimal precision 25" in df.explain()
+
+
+def test_cpu_sort_null_placement():
+    t = pa.table({"s": pa.array(["b", None, "a"], pa.string())})
+    from spark_rapids_tpu.exec.sort import SortOrder
+    # string sort key forces CPU fallback? no - plain sort on strings runs on
+    # device; force CPU via disabled sql
+    conf = RapidsConf({"spark.rapids.tpu.sql.enabled": False})
+    df = DataFrame(from_arrow(t).sort("s").plan, conf)
+    assert [r["s"] for r in df.collect()] == [None, "a", "b"]
+    df2 = DataFrame(
+        from_arrow(t).sort(
+            __import__("spark_rapids_tpu.exec.sort", fromlist=["SortOrder"]
+                       ).SortOrder(col("s"), ascending=False)).plan, conf)
+    assert [r["s"] for r in df2.collect()] == ["b", "a", None]
+
+
+def test_check_expr_reasons():
+    schema = T.Schema.of(("s", T.STRING), ("x", T.LONG))
+    assert check_expr(col("x") + 1, schema) == []
+    rs = check_expr(col("s") < lit("zz"), schema)
+    assert any("string ordering" in r for r in rs)
