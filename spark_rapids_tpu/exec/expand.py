@@ -1,0 +1,56 @@
+"""Expand operator (grouping sets / rollup / cube support).
+
+Reference: GpuExpandExec — each input row emits one output row per projection
+list. TPU design: evaluate every projection over the batch (XLA fuses them)
+and device-concat the results.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.aggregate import concat_jit
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs import eval as EV
+
+
+class ExpandExec(UnaryExec):
+    def __init__(self, projections: Sequence[Sequence[E.Expression]],
+                 child: TpuExec):
+        super().__init__(child)
+        assert projections and all(
+            len(p) == len(projections[0]) for p in projections)
+        self.projections = [list(p) for p in projections]
+        self._bound = None
+
+    def _bind(self):
+        if self._bound is None:
+            cs = self.child.output_schema
+            self._bound = [
+                tuple(E.resolve(e, cs) for e in proj)
+                for proj in self.projections
+            ]
+            self._schema = EV.output_schema(list(self._bound[0]))
+            runs = []
+            for bound in self._bound:
+                runs.append(EV.compile_bound_projection(bound))
+            self._runs = runs
+
+    @property
+    def output_schema(self) -> T.Schema:
+        self._bind()
+        return self._schema
+
+    def node_description(self) -> str:
+        return f"TpuExpand [{len(self.projections)} projections]"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        self._bind()
+        for batch in self.child.execute(partition):
+            pieces = [run(batch) for run in self._runs]
+            yield pieces[0] if len(pieces) == 1 else concat_jit(pieces)
