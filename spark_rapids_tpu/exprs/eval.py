@@ -33,13 +33,7 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.exprs import expr as E
 
 
-class StringVal(NamedTuple):
-    """A string-typed expression value on device (Arrow layout)."""
-
-    data: jax.Array  # uint8 bytes
-    offsets: jax.Array  # int32 (capacity+1,)
-    validity: jax.Array  # bool (capacity,)
-
+from spark_rapids_tpu.exprs.strings import StringVal, row_ids as _string_row_ids
 
 Val = Union[ColVal, StringVal]
 
@@ -178,12 +172,6 @@ def _string_select_n(takes, vals) -> "StringVal":
 
 def _string_select(take: jax.Array, t: "StringVal", f: "StringVal") -> "StringVal":
     return _string_select_n([take, jnp.ones_like(take)], [t, f])
-
-
-def _string_row_ids(offsets: jax.Array, nbytes: int) -> jax.Array:
-    """Map each byte position to its row: row[k] = searchsorted(offsets,k,'right')-1."""
-    pos = jnp.arange(nbytes, dtype=jnp.int32)
-    return jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
 
 
 def _string_eq(a: StringVal, b: StringVal, capacity: int) -> jax.Array:
@@ -569,8 +557,89 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         return _eval_string_search(expr, ctx)
     if isinstance(expr, E.Substring):
         return _eval_substring(expr, ctx)
+    out = _eval_string_fns(expr, ctx)
+    if out is not None:
+        return out
 
     raise NotImplementedError(f"eval of {type(expr).__name__}")
+
+
+def _eval_string_fns(expr: E.Expression, ctx: EvalContext):
+    """Dispatch to the vectorized string kernels (exprs/strings.py)."""
+    from spark_rapids_tpu.exprs import regex as RX
+    from spark_rapids_tpu.exprs import strings as S
+
+    def sval(e: E.Expression) -> StringVal:
+        v = eval_expr(e, ctx)
+        assert isinstance(v, StringVal), f"{type(e).__name__} expects string"
+        return v
+
+    def back(v: StringVal) -> StringVal:
+        return v
+
+    if isinstance(expr, E.Concat):
+        vals = [sval(c) for c in expr.children]
+        acc = vals[0]
+        for v in vals[1:]:
+            acc = S.concat2(acc, v)
+        return back(acc)
+    if isinstance(expr, E.ConcatWs):
+        vals = [sval(c) for c in expr.children]
+        return back(S.concat_ws(expr.sep.encode("utf-8"), vals))
+    if isinstance(expr, E.StringTrim):  # covers Left/Right subclasses
+        chars = (expr.trim_str if expr.trim_str is not None else " ").encode()
+        s = sval(expr.children[0])
+        return back(S.trim(s, chars, left=expr.side in ("both", "left"),
+                           right=expr.side in ("both", "right")))
+    if isinstance(expr, E.StringReplace):
+        return back(S.replace(sval(expr.children[0]),
+                              expr.search.encode("utf-8"),
+                              expr.replacement.encode("utf-8")))
+    if isinstance(expr, E.Like):
+        s = sval(expr.children[0])
+        dfa = RX.like_to_dfa(expr.pattern, expr.escape)
+        return ColVal(RX.match_strings(dfa, s.data, s.offsets), s.validity)
+    if isinstance(expr, E.RLike):
+        s = sval(expr.children[0])
+        dfa = RX.compile_rlike(expr.pattern)
+        return ColVal(RX.match_strings(dfa, s.data, s.offsets), s.validity)
+    if isinstance(expr, E.StringInstr):
+        s = sval(expr.children[0])
+        return ColVal(S.first_match_pos(s, expr.substr.encode("utf-8")),
+                      s.validity)
+    if isinstance(expr, E.StringLocate):
+        s = sval(expr.children[0])
+        if expr.start < 1:
+            # Spark: locate with start < 1 returns 0
+            return ColVal(jnp.zeros((ctx.capacity,), jnp.int32), s.validity)
+        return ColVal(
+            S.first_match_pos(s, expr.substr.encode("utf-8"), expr.start),
+            s.validity,
+        )
+    if isinstance(expr, E.StringLPad):  # covers StringRPad
+        return back(S.pad(sval(expr.children[0]), max(expr.length, 0),
+                          expr.pad.encode("utf-8"), left=expr.side_left))
+    if isinstance(expr, E.StringRepeat):
+        return back(S.repeat(sval(expr.children[0]), expr.times))
+    if isinstance(expr, E.StringReverse):
+        return back(S.reverse(sval(expr.children[0])))
+    if isinstance(expr, E.StringTranslate):
+        return back(S.translate(sval(expr.children[0]),
+                                expr.matching.encode("utf-8"),
+                                expr.replace.encode("utf-8")))
+    if isinstance(expr, E.InitCap):
+        return back(S.initcap(sval(expr.children[0])))
+    if isinstance(expr, E.SubstringIndex):
+        return back(S.substring_index(sval(expr.children[0]),
+                                      expr.delim.encode("utf-8"), expr.count))
+    if isinstance(expr, E.Ascii):
+        s = sval(expr.children[0])
+        return ColVal(S.ascii_code(s), s.validity)
+    if isinstance(expr, E.Chr):
+        v = eval_expr(expr.children[0], ctx)
+        assert isinstance(v, ColVal)
+        return back(S.chr_of(v.data, v.validity))
+    return None
 
 
 def _eval_arith(expr: E.BinaryArithmetic, ctx: EvalContext) -> ColVal:

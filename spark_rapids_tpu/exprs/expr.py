@@ -642,6 +642,222 @@ class Substring(Expression):
         return T.STRING
 
 
+def Left(child: Expression, n: int) -> Substring:
+    return Substring(child, 1, max(n, 0))
+
+
+def Right(child: Expression, n: int) -> Substring:
+    return Substring(child, -n, n) if n > 0 else Substring(child, 1, 0)
+
+
+class _StringParams(Expression):
+    """Base for string expressions with non-child (literal) parameters.
+
+    Subclasses set ``self.children`` and ``self._params``; ``_rebuild``
+    reconstructs them generically as ``cls(*children, *params)``.
+    """
+
+    _params: tuple = ()
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class Concat(_StringParams):
+    """concat(...): null if any input is null (Spark semantics)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+
+class ConcatWs(_StringParams):
+    """concat_ws(sep, ...): skips nulls, never null (sep is a literal)."""
+
+    def __init__(self, *children: Expression, sep: str = ""):
+        self.children = tuple(children)
+        self.sep = sep
+        self._params = ()
+
+    @property
+    def nullable(self):
+        return False
+
+    # sep is a keyword: rebuild by hand
+    def _rebuilt(self, new_children):
+        return ConcatWs(*new_children, sep=self.sep)
+
+
+class StringTrim(_StringParams):
+    side = "both"
+
+    def __init__(self, child: Expression, trim_str: Optional[str] = None):
+        self.children = (child,)
+        self.trim_str = trim_str
+        self._params = (trim_str,)
+
+
+class StringTrimLeft(StringTrim):
+    side = "left"
+
+
+class StringTrimRight(StringTrim):
+    side = "right"
+
+
+class StringReplace(_StringParams):
+    def __init__(self, child: Expression, search: str, replacement: str):
+        self.children = (child,)
+        self.search = search
+        self.replacement = replacement
+        self._params = (search, replacement)
+
+
+class Like(Expression):
+    """SQL LIKE with literal pattern; compiled to a DFA on device."""
+
+    def __init__(self, child: Expression, pattern: str, escape: str = "\\"):
+        self.children = (child,)
+        self.pattern = pattern
+        self.escape = escape
+        self._params = (pattern, escape)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class RLike(Expression):
+    """Java-regex RLIKE (find semantics) with literal pattern."""
+
+    def __init__(self, child: Expression, pattern: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self._params = (pattern,)
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class RegexpExtract(_StringParams):
+    """regexp_extract — group extraction is CPU-fallback in round 1
+    (reference transpiles to cudf extract; our DFA engine has no capture
+    groups yet)."""
+
+    device_supported = False
+
+    def __init__(self, child: Expression, pattern: str, group: int = 1):
+        self.children = (child,)
+        self.pattern = pattern
+        self.group = group
+        self._params = (pattern, group)
+
+
+class RegexpReplace(_StringParams):
+    """regexp_replace — CPU fallback in round 1 (needs match extents)."""
+
+    device_supported = False
+
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        self.children = (child,)
+        self.pattern = pattern
+        self.replacement = replacement
+        self._params = (pattern, replacement)
+
+
+class StringInstr(Expression):
+    """instr(str, substr-literal): 1-based byte position, 0 = not found."""
+
+    def __init__(self, child: Expression, substr: str):
+        self.children = (child,)
+        self.substr = substr
+        self._params = (substr,)
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class StringLocate(Expression):
+    """locate(substr-literal, str, start): like instr with a start offset."""
+
+    def __init__(self, child: Expression, substr: str, start: int = 1):
+        self.children = (child,)
+        self.substr = substr
+        self.start = start
+        self._params = (substr, start)
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class StringLPad(_StringParams):
+    side_left = True
+
+    def __init__(self, child: Expression, length: int, pad: str = " "):
+        self.children = (child,)
+        self.length = length
+        self.pad = pad
+        self._params = (length, pad)
+
+
+class StringRPad(StringLPad):
+    side_left = False
+
+
+class StringRepeat(_StringParams):
+    def __init__(self, child: Expression, times: int):
+        self.children = (child,)
+        self.times = times
+        self._params = (times,)
+
+
+class StringReverse(_StringParams):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+
+class StringTranslate(_StringParams):
+    def __init__(self, child: Expression, matching: str, replace: str):
+        self.children = (child,)
+        self.matching = matching
+        self.replace = replace
+        self._params = (matching, replace)
+
+
+class InitCap(_StringParams):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+
+class SubstringIndex(_StringParams):
+    def __init__(self, child: Expression, delim: str, count: int):
+        self.children = (child,)
+        self.delim = delim
+        self.count = count
+        self._params = (delim, count)
+
+
+class Ascii(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class Chr(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
 # --- aggregate functions (consumed by exec/aggregate.py) ---
 class AggregateExpression(Expression):
     """Marker base; these only appear inside aggregation execs
@@ -767,6 +983,10 @@ def _rebuild(expr: Expression, new_children: List[Expression]) -> Expression:
         return Count(new_children[0] if new_children else None)
     if isinstance(expr, Coalesce):
         return Coalesce(*new_children)
+    if hasattr(expr, "_rebuilt"):
+        return expr._rebuilt(new_children)
+    if getattr(expr, "_params", ()):
+        return cls(*new_children, *expr._params)
     if not new_children:
         return expr
     return cls(*new_children)

@@ -1,0 +1,58 @@
+"""Segmented composition scan for per-byte finite-state transforms.
+
+The TPU-idiomatic primitive behind the regex engine and the sequential
+string kernels (greedy non-overlapping replace, substring_index): instead of
+walking each string's bytes serially (reference: cudf string kernels walk
+chars per thread), we express the per-byte state transition as a *function
+table* ``f_i: state -> state`` and compose them with
+``jax.lax.associative_scan`` — O(log n) depth, fully parallel, and the state
+domain stays tiny (DFA states / countdown values), so the [nbytes, S] working
+set is HBM-friendly.
+
+Segment (= row) boundaries are handled with the standard segmented-scan
+trick: each element carries a reset flag; composition discards everything
+before the latest reset.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segmented_compose(fns: jax.Array, resets: jax.Array) -> jax.Array:
+    """Inclusive segmented function-composition scan.
+
+    Args:
+      fns: ``uint8/int32 [n, S]``; ``fns[i, s]`` = state after applying
+        position ``i``'s transition to incoming state ``s``.
+      resets: ``bool [n]``; True where a new segment starts — the carried-in
+        composition is discarded *before* applying position ``i``.
+
+    Returns:
+      ``h [n, S]`` where ``h[i]`` is the composition of the current segment's
+      transitions up to and including position ``i``.
+    """
+
+    def combine(a, b):
+        fa, ra = a
+        fb, rb = b
+        composed = jnp.take_along_axis(fb, fa.astype(jnp.int32), axis=-1)
+        h = jnp.where(rb[..., None], fb, composed)
+        return h.astype(fns.dtype), ra | rb
+
+    h, _ = jax.lax.associative_scan(combine, (fns, resets), axis=0)
+    return h
+
+
+def exclusive_states(h: jax.Array, resets: jax.Array, start_state: int) -> jax.Array:
+    """Per-position state *before* consuming that position's byte.
+
+    ``h`` is the inclusive scan from :func:`segmented_compose`; the incoming
+    state at position ``i`` is ``h[i-1][start]`` unless ``i`` starts a
+    segment, where it is ``start``.
+    """
+    n = h.shape[0]
+    prev_end = jnp.roll(h[:, start_state], 1)
+    prev_end = prev_end.at[0].set(start_state)
+    return jnp.where(resets, jnp.int32(start_state), prev_end.astype(jnp.int32))

@@ -1,0 +1,488 @@
+"""Device string kernels over Arrow-layout columns.
+
+TPU analogs of the cudf string kernels the reference calls through
+``ai.rapids.cudf.ColumnVector`` (reference: stringFunctions.scala dispatches
+~40 string expressions to cudf; SURVEY.md §2.11 item 1). Instead of per-row
+thread loops, every kernel here is expressed over the *flat byte buffer*:
+compute per-row output lengths, prefix-sum them into offsets, then build the
+output bytes with one vectorized gather/select — all static-shaped so XLA
+can fuse and tile.
+
+Sequential-per-row semantics (greedy non-overlapping replace,
+substring_index occurrence counting) use the segmented function-composition
+scan from segscan.py with a small countdown-state domain.
+
+Byte-level semantics: correct for ASCII and for any UTF-8 data in kernels
+that only copy whole rows or split on ASCII delimiters; case mapping is
+ASCII-only (matches the reference's documented Latin behavior for upper/
+lower fast paths).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.exprs.segscan import exclusive_states, segmented_compose
+
+
+class StringVal(NamedTuple):
+    """A string-typed expression value on device (Arrow layout).
+
+    This is THE string value type for the whole expression engine —
+    eval.py imports it from here.
+    """
+
+    data: jax.Array     # uint8 bytes
+    offsets: jax.Array  # int32 (capacity+1,)
+    validity: jax.Array
+
+
+SVal = StringVal
+
+
+def row_ids(offsets: jax.Array, nbytes: int) -> jax.Array:
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    return jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+
+
+def make_offsets(out_len: jax.Array) -> jax.Array:
+    return jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(out_len).astype(jnp.int32)]
+    )
+
+
+def lengths(s: SVal) -> jax.Array:
+    return (s.offsets[1:] - s.offsets[:-1]).astype(jnp.int32)
+
+
+def _gather_bytes(src: SVal, out_off: jax.Array, src_start: jax.Array,
+                  nbytes_out: int) -> jax.Array:
+    """out[i] = src bytes starting at src_start[row] for each output row."""
+    rows = row_ids(out_off, nbytes_out)
+    rel = jnp.arange(nbytes_out, dtype=jnp.int32) - out_off[rows]
+    idx = jnp.clip(src_start[rows] + rel, 0, max(src.data.shape[0] - 1, 0))
+    if src.data.shape[0] == 0:
+        return jnp.zeros((nbytes_out,), jnp.uint8)
+    return src.data[idx]
+
+
+# --------------------------------------------------------------------------
+# concat / concat_ws
+# --------------------------------------------------------------------------
+
+
+def concat2(a: SVal, b: SVal) -> SVal:
+    """Spark ``concat``: null if either side is null."""
+    la, lb = lengths(a), lengths(b)
+    valid = a.validity & b.validity
+    out_len = jnp.where(valid, la + lb, 0)
+    off = make_offsets(out_len)
+    nbytes = a.data.shape[0] + b.data.shape[0]
+    if nbytes == 0:
+        return SVal(jnp.zeros(0, jnp.uint8), off, valid)
+    rows = row_ids(off, nbytes)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - off[rows]
+    from_a = rel < la[rows]
+    ia = jnp.clip(a.offsets[rows] + rel, 0, max(a.data.shape[0] - 1, 0))
+    ib = jnp.clip(b.offsets[rows] + rel - la[rows], 0, max(b.data.shape[0] - 1, 0))
+    da = a.data[ia] if a.data.shape[0] else jnp.zeros(nbytes, jnp.uint8)
+    db = b.data[ib] if b.data.shape[0] else jnp.zeros(nbytes, jnp.uint8)
+    return SVal(jnp.where(from_a, da, db), off, valid)
+
+
+def concat_ws(sep: bytes, vals: Sequence[SVal]) -> SVal:
+    """Spark ``concat_ws``: skips null children, never returns null."""
+    cap = vals[0].validity.shape[0]
+    sep_arr = np.frombuffer(sep, np.uint8)
+    m = len(sep_arr)
+    acc = SVal(
+        jnp.zeros(0, jnp.uint8),
+        jnp.zeros(cap + 1, jnp.int32),
+        jnp.ones(cap, jnp.bool_),
+    )
+    has_any = jnp.zeros(cap, jnp.bool_)
+    for v in vals:
+        la = lengths(acc)
+        lv = lengths(v)
+        add_sep = v.validity & has_any
+        out_len = la + jnp.where(add_sep, m, 0) + jnp.where(v.validity, lv, 0)
+        off = make_offsets(out_len)
+        nbytes = acc.data.shape[0] + cap * m + v.data.shape[0]
+        if nbytes == 0:
+            acc = SVal(jnp.zeros(0, jnp.uint8), off, acc.validity)
+        else:
+            rows = row_ids(off, nbytes)
+            rel = jnp.arange(nbytes, dtype=jnp.int32) - off[rows]
+            sep_len = jnp.where(add_sep, m, 0)
+            in_acc = rel < la[rows]
+            in_sep = ~in_acc & (rel < la[rows] + sep_len[rows])
+            ia = jnp.clip(acc.offsets[rows] + rel, 0, max(acc.data.shape[0] - 1, 0))
+            iv = jnp.clip(
+                v.offsets[rows] + rel - la[rows] - sep_len[rows],
+                0, max(v.data.shape[0] - 1, 0),
+            )
+            da = acc.data[ia] if acc.data.shape[0] else jnp.zeros(nbytes, jnp.uint8)
+            dv = v.data[iv] if v.data.shape[0] else jnp.zeros(nbytes, jnp.uint8)
+            if m:
+                ds = jnp.asarray(sep_arr)[jnp.clip(rel - la[rows], 0, m - 1)]
+            else:
+                ds = jnp.zeros(nbytes, jnp.uint8)
+            out = jnp.where(in_acc, da, jnp.where(in_sep, ds, dv))
+            acc = SVal(out, off, acc.validity)
+        has_any = has_any | v.validity
+    return acc
+
+
+# --------------------------------------------------------------------------
+# trim family
+# --------------------------------------------------------------------------
+
+
+def trim(s: SVal, chars: bytes, left: bool, right: bool) -> SVal:
+    lut = np.zeros(256, bool)
+    for b in chars:
+        lut[b] = True
+    lens = lengths(s)
+    cap = lens.shape[0]
+    nbytes = s.data.shape[0]
+    if nbytes == 0:
+        return s
+    in_set = jnp.asarray(lut)[s.data.astype(jnp.int32)]
+    rows = row_ids(s.offsets, nbytes)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - s.offsets[rows]
+    in_row = rel < lens[rows]
+    big = jnp.int32(1 << 30)
+    bad_pos = jnp.where(~in_set & in_row, rel, big)
+    first_bad = jax.ops.segment_min(bad_pos, rows, num_segments=cap,
+                                    indices_are_sorted=True)
+    last_bad = jax.ops.segment_max(
+        jnp.where(~in_set & in_row, rel, -1), rows, num_segments=cap,
+        indices_are_sorted=True,
+    )
+    # empty segments return identities (max int / min int); normalize
+    lead = jnp.where(first_bad >= big, lens, first_bad.astype(jnp.int32))
+    last_keep = jnp.clip(last_bad, -1, lens - 1)
+    start = lead if left else jnp.zeros_like(lens)
+    end = (last_keep + 1) if right else lens
+    out_len = jnp.maximum(end - start, 0)
+    off = make_offsets(out_len)
+    out = _gather_bytes(s, off, s.offsets[:-1] + start, nbytes)
+    return SVal(out, off, s.validity)
+
+
+# --------------------------------------------------------------------------
+# replace (greedy, non-overlapping, literal)
+# --------------------------------------------------------------------------
+
+
+def _literal_match_starts(s: SVal, needle: np.ndarray) -> jax.Array:
+    """bool[nbytes]: a needle occurrence starts here (within one row)."""
+    nbytes = s.data.shape[0]
+    m = len(needle)
+    lens = lengths(s)
+    match = jnp.ones((nbytes,), jnp.bool_)
+    for j, ch in enumerate(needle):
+        shifted = jnp.roll(s.data, -j)
+        match = match & (shifted == np.uint8(ch)) & (
+            jnp.arange(nbytes, dtype=jnp.int32) + j < nbytes
+        )
+    rows = row_ids(s.offsets, nbytes)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - s.offsets[rows]
+    return match & (rel <= lens[rows] - m)
+
+
+def _greedy_takes(s: SVal, match: jax.Array, m: int):
+    """Left-to-right non-overlapping selection of matches of length ``m``.
+
+    Countdown automaton with states 0..m-1 (0 = free), run via the segmented
+    composition scan: at each byte, if busy count down; else if a match
+    starts here, become busy for m-1 more bytes.
+
+    Returns ``(take, covered)``: where selected matches start, and which
+    bytes fall inside a selected match.
+    """
+    nbytes = s.data.shape[0]
+    if m <= 1:
+        return match, match
+    # countdown states must not wrap: uint8 only when m fits
+    state_dtype = jnp.uint8 if m <= 255 else jnp.int32
+    states = jnp.arange(m, dtype=jnp.int32)  # [S]
+    busy_next = jnp.maximum(states - 1, 0)
+    fns = jnp.where(
+        states[None, :] > 0,
+        busy_next[None, :],
+        jnp.where(match[:, None], m - 1, 0),
+    ).astype(state_dtype)
+    resets = jnp.zeros((nbytes,), jnp.bool_)
+    starts = s.offsets[:-1]
+    resets = resets.at[jnp.where(starts < nbytes, starts, 0)].set(True)
+    h = segmented_compose(fns, resets)
+    c_in = exclusive_states(h, resets, 0)
+    take = match & (c_in == 0)
+    covered = take | (c_in > 0)
+    return take, covered
+
+
+def replace(s: SVal, search: bytes, repl: bytes) -> SVal:
+    """Spark ``replace(str, search, replace)`` with literal arguments."""
+    if len(search) == 0:
+        return s
+    needle = np.frombuffer(search, np.uint8)
+    rep = np.frombuffer(repl, np.uint8)
+    m, r = len(needle), len(rep)
+    nbytes = s.data.shape[0]
+    cap = s.validity.shape[0]
+    if nbytes == 0:
+        return s
+    match = _literal_match_starts(s, needle)
+    take, covered = _greedy_takes(s, match, m)
+    rows = row_ids(s.offsets, nbytes)
+    take_i = take.astype(jnp.int32)
+    surv = (~covered).astype(jnp.int32)
+    cum_t = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(take_i)])
+    cum_s = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(surv)])
+    row_t0 = cum_t[s.offsets[:-1]]
+    row_s0 = cum_s[s.offsets[:-1]]
+    lens = lengths(s)
+    n_takes = cum_t[jnp.clip(s.offsets[1:], 0, nbytes)] - row_t0
+    n_surv = cum_s[jnp.clip(s.offsets[1:], 0, nbytes)] - row_s0
+    out_len = n_surv + n_takes * r
+    off = make_offsets(out_len)
+    factor = max(1, -(-r // m))  # ceil(r/m): worst-case growth
+    nbytes_out = nbytes * factor
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    takes_before = cum_t[pos] - row_t0[rows]   # takes strictly before i
+    surv_before = cum_s[pos] - row_s0[rows]
+    out = jnp.zeros((nbytes_out,), jnp.uint8)
+    # scatter surviving input bytes (index nbytes_out = dropped)
+    out_pos = off[rows] + surv_before + takes_before * r
+    scatter_pos = jnp.where(~covered, out_pos, nbytes_out)
+    out = out.at[scatter_pos].set(s.data, mode="drop")
+    # scatter replacement bytes at each taken match
+    for j in range(r):
+        rpos = jnp.where(take, off[rows] + surv_before + takes_before * r + j,
+                         nbytes_out)
+        out = out.at[rpos].set(np.uint8(rep[j]), mode="drop")
+    return SVal(out, off, s.validity)
+
+
+# --------------------------------------------------------------------------
+# find: instr / locate
+# --------------------------------------------------------------------------
+
+
+def first_match_pos(s: SVal, needle_bytes: bytes, from_pos: int = 1) -> jax.Array:
+    """1-based position of first occurrence at/after ``from_pos``; 0 if none.
+
+    Byte positions (== char positions for ASCII).
+    """
+    cap = s.validity.shape[0]
+    needle = np.frombuffer(needle_bytes, np.uint8)
+    lens = lengths(s)
+    if len(needle) == 0:
+        # Spark: instr(s, '') = 1; locate('', s, p) = p clamped-ish (1 if p<=1)
+        return jnp.where(lens >= 0, jnp.int32(max(from_pos, 1)), 0)
+    nbytes = s.data.shape[0]
+    if nbytes == 0:
+        return jnp.zeros((cap,), jnp.int32)
+    match = _literal_match_starts(s, needle)
+    rows = row_ids(s.offsets, nbytes)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - s.offsets[rows]
+    big = jnp.int32(1 << 30)
+    ok = match & (rel >= from_pos - 1)
+    pos = jnp.where(ok, rel, big)
+    first = jax.ops.segment_min(pos, rows, num_segments=cap,
+                                indices_are_sorted=True)
+    return jnp.where(first >= big, 0, first.astype(jnp.int32) + 1)
+
+
+# --------------------------------------------------------------------------
+# pad / repeat / reverse / translate / initcap / case
+# --------------------------------------------------------------------------
+
+
+def pad(s: SVal, target: int, pad_bytes: bytes, left: bool) -> SVal:
+    lens = lengths(s)
+    p = np.frombuffer(pad_bytes, np.uint8)
+    plen = len(p)
+    if plen == 0:
+        out_len = jnp.minimum(lens, target)
+    else:
+        out_len = jnp.where(s.validity, jnp.int32(target), 0)
+        out_len = jnp.where(lens >= target, jnp.int32(target), out_len)
+    out_len = jnp.where(s.validity, out_len, 0)
+    off = make_offsets(out_len)
+    cap = lens.shape[0]
+    nbytes_out = cap * max(target, 1)
+    rows = row_ids(off, nbytes_out)
+    rel = jnp.arange(nbytes_out, dtype=jnp.int32) - off[rows]
+    n_pad = jnp.maximum(out_len - jnp.minimum(lens, target), 0)
+    if left:
+        in_pad = rel < n_pad[rows]
+        src_rel = rel - n_pad[rows]
+    else:
+        in_pad = rel >= jnp.minimum(lens, target)[rows]
+        src_rel = rel
+    src = jnp.clip(s.offsets[rows] + src_rel, 0, max(s.data.shape[0] - 1, 0))
+    d_src = s.data[src] if s.data.shape[0] else jnp.zeros(nbytes_out, jnp.uint8)
+    if plen:
+        pad_rel = jnp.where(left, rel, rel - jnp.minimum(lens, target)[rows])
+        d_pad = jnp.asarray(p)[jnp.clip(pad_rel, 0, None) % plen]
+        out = jnp.where(in_pad, d_pad, d_src)
+    else:
+        out = d_src
+    return SVal(out, off, s.validity)
+
+
+def repeat(s: SVal, n: int) -> SVal:
+    n = max(n, 0)
+    lens = lengths(s)
+    out_len = lens * n
+    off = make_offsets(out_len)
+    nbytes_out = s.data.shape[0] * max(n, 1)
+    if nbytes_out == 0 or n == 0:
+        return SVal(jnp.zeros(0, jnp.uint8), make_offsets(jnp.zeros_like(lens)),
+                    s.validity)
+    rows = row_ids(off, nbytes_out)
+    rel = jnp.arange(nbytes_out, dtype=jnp.int32) - off[rows]
+    safe_len = jnp.maximum(lens[rows], 1)
+    src = jnp.clip(s.offsets[rows] + rel % safe_len, 0, s.data.shape[0] - 1)
+    return SVal(s.data[src], off, s.validity)
+
+
+def reverse(s: SVal) -> SVal:
+    """Byte-order reverse (exact for ASCII; reference cudf reverses chars)."""
+    lens = lengths(s)
+    nbytes = s.data.shape[0]
+    if nbytes == 0:
+        return s
+    rows = row_ids(s.offsets, nbytes)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - s.offsets[rows]
+    src = jnp.clip(s.offsets[rows] + lens[rows] - 1 - rel, 0, nbytes - 1)
+    return SVal(s.data[src], s.offsets, s.validity)
+
+
+def translate(s: SVal, frm: bytes, to: bytes) -> SVal:
+    """Per-byte remap; from-chars beyond len(to) are deleted (Spark semantics)."""
+    lut_map = np.arange(256, dtype=np.int32)   # -1 = delete
+    seen = set()
+    for i, b in enumerate(frm):
+        if b in seen:
+            continue
+        seen.add(b)
+        lut_map[b] = to[i] if i < len(to) else -1
+    nbytes = s.data.shape[0]
+    if nbytes == 0:
+        return s
+    mapped = jnp.asarray(lut_map)[s.data.astype(jnp.int32)]
+    keep = mapped >= 0
+    rows = row_ids(s.offsets, nbytes)
+    lens = lengths(s)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - s.offsets[rows]
+    in_row = rel < lens[rows]
+    keep = keep & in_row
+    cum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                           jnp.cumsum(keep.astype(jnp.int32))])
+    row0 = cum[s.offsets[:-1]]
+    out_len = cum[jnp.clip(s.offsets[1:], 0, nbytes)] - row0
+    off = make_offsets(out_len)
+    out_pos = off[rows] + (cum[jnp.arange(nbytes)] - row0[rows])
+    out = jnp.zeros((nbytes,), jnp.uint8)
+    out = out.at[jnp.where(keep, out_pos, nbytes)].set(
+        mapped.astype(jnp.uint8), mode="drop")
+    return SVal(out, off, s.validity)
+
+
+def initcap(s: SVal) -> SVal:
+    nbytes = s.data.shape[0]
+    if nbytes == 0:
+        return s
+    d = s.data
+    is_upper = (d >= ord("A")) & (d <= ord("Z"))
+    is_lower = (d >= ord("a")) & (d <= ord("z"))
+    lowered = jnp.where(is_upper, d + 32, d)
+    rows = row_ids(s.offsets, nbytes)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - s.offsets[rows]
+    prev = jnp.roll(d, 1)
+    word_start = (rel == 0) | (prev == ord(" "))
+    upped = jnp.where(is_lower, d - 32, d)
+    out = jnp.where(word_start, upped, lowered).astype(jnp.uint8)
+    return SVal(out, s.offsets, s.validity)
+
+
+# --------------------------------------------------------------------------
+# substring_index
+# --------------------------------------------------------------------------
+
+
+def substring_index(s: SVal, delim: bytes, count: int) -> SVal:
+    if count == 0 or len(delim) == 0:
+        lens = lengths(s)
+        off = make_offsets(jnp.zeros_like(lens))
+        return SVal(jnp.zeros(0, jnp.uint8), off, s.validity)
+    needle = np.frombuffer(delim, np.uint8)
+    m = len(needle)
+    nbytes = s.data.shape[0]
+    cap = s.validity.shape[0]
+    lens = lengths(s)
+    if nbytes == 0:
+        return s
+    match = _literal_match_starts(s, needle)
+    take, _ = _greedy_takes(s, match, m)
+    rows = row_ids(s.offsets, nbytes)
+    rel = jnp.arange(nbytes, dtype=jnp.int32) - s.offsets[rows]
+    take_i = take.astype(jnp.int32)
+    cum = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(take_i)])
+    row0 = cum[s.offsets[:-1]]
+    total = cum[jnp.clip(s.offsets[1:], 0, nbytes)] - row0
+    rank = cum[jnp.arange(nbytes) + 1] - row0[rows]  # 1-based at take positions
+    big = jnp.int32(1 << 30)
+    if count > 0:
+        # cut before the count-th occurrence; whole string if fewer
+        cut_pos = jax.ops.segment_min(
+            jnp.where(take & (rank == count), rel, big), rows,
+            num_segments=cap, indices_are_sorted=True)
+        out_len = jnp.where(cut_pos >= big, lens, cut_pos.astype(jnp.int32))
+        start = jnp.zeros_like(lens)
+    else:
+        k = count  # negative
+        want = total + k + 1  # 1-based rank of the cut occurrence
+        cut_pos = jax.ops.segment_min(
+            jnp.where(take & (rank == want[rows]), rel, big), rows,
+            num_segments=cap, indices_are_sorted=True)
+        has = (total + k + 1) >= 1
+        start = jnp.where(has & (cut_pos < big),
+                          cut_pos.astype(jnp.int32) + m, 0)
+        out_len = lens - start
+    off = make_offsets(jnp.where(s.validity, out_len, 0))
+    out = _gather_bytes(s, off, s.offsets[:-1] + start, nbytes)
+    return SVal(out, off, s.validity)
+
+
+# --------------------------------------------------------------------------
+# ascii / chr
+# --------------------------------------------------------------------------
+
+
+def ascii_code(s: SVal) -> jax.Array:
+    lens = lengths(s)
+    nbytes = s.data.shape[0]
+    if nbytes == 0:
+        return jnp.zeros_like(lens)
+    first = s.data[jnp.clip(s.offsets[:-1], 0, nbytes - 1)].astype(jnp.int32)
+    return jnp.where(lens > 0, first, 0)
+
+
+def chr_of(codes: jax.Array, validity: jax.Array) -> SVal:
+    cap = codes.shape[0]
+    n = codes.astype(jnp.int64)
+    byte = (n % 256).astype(jnp.uint8)
+    out_len = jnp.where(validity & (n >= 0), 1, 0).astype(jnp.int32)
+    off = make_offsets(out_len)
+    rows = row_ids(off, cap)
+    out = byte[jnp.clip(rows, 0, cap - 1)]
+    return SVal(out, off, validity)

@@ -57,6 +57,10 @@ _DEVICE_EXPRS = (
     E.DateAdd, E.DateSub, E.DateDiff,
     E.Length, E.Upper, E.Lower, E.StartsWith, E.EndsWith, E.Contains,
     E.Substring,
+    E.Concat, E.ConcatWs, E.StringTrim, E.StringReplace, E.Like, E.RLike,
+    E.StringInstr, E.StringLocate, E.StringLPad, E.StringRepeat,
+    E.StringReverse, E.StringTranslate, E.InitCap, E.SubstringIndex,
+    E.Ascii, E.Chr,
     E.Sum, E.Count, E.Min, E.Max, E.Average, E.First, E.Last,
 )
 
@@ -72,7 +76,9 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
     reasons: List[str] = []
 
     def walk(e: E.Expression):
-        if not isinstance(e, _DEVICE_EXPRS):
+        if not isinstance(e, _DEVICE_EXPRS) or not getattr(
+            e, "device_supported", True
+        ):
             reasons.append(f"expression {type(e).__name__} not on device")
             return
         try:
@@ -85,6 +91,19 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
                                   E.GreaterThan, E.GreaterThanOrEqual)):
                 if bound.left.dtype in (T.STRING, T.BINARY):
                     reasons.append("string ordering comparison not on device")
+            # probe regex compilability (reference: RegexParser transpiler
+            # bail-outs -> willNotWorkOnGpu); patterns outside the DFA
+            # subset fall back to CPU
+            if isinstance(bound, (E.Like, E.RLike)):
+                from spark_rapids_tpu.exprs import regex as RX
+
+                try:
+                    if isinstance(bound, E.Like):
+                        RX.like_to_dfa(bound.pattern, bound.escape)
+                    else:
+                        RX.compile_rlike(bound.pattern)
+                except RX.RegexUnsupported as rex:
+                    reasons.append(f"regex not on device: {rex}")
         except (TypeError, KeyError, NotImplementedError) as ex:
             reasons.append(str(ex))
         for c in e.children:
