@@ -12,6 +12,12 @@ from spark_rapids_tpu.exec.project import FilterExec, ProjectExec  # noqa: F401
 from spark_rapids_tpu.exec.aggregate import HashAggregateExec  # noqa: F401
 from spark_rapids_tpu.exec.sort import SortExec, SortOrder  # noqa: F401
 from spark_rapids_tpu.exec.join import HashJoinExec  # noqa: F401
+from spark_rapids_tpu.exec.join_bcast import (  # noqa: F401
+    BroadcastHashJoinExec,
+    BroadcastNestedLoopJoinExec,
+    CartesianProductExec,
+    SubPartitionHashJoinExec,
+)
 from spark_rapids_tpu.exec.scan import ParquetScanExec  # noqa: F401
 from spark_rapids_tpu.exec.misc import (  # noqa: F401
     CoalesceBatchesExec,

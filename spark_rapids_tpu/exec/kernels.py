@@ -101,21 +101,51 @@ def gather_batch(
 _SIGN64 = np.uint64(1) << np.uint64(63)
 
 
-def _float_sortable(data: jax.Array) -> jax.Array:
-    """IEEE total order with Spark semantics: NaN greater than everything,
-    all NaN payloads equal, -0.0 == 0.0."""
+def _u64_from_words(x: jax.Array) -> jax.Array:
+    """Assemble uint64 from a 64-bit-typed array via two u32 words.
+
+    The real-TPU backend (axon) cannot rewrite 64-bit bitcast_convert HLOs,
+    but N-bit -> 32-bit-word bitcasts are supported; reassembling with shifts
+    keeps every path off the unimplemented op."""
+    w = jax.lax.bitcast_convert_type(x, jnp.uint32)  # (..., 2), [lo, hi]
+    return (w[..., 1].astype(jnp.uint64) << jnp.uint64(32)) | w[..., 0].astype(
+        jnp.uint64)
+
+
+def _float_canonical(data: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(canonical value, is_nan): all NaNs collapse to 0.0 + flag, -0.0 ->
+    +0.0. Spark float ordering/equality treats all NaNs as one value greater
+    than everything and -0.0 == 0.0.
+
+    IMPORTANT real-TPU constraint: the axon backend implements float64 as a
+    float32 double-double, so f64 *bit patterns* do not exist on device and
+    values beyond float32 range saturate. Every float kernel therefore works
+    on canonical VALUES (+ a NaN flag), never on IEEE bit encodings."""
     d = data.astype(jnp.float64)
-    # canonicalize: all NaNs -> one positive qNaN; -0.0 -> +0.0
-    d = jnp.where(jnp.isnan(d), jnp.float64(np.nan), d)
-    d = jnp.where(d == 0.0, jnp.float64(0.0), d)
-    bits = jax.lax.bitcast_convert_type(d, jnp.int64)
-    u = jax.lax.bitcast_convert_type(d, jnp.uint64)
-    return jnp.where(bits < 0, ~u, u | jnp.uint64(_SIGN64))
+    is_nan = jnp.isnan(d)
+    d = jnp.where(is_nan, jnp.float64(0.0), d)
+    d = jnp.where(d == 0.0, jnp.float64(0.0), d)  # -0.0 -> +0.0
+    return d, is_nan
+
+
+def _float_hash_key(data: jax.Array) -> jax.Array:
+    """Deterministic uint64 hash key for a float column: the two float32
+    words of the device double-double (exact: hi = round-to-f32, lo =
+    residual), bitcast through the supported 32-bit path. Equal canonical
+    values always produce equal keys; hash collisions are resolved by the
+    exact verification pass."""
+    d, is_nan = _float_canonical(data)
+    hi = d.astype(jnp.float32)
+    lo = (d - hi.astype(jnp.float64)).astype(jnp.float32)
+    uhi = jax.lax.bitcast_convert_type(hi, jnp.uint32).astype(jnp.uint64)
+    ulo = jax.lax.bitcast_convert_type(lo, jnp.uint32).astype(jnp.uint64)
+    u = (uhi << jnp.uint64(32)) | ulo
+    return jnp.where(is_nan, jnp.uint64(0x7FF8DEAD7F4A7C15), u)
 
 
 def _int_sortable(data: jax.Array) -> jax.Array:
     x = data.astype(jnp.int64)
-    return jax.lax.bitcast_convert_type(x, jnp.uint64) ^ jnp.uint64(_SIGN64)
+    return _u64_from_words(x) ^ jnp.uint64(_SIGN64)
 
 
 def string_prefix_keys(col: DeviceColumn) -> List[jax.Array]:
@@ -151,21 +181,32 @@ def sortable_keys(
         nulls_first = ascending
     dt = col.dtype
     if dt in (T.STRING, T.BINARY):
-        data_keys = string_prefix_keys(col)  # [hi_word, lo_word]? build lo-first
-        data_keys = [data_keys[1], data_keys[0]]
+        pk = string_prefix_keys(col)  # [hi_word, lo_word]; emit lo-first
+        data_keys = [pk[1], pk[0]]
+        if not ascending:
+            data_keys = [~k for k in data_keys]
     elif dt in T.FRACTIONAL_TYPES:
-        data_keys = [_float_sortable(col.data)]
+        # float order rides the VALUE itself (a NaN flag key above it makes
+        # NaN greater than everything) — no f64 bit encoding exists on the
+        # real-TPU backend (float64 there is a float32 double-double)
+        d, is_nan = _float_canonical(col.data)
+        nan_key = is_nan.astype(jnp.int32)
+        if not ascending:
+            d = -d
+            nan_key = 1 - nan_key
+        data_keys = [d, nan_key]
     elif dt == T.BOOLEAN:
-        data_keys = [col.data.astype(jnp.uint64)]
+        k = col.data.astype(jnp.int32)
+        data_keys = [(1 - k) if not ascending else k]
     else:
-        data_keys = [_int_sortable(col.data)]
-    if not ascending:
-        data_keys = [~k for k in data_keys]
-    # neutralize data key for nulls so ties are broken deterministically
-    data_keys = [jnp.where(col.validity, k, jnp.uint64(0)) for k in data_keys]
-    null_key = jnp.where(col.validity, jnp.uint64(1), jnp.uint64(0))
+        k = _int_sortable(col.data)
+        data_keys = [~k if not ascending else k]
+    # neutralize data keys for nulls so ties are broken deterministically
+    data_keys = [jnp.where(col.validity, k, jnp.zeros_like(k))
+                 for k in data_keys]
+    null_key = jnp.where(col.validity, jnp.int32(1), jnp.int32(0))
     if not nulls_first:
-        null_key = ~null_key
+        null_key = 1 - null_key
     return data_keys + [null_key]
 
 
@@ -252,8 +293,8 @@ def hash_keys(batch: ColumnarBatch, key_cols: Sequence[int]) -> jax.Array:
         if col.offsets is not None:
             ch = _string_hash(col)
         elif col.dtype in T.FRACTIONAL_TYPES:
-            # hash the canonical sortable form so NaN==NaN, -0.0==0.0
-            ch = _splitmix64(_float_sortable(col.data))
+            # hash the canonical value words so NaN==NaN, -0.0==0.0
+            ch = _splitmix64(_float_hash_key(col.data))
         else:
             ch = _splitmix64(_int_sortable(col.data))
         ch = jnp.where(col.validity, ch, jnp.uint64(0xDEADBEEFCAFEBABE))
@@ -277,7 +318,10 @@ def keys_equal(
         if ca.offsets is not None:
             ceq = _string_eq_at(ca, a_idx, cb, b_idx)
         elif ca.dtype in T.FRACTIONAL_TYPES:
-            ceq = _float_sortable(ca.data)[a_idx] == _float_sortable(cb.data)[b_idx]
+            da, na = _float_canonical(ca.data)
+            db, nb = _float_canonical(cb.data)
+            ceq = ((da[a_idx] == db[b_idx]) & ~na[a_idx] & ~nb[b_idx]) | (
+                na[a_idx] & nb[b_idx])
         else:
             da = ca.data[a_idx]
             db = cb.data[b_idx]
@@ -414,19 +458,26 @@ def segment_agg(
         return jax.ops.segment_sum(v, seg, num_segments=num_segments), any_valid
     if op in ("min", "max"):
         if jnp.issubdtype(values.dtype, jnp.floating):
-            # NaN-aware: encode to sortable, reduce, decode
-            enc = _float_sortable(values)
-            ident = jnp.uint64(0) if op == "max" else jnp.uint64(0xFFFFFFFFFFFFFFFF)
-            enc = jnp.where(live, enc, ident)
+            # NaN-aware on VALUES (Spark: NaN greater than everything): clean
+            # reduce with +/-inf identity, then splice NaN segments back in
+            d, is_nan = _float_canonical(values)
+            live_clean = live & ~is_nan
+            ident = jnp.float64(-np.inf if op == "max" else np.inf)
+            v = jnp.where(live_clean, d, ident)
             red = (jax.ops.segment_max if op == "max" else jax.ops.segment_min)(
-                enc, seg, num_segments=num_segments
+                v, seg, num_segments=num_segments
             )
-            dec = jnp.where(
-                red >= jnp.uint64(_SIGN64),
-                jax.lax.bitcast_convert_type(red ^ jnp.uint64(_SIGN64), jnp.float64),
-                jax.lax.bitcast_convert_type(~red, jnp.float64),
-            ).astype(values.dtype)
-            return dec, any_valid
+            nan_any = jax.ops.segment_max(
+                (live & is_nan).astype(jnp.int32), seg,
+                num_segments=num_segments) > 0
+            clean_any = jax.ops.segment_max(
+                live_clean.astype(jnp.int32), seg,
+                num_segments=num_segments) > 0
+            if op == "max":
+                dec = jnp.where(nan_any, jnp.float64(np.nan), red)
+            else:
+                dec = jnp.where(clean_any, red, jnp.float64(np.nan))
+            return dec.astype(values.dtype), any_valid
         ii = jnp.iinfo(values.dtype if values.dtype != jnp.bool_ else jnp.int8)
         if values.dtype == jnp.bool_:
             v = values.astype(jnp.int8)

@@ -16,9 +16,10 @@ from functools import partial
 from typing import Iterator, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, bucket_capacity
 from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
 from spark_rapids_tpu.exec import kernels as K
 from spark_rapids_tpu.exec.aggregate import concat_jit
@@ -45,13 +46,21 @@ class SortOrder:
 class SortExec(UnaryExec):
     """Sorts each partition (total order per partition).
 
-    A global sort is a range-shuffle (shuffle/) followed by this."""
+    A global sort is a range-shuffle (shuffle/) followed by this.
+    ``out_of_core=True`` switches to the chunked external sort
+    (GpuOutOfCoreSortIterator analog): each input batch is sorted as a run,
+    runs are held spillable, and output batches are produced by boundary
+    splitting + merge so no step needs the whole partition in HBM."""
 
     def __init__(self, orders: Sequence[SortOrder], child: TpuExec,
-                 each_batch: bool = False):
+                 each_batch: bool = False, out_of_core: bool = False,
+                 target_rows: int = 1 << 17, spill_framework=None):
         super().__init__(child)
         self.orders = list(orders)
         self.each_batch = each_batch
+        self.out_of_core = out_of_core
+        self.target_rows = target_rows
+        self.spill_framework = spill_framework
         self._prepared = False
         self._register_metric("sortTimeNs")
 
@@ -84,9 +93,155 @@ class SortExec(UnaryExec):
                 with self.timer("sortTimeNs"):
                     yield self._run(b)
             return
+        if self.out_of_core:
+            yield from OutOfCoreSortIterator(
+                self.child.execute(partition), tuple(self._specs),
+                self.target_rows, self.spill_framework)
+            return
         batches = list(self.child.execute(partition))
         if not batches:
             return
         with self.timer("sortTimeNs"):
             whole = batches[0] if len(batches) == 1 else concat_jit(batches)
             yield self._run(whole)
+
+
+# ---------------------------------------------------------------------------
+# Out-of-core sort (GpuSortExec.scala:281-411, GpuOutOfCoreSortIterator)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=1)
+def _run_boundary_keys(batch: ColumnarBatch, spec):
+    """Coarse primary-order key triple for the FIRST sort spec,
+    most-significant first. Any most-significant prefix of the lexsort key
+    sequence is a valid coarsening of the total order, so splitting the
+    stream at such a boundary preserves global order across emitted batches;
+    full order within a batch comes from the final lexsort. Keys are native
+    dtypes (float value keys, int32 flags, uint64 string prefixes)."""
+    keys = K.sortable_keys(batch.columns[spec.column], spec.ascending,
+                           spec.nulls_first)
+    rev = list(reversed(keys))  # most significant first
+    while len(rev) < 3:
+        rev.append(jnp.zeros(batch.capacity, jnp.int32))
+    return tuple(rev[:3])
+
+
+class _SortRun:
+    """One sorted run: device batch (optionally spillable) + consumed offset."""
+
+    def __init__(self, batch: ColumnarBatch, keys, framework):
+        self.offset = 0
+        self.n = int(batch.num_rows)
+        self.keys = keys  # boundary key triple, most significant first
+        if framework is not None:
+            from spark_rapids_tpu.mem.spill import SpillableBatch
+            self.handle = SpillableBatch(batch, framework)
+            self.batch = None
+        else:
+            self.handle = None
+            self.batch = batch
+
+    def get(self) -> ColumnarBatch:
+        return self.handle.get() if self.handle is not None else self.batch
+
+    def unpin(self):
+        if self.handle is not None:
+            self.handle.unpin()
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+
+
+class OutOfCoreSortIterator:
+    """Chunked external sort: sort each input batch into a run, then emit
+    globally-ordered output batches by picking a boundary key = min over runs
+    of each run's t-th remaining key, taking every remaining row <= boundary
+    from every run, and lexsorting that bounded merge set."""
+
+    def __init__(self, source, specs, target_rows: int, framework):
+        self.source = source
+        self.specs = specs
+        self.target_rows = max(int(target_rows), 1)
+        self.framework = framework
+
+    def __iter__(self) -> Iterator[ColumnarBatch]:
+        runs: List[_SortRun] = []
+        for b in self.source:
+            sb = _sort_run(b, self.specs)
+            keys = _run_boundary_keys(sb, self.specs[0])
+            runs.append(_SortRun(sb, keys, self.framework))
+        runs = [r for r in runs if r.n > 0]
+        if not runs:
+            return
+        t = max(self.target_rows // len(runs), 1)
+        while runs:
+            # boundary = min over runs of the t-th remaining key triple; the
+            # host compare only SELECTS the boundary run — the boundary
+            # scalars stay on device so comparisons are exact even where the
+            # device float representation (double-double on real TPU) does
+            # not round-trip through host float64
+            bounds = []
+            for r in runs:
+                j = min(r.offset + t - 1, r.n - 1)
+                bounds.append((tuple(k[j].item() for k in r.keys), r, j))
+            _, rb, jb = min(bounds, key=lambda x: x[0])
+            bvals = tuple(k[jb] for k in rb.keys)
+            pieces = []
+            for r in runs:
+                c = int(_count_le(r.keys, r.offset, r.n, bvals))
+                if c > 0:
+                    batch = r.get()
+                    # exact byte needs per string column keep emitted pieces
+                    # truly bounded (no full-run byte buffers riding along)
+                    bcaps = tuple(
+                        bucket_capacity(
+                            max(int(col.offsets[r.offset + c]
+                                    - col.offsets[r.offset]), 8), 8)
+                        if col.offsets is not None else 0
+                        for col in batch.columns)
+                    pieces.append(_slice_rows(batch, jnp.int32(r.offset),
+                                              jnp.int32(c), _cap(c), bcaps))
+                    r.unpin()
+                    r.offset += c
+            runs_left = []
+            for r in runs:
+                if r.offset >= r.n:
+                    r.close()
+                else:
+                    runs_left.append(r)
+            runs = runs_left
+            if not pieces:
+                continue  # cannot happen (boundary includes >= t rows)
+            merged = pieces[0] if len(pieces) == 1 else concat_jit(pieces)
+            yield _sort_run(merged, self.specs)
+
+
+def _cap(n: int) -> int:
+    return bucket_capacity(n, 16)
+
+
+@jax.jit
+def _count_le(keys, offset, n, bounds):
+    """Rows in [offset, n) whose key triple is lexicographically <= bounds."""
+    (k0, k1, k2), (b0, b1, b2) = keys, bounds
+    i = jnp.arange(k0.shape[0])
+    live = (i >= offset) & (i < n)
+    le = ((k0 < b0)
+          | ((k0 == b0) & (k1 < b1))
+          | ((k0 == b0) & (k1 == b1) & (k2 <= b2)))
+    return jnp.sum((live & le).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _slice_rows(batch: ColumnarBatch, start, count, cap: int, byte_caps):
+    """Slice rows [start, start+count) into a cap-capacity batch. Only the
+    capacity buckets are static — start/count are traced, so all slices of a
+    capacity bucket share one compiled kernel."""
+    idx = jnp.arange(cap, dtype=jnp.int32) + start
+    idx = jnp.clip(idx, 0, batch.capacity - 1)
+    row_valid = jnp.arange(cap, dtype=jnp.int32) < count
+    cols = [K.gather_column(c, idx, row_valid, byte_caps[i] or None)
+            for i, c in enumerate(batch.columns)]
+    return ColumnarBatch(cols, count.astype(jnp.int32))

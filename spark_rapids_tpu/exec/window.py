@@ -23,6 +23,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
@@ -318,22 +319,29 @@ class WindowExec(UnaryExec):
                      gather_at, idx):
         op = jnp.minimum if isinstance(f, E.Min) else jnp.maximum
         if jnp.issubdtype(vals.dtype, jnp.floating):
-            enc = K._float_sortable(vals)
-            ident = (jnp.uint64(0xFFFFFFFFFFFFFFFF) if isinstance(f, E.Min)
-                     else jnp.uint64(0))
-            eop = jnp.minimum if isinstance(f, E.Min) else jnp.maximum
-            m = jnp.where(valid & active, enc, ident)
-            red = _segmented_scan(m, seg_flag, eop)
+            # NaN-aware on values (no f64 bit encodings on the real-TPU
+            # backend): scan clean values with an inf identity and scan a
+            # NaN-seen flag alongside; Spark orders NaN above everything
+            d, is_nan = K._float_canonical(vals)
+            live_clean = valid & active & ~is_nan
+            ident = jnp.float64(np.inf if isinstance(f, E.Min) else -np.inf)
+            m = jnp.where(live_clean, d, ident)
+            red = _segmented_scan(m, seg_flag, op)
+            nan_seen = _segmented_scan(
+                (valid & active & is_nan).astype(jnp.int32), seg_flag,
+                jnp.maximum) > 0
+            clean_seen = _segmented_scan(
+                live_clean.astype(jnp.int32), seg_flag, jnp.maximum) > 0
             if gather_at is not None:
                 red = red[gather_at]
                 cnt = cnt[gather_at]
-            dec = jnp.where(
-                red >= jnp.uint64(1) << jnp.uint64(63),
-                jax.lax.bitcast_convert_type(
-                    red ^ (jnp.uint64(1) << jnp.uint64(63)), jnp.float64),
-                jax.lax.bitcast_convert_type(~red, jnp.float64),
-            ).astype(vals.dtype)
-            return _win_out(out_t, dec, cnt > 0, active)
+                nan_seen = nan_seen[gather_at]
+                clean_seen = clean_seen[gather_at]
+            if isinstance(f, E.Max):
+                dec = jnp.where(nan_seen, jnp.float64(np.nan), red)
+            else:
+                dec = jnp.where(clean_seen, red, jnp.float64(np.nan))
+            return _win_out(out_t, dec.astype(vals.dtype), cnt > 0, active)
         ii = jnp.iinfo(vals.dtype if vals.dtype != jnp.bool_ else jnp.int8)
         ident = ii.max if isinstance(f, E.Min) else ii.min
         m = jnp.where(valid & active, vals, jnp.full_like(vals, ident))
