@@ -147,6 +147,28 @@ def batch_from_arrow(
         if dt.fixed_width:
             values, valid = _arrow_fixed_to_numpy(arr, dt)
             cols.append(make_fixed_column(dt, values, valid, cap))
+        elif isinstance(dt, T.ArrayType):
+            valid = (None if arr.null_count == 0
+                     else np.asarray(arr.is_valid(), dtype=np.bool_))
+            raw_off = np.asarray(arr.offsets, dtype=np.int32)
+            offsets = raw_off - raw_off[0]
+            # arr.values (not flatten()): keeps elements spanned by null
+            # slots, so offsets and the element buffer stay aligned even for
+            # non-canonical Arrow producers
+            flat = arr.values.slice(int(raw_off[0]),
+                                    int(raw_off[-1] - raw_off[0]))
+            assert flat.null_count == 0, (
+                "element nulls in arrays not device-supported (CPU fallback)")
+            evalues, _ = _arrow_fixed_to_numpy(flat, dt.element)
+            ecap = bucket_capacity(max(len(evalues), 8), 8)
+            edata = np.zeros(ecap, dtype=T.numpy_dtype(dt.element))
+            edata[: len(evalues)] = evalues
+            off = np.full(cap + 1, offsets[-1], dtype=np.int32)
+            off[: n + 1] = offsets
+            validity = np.zeros(cap, dtype=np.bool_)
+            validity[:n] = True if valid is None else valid
+            cols.append(DeviceColumn(dt, jnp.asarray(edata),
+                                     jnp.asarray(validity), jnp.asarray(off)))
         else:
             sarr = arr.cast(pa.string()) if dt == T.STRING else arr.cast(pa.binary())
             valid = (
@@ -202,6 +224,19 @@ def batch_to_arrow(batch: ColumnarBatch, schema: T.Schema) -> pa.Table:
                 arr = arr.cast(pa.timestamp("us", tz="UTC"))
             else:
                 arr = pa.array(values, type=dt.arrow_type(), mask=mask)
+        elif isinstance(dt, T.ArrayType):
+            offsets = np.asarray(col.offsets)[: n + 1].astype(np.int32)
+            flat = np.asarray(col.data)[: int(offsets[-1]) if n else 0]
+            values = pa.array(flat, type=dt.element.arrow_type())
+            arr = pa.ListArray.from_arrays(
+                pa.array(offsets, pa.int32()), values)
+            if mask is not None:
+                # from_arrays has no mask param: rebuild with a validity buffer
+                arr = pa.Array.from_buffers(
+                    dt.arrow_type(), n,
+                    [_validity_buffer(valid_np),
+                     pa.py_buffer(offsets.tobytes())],
+                    children=[values])
         else:
             offsets = np.asarray(col.offsets)[: n + 1]
             data = np.asarray(col.data)[: int(offsets[-1]) if n else 0]

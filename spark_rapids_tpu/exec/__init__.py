@@ -24,6 +24,8 @@ from spark_rapids_tpu.exec.misc import (  # noqa: F401
     GlobalLimitExec,
     LocalLimitExec,
     RangeExec,
+    SampleExec,
     UnionExec,
     take_ordered_and_project,
 )
+from spark_rapids_tpu.exec.generate import GenerateExec  # noqa: F401

@@ -1,0 +1,169 @@
+"""Generate (explode/posexplode) over array columns.
+
+Reference: GpuGenerateExec (SURVEY.md §2.4) — explode expands each array
+element into its own output row, repeating the other columns; posexplode adds
+the element position; the *_outer variants emit one null-element row for
+empty/null arrays.
+
+TPU-first design: the output capacity is the (static) element-buffer capacity
+of the array column, so the whole expansion — per-row contribution lengths,
+generated offsets, row ids by searchsorted, element gather, repeated-column
+gather — is one fused XLA computation per capacity bucket. Exact string byte
+needs for the repeated columns are computed on device and pulled once to pick
+static byte capacities (same sizing discipline as the joins).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, bucket_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+from spark_rapids_tpu.exec import kernels as K
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exec.join import _pad_idx
+
+
+class GenerateExec(UnaryExec):
+    """explode / posexplode of one array column; other child columns repeat.
+
+    The generator input column is dropped from the output (Spark's
+    requiredChildOutput semantics); ``outer=True`` emits a null-element row
+    for null/empty arrays."""
+
+    def __init__(self, generator: E.Expression, child: TpuExec,
+                 outer: bool = False, position: bool = False,
+                 element_name: str = "col", pos_name: str = "pos"):
+        super().__init__(child)
+        self.generator = generator
+        self.outer = outer
+        self.position = position
+        self.element_name = element_name
+        self.pos_name = pos_name
+        self._prepared = False
+        self._register_metric("generateTimeNs")
+
+    def _prepare(self):
+        if self._prepared:
+            return
+        cs = self.child.output_schema
+        bound = E.resolve(self.generator, cs)
+        assert isinstance(bound, E.ColumnRef), (
+            "generator must be a column ref; plan layer pre-projects")
+        self._gen_idx = bound.index
+        gen_t = cs[self._gen_idx].dtype
+        assert isinstance(gen_t, T.ArrayType), f"explode needs array, got {gen_t}"
+        self._elem_t = gen_t.element
+        self._keep = [i for i in range(len(cs)) if i != self._gen_idx]
+        fields = [cs[i] for i in self._keep]
+        if self.position:
+            fields.append(T.Field(self.pos_name, T.INT, self.outer))
+        fields.append(T.Field(self.element_name, self._elem_t, True))
+        self._schema = T.Schema(fields)
+        self._prepared = True
+
+    @property
+    def output_schema(self) -> T.Schema:
+        self._prepare()
+        return self._schema
+
+    def node_description(self) -> str:
+        fn = "posexplode" if self.position else "explode"
+        return f"TpuGenerate {fn}{'_outer' if self.outer else ''}({self.generator!r})"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        self._prepare()
+        for b in self.child.execute(partition):
+            with self.timer("generateTimeNs"):
+                yield from self._generate(b)
+
+    def _generate(self, b: ColumnarBatch) -> Iterator[ColumnarBatch]:
+        gi = self._gen_idx
+        total, sbytes, n_outer = _gen_stats(b, gi, tuple(self._keep))
+        ecap = b.columns[gi].data.shape[0]
+        scaps = tuple(sorted(
+            (i, bucket_capacity(max(int(v), 8), 8))
+            for i, v in sbytes.items()))
+        out = _gen_expand(b, gi, tuple(self._keep), self.position, ecap, scaps)
+        yield out
+        if self.outer:
+            n = int(n_outer)
+            if n:
+                cap = bucket_capacity(n, 16)
+                yield _gen_outer(b, gi, tuple(self._keep), self.position,
+                                 cap, self._elem_t)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _gen_stats(b: ColumnarBatch, gi: int, keep):
+    """Total output elements, per-string-column byte needs, outer-row count."""
+    col = b.columns[gi]
+    lens = (col.offsets[1:] - col.offsets[:-1])
+    lens = jnp.where(col.validity & b.active_mask(), lens, 0)
+    total = jnp.sum(lens.astype(jnp.int64))
+    sbytes = {}
+    for i in keep:
+        c = b.columns[i]
+        if c.offsets is not None:
+            # same formula covers strings (bytes) and other array columns
+            # (element counts): per-row width times the explode fanout
+            sl = (c.offsets[1:] - c.offsets[:-1]).astype(jnp.int64)
+            sbytes[i] = jnp.sum(sl * lens.astype(jnp.int64))
+    n_outer = jnp.sum(((lens == 0) & b.active_mask()).astype(jnp.int32))
+    return total, sbytes, n_outer
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _gen_expand(b: ColumnarBatch, gi: int, keep, position: bool, ecap: int,
+                scap_items) -> ColumnarBatch:
+    scaps = dict(scap_items)
+    col = b.columns[gi]
+    raw_lens = col.offsets[1:] - col.offsets[:-1]
+    lens = jnp.where(col.validity & b.active_mask(), raw_lens, 0)
+    gen_off = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens).astype(jnp.int32)])
+    total = gen_off[-1]
+    pos_all = jnp.arange(ecap, dtype=jnp.int32)
+    in_range = pos_all < total
+    rows = jnp.clip(
+        jnp.searchsorted(gen_off, pos_all, side="right").astype(jnp.int32) - 1,
+        0, b.capacity - 1)
+    pos = pos_all - gen_off[rows]
+    src = jnp.clip(col.offsets[rows] + pos, 0, ecap - 1)
+    cols: List[DeviceColumn] = []
+    for i in keep:
+        cols.append(K.gather_column(b.columns[i], rows, in_range,
+                                    scaps.get(i)))
+    if position:
+        cols.append(DeviceColumn(
+            T.INT, jnp.where(in_range, pos, 0), in_range))
+    edata = jnp.where(in_range, col.data[src], jnp.zeros((), col.data.dtype))
+    cols.append(DeviceColumn(col.dtype.element, edata, in_range))
+    return ColumnarBatch(cols, total)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _gen_outer(b: ColumnarBatch, gi: int, keep, position: bool,
+               cap: int, elem_t) -> ColumnarBatch:
+    """One null-element row per null/empty array (outer variants)."""
+    col = b.columns[gi]
+    raw_lens = col.offsets[1:] - col.offsets[:-1]
+    lens = jnp.where(col.validity, raw_lens, 0)
+    want = (lens == 0) & b.active_mask()
+    idx, n = K.filter_indices(want, b.active_mask())
+    idx = _pad_idx(idx, cap)
+    row_valid = jnp.arange(cap, dtype=jnp.int32) < n
+    cols = [K.gather_column(b.columns[i], idx, row_valid) for i in keep]
+    if position:
+        cols.append(DeviceColumn(
+            T.INT, jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.bool_)))
+    cols.append(DeviceColumn(
+        elem_t, jnp.zeros(cap, T.numpy_dtype(elem_t)),
+        jnp.zeros(cap, jnp.bool_)))
+    return ColumnarBatch(cols, n.astype(jnp.int32))

@@ -211,6 +211,9 @@ class HashJoinExec(BinaryExec):
 
 
 def _pad_idx(idx: jax.Array, out_cap: int) -> jax.Array:
+    """Pad or truncate a compaction index vector to a static capacity."""
+    if idx.shape[0] >= out_cap:
+        return idx[:out_cap]
     pad = jnp.zeros(out_cap - idx.shape[0], jnp.int32)
     return jnp.concatenate([idx, pad])
 

@@ -32,7 +32,7 @@ from spark_rapids_tpu.columnar.batch import (
 from spark_rapids_tpu.exec.base import BatchSourceExec, BinaryExec, TpuExec
 from spark_rapids_tpu.exec import kernels as K
 from spark_rapids_tpu.exec.aggregate import concat_jit
-from spark_rapids_tpu.exec.join import HashJoinExec, _null_column
+from spark_rapids_tpu.exec.join import HashJoinExec, _null_column, _pad_idx
 from spark_rapids_tpu.exprs import expr as E
 from spark_rapids_tpu.exprs import eval as EV
 
@@ -245,14 +245,24 @@ def _nlj_verify(probe: ColumnarBatch, build: ColumnarBatch, start: int,
               & (bi < build.capacity)
               & build.active_mask()[bi_c])
     if cond_bound is not None:
-        # condition eval over the expanded tile: the tile repeats probe bytes
-        # `chunk` times and build-chunk bytes P times, so input byte capacity
-        # scaled by the fanout is an exact upper bound
+        # condition eval over the expanded tile: only columns the condition
+        # actually reads are gathered (unreferenced ones — often wide string
+        # payloads — become cheap null placeholders); the tile repeats probe
+        # bytes `chunk` times and build-chunk bytes P times, so input byte
+        # capacity scaled by the fanout is an exact upper bound
+        refs = set(E.referenced_columns(cond_bound))
         cols = []
         for i, c in enumerate(probe.columns):
+            if i not in refs:
+                cols.append(_null_column(c.dtype, P * chunk))
+                continue
             cap = c.data.shape[0] * chunk if c.offsets is not None else None
             cols.append(K.gather_column(c, pi, active, cap))
+        nl = len(probe.columns)
         for i, c in enumerate(build.columns):
+            if nl + i not in refs:
+                cols.append(_null_column(c.dtype, P * chunk))
+                continue
             cap = c.data.shape[0] * P if c.offsets is not None else None
             cols.append(K.gather_column(c, bi_c, active, cap))
         pair = ColumnarBatch(cols, jnp.int32(P * chunk))
@@ -276,8 +286,7 @@ def _nlj_gather(probe: ColumnarBatch, build: ColumnarBatch, ver: jax.Array,
                 start: int, chunk: int, out_cap: int, pcap_items, bcap_items):
     pcaps, bcaps = dict(pcap_items), dict(bcap_items)
     idx, n = K.filter_indices(ver, jnp.ones_like(ver))
-    idx = idx[:out_cap] if idx.shape[0] >= out_cap else jnp.concatenate(
-        [idx, jnp.zeros(out_cap - idx.shape[0], jnp.int32)])
+    idx = _pad_idx(idx, out_cap)
     pi = idx // chunk
     bi = jnp.clip(start + (idx % chunk), 0, build.capacity - 1)
     row_valid = jnp.arange(out_cap, dtype=jnp.int32) < n
@@ -326,8 +335,8 @@ class SubPartitionHashJoinExec(BinaryExec):
         return (f"TpuSubPartitionHashJoin {self.join_type} "
                 f"k={self.num_sub_partitions}")
 
-    def _bucketize(self, batches: List[ColumnarBatch], key_idx: Tuple[int, ...],
-                   schema: T.Schema) -> List[List[ColumnarBatch]]:
+    def _bucketize(self, batches: List[ColumnarBatch],
+                   key_idx: Tuple[int, ...]) -> List[List[ColumnarBatch]]:
         k = self.num_sub_partitions
         out: List[List[ColumnarBatch]] = [[] for _ in range(k)]
         for b in batches:
@@ -353,8 +362,8 @@ class SubPartitionHashJoinExec(BinaryExec):
         lk = tuple(self._template._lkeys)
         rk = tuple(self._template._rkeys)
         ls, rs = self.left.output_schema, self.right.output_schema
-        lbuckets = self._bucketize(list(self.left.execute(partition)), lk, ls)
-        rbuckets = self._bucketize(list(self.right.execute(partition)), rk, rs)
+        lbuckets = self._bucketize(list(self.left.execute(partition)), lk)
+        rbuckets = self._bucketize(list(self.right.execute(partition)), rk)
         for p in range(self.num_sub_partitions):
             sub = HashJoinExec(
                 self.left_keys, self.right_keys, self.join_type,
@@ -390,8 +399,7 @@ def _bucket_gather(batch: ColumnarBatch, hmod: jax.Array, p: int, cap: int,
     bcaps = dict(bcap_items)
     want = hmod == p
     idx, n = K.filter_indices(want, batch.active_mask())
-    idx = idx[:cap] if idx.shape[0] >= cap else jnp.concatenate(
-        [idx, jnp.zeros(cap - idx.shape[0], jnp.int32)])
+    idx = _pad_idx(idx, cap)
     row_valid = jnp.arange(cap, dtype=jnp.int32) < n
     cols = [K.gather_column(c, idx, row_valid, bcaps.get(i))
             for i, c in enumerate(batch.columns)]

@@ -75,7 +75,7 @@ def gather_column(
     src = col.offsets[safe_idx[rows]] + rel
     src = jnp.clip(src, 0, col.data.shape[0] - 1)
     in_range = jnp.arange(out_bytes, dtype=jnp.int32) < out_offsets[-1]
-    data = jnp.where(in_range, col.data[src], jnp.uint8(0))
+    data = jnp.where(in_range, col.data[src], jnp.zeros((), col.data.dtype))
     return DeviceColumn(col.dtype, data, validity, out_offsets)
 
 

@@ -7,6 +7,7 @@ GpuGlobalLimitExec / GpuTakeOrderedAndProjectExec), GpuRangeExec, UnionExec.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Iterator, List, Optional, Sequence
 
 import jax
@@ -21,6 +22,7 @@ from spark_rapids_tpu.exec import kernels as K
 from spark_rapids_tpu.exec.aggregate import concat_jit
 from spark_rapids_tpu.exec.sort import SortExec, SortOrder
 from spark_rapids_tpu.exec.project import ProjectExec
+from spark_rapids_tpu.exec.join import _pad_idx
 from spark_rapids_tpu.exprs import expr as E
 
 
@@ -126,6 +128,46 @@ class GlobalLimitExec(UnaryExec):
                 else:
                     yield _truncate(b, remaining)
                     return
+
+
+class SampleExec(UnaryExec):
+    """Seeded Bernoulli row sample (GpuSampleExec analog, without-replacement
+    path). Deterministic for a given (seed, partition, batch index): the mask
+    comes from a counter-based PRNG key folded with those coordinates, the
+    TPU-native analog of Spark's per-partition XORShift sampler."""
+
+    def __init__(self, fraction: float, seed: int, child: TpuExec):
+        super().__init__(child)
+        assert 0.0 <= fraction <= 1.0
+        self.fraction = fraction
+        self.seed = seed
+
+    def node_description(self) -> str:
+        return f"TpuSample {self.fraction} seed={self.seed}"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), partition)
+        for bi, b in enumerate(self.child.execute(partition)):
+            bkey = jax.random.fold_in(key, bi)
+            keep, n = _sample_mask(b, bkey, self.fraction)
+            cap = bucket_capacity(max(int(n), 1), 16)
+            yield _sample_gather(b, keep, cap)
+
+
+@jax.jit
+def _sample_mask(b: ColumnarBatch, key, fraction):
+    u = jax.random.uniform(key, (b.capacity,))
+    keep = (u < fraction) & b.active_mask()
+    return keep, jnp.sum(keep.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnums=2)
+def _sample_gather(b: ColumnarBatch, keep, cap: int):
+    idx, n = K.filter_indices(keep, b.active_mask())
+    idx = _pad_idx(idx, cap)
+    row_valid = jnp.arange(cap, dtype=jnp.int32) < n
+    cols = [K.gather_column(c, idx, row_valid) for c in b.columns]
+    return ColumnarBatch(cols, n.astype(jnp.int32))
 
 
 def take_ordered_and_project(orders: Sequence[SortOrder], limit: int,

@@ -95,6 +95,22 @@ def _lit(v) -> Expression:
     return Literal.of(v)
 
 
+def referenced_columns(expr: Expression) -> Tuple[int, ...]:
+    """Sorted column indices a BOUND expression reads (for operators that
+    materialize only the inputs an expression needs, e.g. join conditions
+    over expanded pair tiles)."""
+    out = set()
+
+    def walk(e: Expression):
+        if isinstance(e, ColumnRef):
+            out.add(e.index)
+        for c in e.children:
+            walk(c)
+
+    walk(expr)
+    return tuple(sorted(out))
+
+
 @dataclasses.dataclass(frozen=True, repr=False, eq=False)
 class ColumnRef(Expression):
     """Reference to an input column by ordinal (bound) with known type."""

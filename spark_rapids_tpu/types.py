@@ -237,6 +237,37 @@ class NullType(DataType):
         return pa.null()
 
 
+@dataclasses.dataclass(frozen=True, eq=False)
+class ArrayType(DataType):
+    """Array of fixed-width elements, Arrow list layout: a flat element
+    buffer + int32 row offsets (same offsets representation as strings).
+
+    Round-1 device support covers fixed-width, non-null elements (the common
+    explode/posexplode input); nested/element-null arrays fall back to CPU —
+    mirroring the reference's incremental nested-type support
+    (GpuColumnVector.java typeConversionAllowed)."""
+
+    element: DataType = None  # type: ignore[assignment]
+    contains_null: bool = False
+
+    @property
+    def name(self):  # type: ignore[override]
+        return f"array<{self.element.name}>"
+
+    @property
+    def fixed_width(self):
+        return False
+
+    def jnp_dtype(self):
+        return self.element.jnp_dtype()
+
+    def arrow_type(self):
+        return pa.list_(self.element.arrow_type())
+
+    def __repr__(self):
+        return self.name
+
+
 # Singletons (Spark-style)
 BOOLEAN = BooleanType()
 BYTE = ByteType()
@@ -344,6 +375,11 @@ def from_arrow_type(t: pa.DataType) -> DataType:
         return BINARY
     if pa.types.is_null(t):
         return NULL
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        elem = from_arrow_type(t.value_type)
+        if not elem.fixed_width:
+            raise NotImplementedError("nested variable-width arrays")
+        return ArrayType(elem)
     raise NotImplementedError(f"arrow type {t}")
 
 
