@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import dataclasses
+from collections import deque
 from typing import Iterator, List, Optional, Sequence
 
 import pyarrow as pa
@@ -29,6 +30,142 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, batch_from_arrow
 from spark_rapids_tpu.exec.base import LeafExec
 from spark_rapids_tpu.exprs import expr as E
+
+
+def windowed_map(pool, fn, items, window: int):
+    """pool.map with a bounded in-flight window: keeps reads overlapped with
+    consumption without materializing every decoded table."""
+    items = iter(items)
+    inflight = deque()
+    try:
+        for it in items:
+            inflight.append(pool.submit(fn, it))
+            if len(inflight) >= window:
+                yield inflight.popleft().result()
+        while inflight:
+            yield inflight.popleft().result()
+    finally:
+        for f in inflight:
+            f.cancel()
+
+
+class FileScanBase(LeafExec):
+    """Base for single-format file scans: subclasses provide
+    ``_read_path(path) -> pa.Table`` and ``_read_schema() -> pa.Schema``;
+    finer-than-file work splitting (e.g. parquet row groups) overrides
+    ``_partition_items``/``_read_item`` instead. The base owns the
+    scanTimeNs timer around ``_read_item``."""
+
+    def __init__(self, paths: Sequence[str],
+                 columns: Optional[Sequence[str]] = None,
+                 reader_type: str = "MULTITHREADED",
+                 reader_threads: int = 8,
+                 target_batch_rows: int = 1 << 20,
+                 n_partitions: int = 1,
+                 min_bucket: int = 1024):
+        super().__init__()
+        assert reader_type in ("PERFILE", "MULTITHREADED", "COALESCING")
+        self.paths = list(paths)
+        self.columns = list(columns) if columns is not None else None
+        self.reader_type = reader_type
+        self.reader_threads = reader_threads
+        self.target_batch_rows = target_batch_rows
+        self.n_partitions = n_partitions
+        self.min_bucket = min_bucket
+        self._schema: Optional[T.Schema] = None
+        self._register_metric("scanTimeNs")
+        self._register_metric("uploadTimeNs")
+
+    # subclass surface -----------------------------------------------------
+    def _read_schema(self) -> pa.Schema:
+        raise NotImplementedError
+
+    def _read_path(self, path: str) -> pa.Table:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------------------
+    @property
+    def output_schema(self) -> T.Schema:
+        if self._schema is None:
+            arrow_schema = self._read_schema()
+            if self.columns is not None:
+                arrow_schema = pa.schema(
+                    [arrow_schema.field(c) for c in self.columns])
+            self._schema = T.Schema.from_arrow(arrow_schema)
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return self.n_partitions
+
+    def node_description(self) -> str:
+        cols = f" columns={self.columns}" if self.columns else ""
+        return (f"Tpu{type(self).__name__} [{len(self.paths)} files,"
+                f" {self.reader_type}]{cols}")
+
+    def _files_for_partition(self, partition: int) -> List[str]:
+        return [p for i, p in enumerate(self.paths)
+                if i % self.n_partitions == partition]
+
+    def _project(self, t: pa.Table) -> pa.Table:
+        schema = self.output_schema.to_arrow()
+        # select first: pa.Table.cast cannot reorder fields (e.g. json files
+        # whose keys appear in different orders)
+        t = t.select(schema.names)
+        return t.cast(schema)
+
+    # work-splitting hooks: default = one item per file
+    def _partition_items(self, partition: int) -> List:
+        return self._files_for_partition(partition)
+
+    def _read_item(self, item) -> pa.Table:
+        return self._read_path(item)
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        items = self._partition_items(partition)
+        if not items:
+            return
+        # resolve the schema once on the caller thread: schema-inferring
+        # subclasses would otherwise race to parse the first file in every
+        # pool worker
+        _ = self.output_schema
+
+        def read(it):
+            with self.timer("scanTimeNs"):
+                return self._project(self._read_item(it))
+
+        if self.reader_type == "PERFILE":
+            yield from self.upload_batched(map(read, items))
+        elif self.reader_type == "MULTITHREADED":
+            with cf.ThreadPoolExecutor(self.reader_threads) as pool:
+                yield from self.upload_batched(
+                    windowed_map(pool, read, items,
+                                 window=self.reader_threads * 2))
+        else:  # COALESCING
+            whole = pa.concat_tables(read(it) for it in items)
+            yield from self.upload_batched(iter([whole]))
+
+    def upload_batched(self, tables) -> Iterator[ColumnarBatch]:
+        """Re-chunk host tables to target_batch_rows and upload each once."""
+        pending: List[pa.Table] = []
+        pending_rows = 0
+        for t in tables:
+            pending.append(t)
+            pending_rows += t.num_rows
+            while pending_rows >= self.target_batch_rows:
+                whole = pa.concat_tables(pending)
+                head = whole.slice(0, self.target_batch_rows)
+                rest = whole.slice(self.target_batch_rows)
+                with self.timer("uploadTimeNs"):
+                    yield batch_from_arrow(head, self.min_bucket)
+                pending = [rest] if rest.num_rows else []
+                pending_rows = rest.num_rows
+        if pending_rows > 0:
+            with self.timer("uploadTimeNs"):
+                yield batch_from_arrow(pa.concat_tables(pending),
+                                       self.min_bucket)
+
+
+
 
 
 @dataclasses.dataclass
@@ -88,27 +225,7 @@ def _col_lit(expr: E.BinaryComparison):
     return None, None, False
 
 
-def _windowed_map(pool, fn, items, window: int):
-    """pool.map with a bounded in-flight window: keeps reads overlapped with
-    consumption without materializing every decoded table (the reference's
-    multithreaded reader similarly caps in-flight host buffers)."""
-    from collections import deque
-
-    items = iter(items)
-    inflight = deque()
-    try:
-        for it in items:
-            inflight.append(pool.submit(fn, it))
-            if len(inflight) >= window:
-                yield inflight.popleft().result()
-        while inflight:
-            yield inflight.popleft().result()
-    finally:
-        for f in inflight:
-            f.cancel()
-
-
-class ParquetScanExec(LeafExec):
+class ParquetScanExec(FileScanBase):
     """Scan parquet files into device batches.
 
     Files are split across ``n_partitions``; within a partition, the reader
@@ -118,40 +235,14 @@ class ParquetScanExec(LeafExec):
     def __init__(self, paths: Sequence[str],
                  columns: Optional[Sequence[str]] = None,
                  predicate: Optional[E.Expression] = None,
-                 reader_type: str = "MULTITHREADED",
-                 reader_threads: int = 8,
-                 target_batch_rows: int = 1 << 20,
-                 n_partitions: int = 1,
-                 min_bucket: int = 1024):
-        super().__init__()
-        assert reader_type in ("PERFILE", "MULTITHREADED", "COALESCING")
-        self.paths = list(paths)
-        self.columns = list(columns) if columns is not None else None
+                 **kw):
+        super().__init__(paths, columns, **kw)
         self.predicate = predicate
-        self.reader_type = reader_type
-        self.reader_threads = reader_threads
-        self.target_batch_rows = target_batch_rows
-        self.n_partitions = n_partitions
-        self.min_bucket = min_bucket
-        self._schema: Optional[T.Schema] = None
         self._register_metric("numRowGroups")
         self._register_metric("numPrunedRowGroups")
-        self._register_metric("scanTimeNs")
-        self._register_metric("uploadTimeNs")
 
-    @property
-    def output_schema(self) -> T.Schema:
-        if self._schema is None:
-            arrow_schema = pq.read_schema(self.paths[0])
-            if self.columns is not None:
-                arrow_schema = pa.schema(
-                    [arrow_schema.field(c) for c in self.columns]
-                )
-            self._schema = T.Schema.from_arrow(arrow_schema)
-        return self._schema
-
-    def num_partitions(self) -> int:
-        return self.n_partitions
+    def _read_schema(self) -> pa.Schema:
+        return pq.read_schema(self.paths[0])
 
     def node_description(self) -> str:
         cols = f" columns={self.columns}" if self.columns else ""
@@ -160,8 +251,7 @@ class ParquetScanExec(LeafExec):
 
     # -- planning ----------------------------------------------------------
     def _tasks_for_partition(self, partition: int) -> List[RowGroupTask]:
-        files = [p for i, p in enumerate(self.paths)
-                 if i % self.n_partitions == partition]
+        files = self._files_for_partition(partition)
         tasks = []
         for path in files:
             md = pq.ParquetFile(path).metadata
@@ -187,43 +277,11 @@ class ParquetScanExec(LeafExec):
                 stats_by_col[name] = (st.min, st.max)
         return not _stats_may_match(self.predicate, stats_by_col)
 
-    # -- reading -----------------------------------------------------------
-    def _read_task(self, task: RowGroupTask) -> pa.Table:
+    # -- reading: base dispatch over row-group tasks -----------------------
+    def _partition_items(self, partition: int) -> List[RowGroupTask]:
+        return self._tasks_for_partition(partition)
+
+    def _read_item(self, task: RowGroupTask) -> pa.Table:
         f = pq.ParquetFile(task.path)
         return f.read_row_groups(task.row_groups, columns=self.columns,
                                  use_threads=False)
-
-    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
-        tasks = self._tasks_for_partition(partition)
-        if not tasks:
-            return
-        if self.reader_type == "PERFILE":
-            yield from self._upload(map(self._read_task, tasks))
-        elif self.reader_type == "MULTITHREADED":
-            with cf.ThreadPoolExecutor(self.reader_threads) as pool:
-                yield from self._upload(
-                    _windowed_map(pool, self._read_task, tasks,
-                                  window=self.reader_threads * 2)
-                )
-        else:  # COALESCING: one logical read of everything, then re-chunk
-            with self.timer("scanTimeNs"):
-                whole = pa.concat_tables(self._read_task(t) for t in tasks)
-            yield from self._upload(iter([whole]))
-
-    def _upload(self, tables) -> Iterator[ColumnarBatch]:
-        pending: List[pa.Table] = []
-        pending_rows = 0
-        for t in tables:
-            pending.append(t)
-            pending_rows += t.num_rows
-            while pending_rows >= self.target_batch_rows:
-                whole = pa.concat_tables(pending)
-                head = whole.slice(0, self.target_batch_rows)
-                rest = whole.slice(self.target_batch_rows)
-                with self.timer("uploadTimeNs"):
-                    yield batch_from_arrow(head, self.min_bucket)
-                pending = [rest] if rest.num_rows else []
-                pending_rows = rest.num_rows
-        if pending_rows > 0:
-            with self.timer("uploadTimeNs"):
-                yield batch_from_arrow(pa.concat_tables(pending), self.min_bucket)

@@ -1,0 +1,26 @@
+"""I/O layer (SURVEY.md §2.7): file-format scans beyond parquet, columnar
+writers with dynamic partitioning, async write throttling, and a local range
+file cache.
+
+All decode/encode work is host-side (CPU threadpools), mirroring the
+reference's design of acquiring the device only after host buffers are ready
+(GpuParquetScan.scala:2266); the device is touched only for the final upload.
+"""
+
+from spark_rapids_tpu.io.csv import CsvScanExec  # noqa: F401
+from spark_rapids_tpu.io.json import JsonScanExec  # noqa: F401
+from spark_rapids_tpu.io.orc import OrcScanExec  # noqa: F401
+from spark_rapids_tpu.io.avro import AvroScanExec  # noqa: F401
+from spark_rapids_tpu.io.writer import (  # noqa: F401
+    CsvWriter,
+    OrcWriter,
+    ParquetWriter,
+    WriteStats,
+    write_columnar,
+)
+from spark_rapids_tpu.io.async_write import (  # noqa: F401
+    AsyncOutputStream,
+    HostMemoryThrottle,
+    TrafficController,
+)
+from spark_rapids_tpu.io.filecache import FileCache  # noqa: F401

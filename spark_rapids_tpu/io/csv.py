@@ -1,0 +1,73 @@
+"""CSV scan with Spark-compatible parsing options.
+
+Reference: GpuCSVScan.scala (439 LoC) + GpuTextBasedPartitionReader — cudf
+CSV decode with custom Spark timestamp/date handling. Here Arrow C++ does
+the host decode; Spark option names (sep, header, nullValue, comment,
+quote, escape) map onto Arrow parse/convert options, and an explicit schema
+gives Spark's permissive-mode column typing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+
+from spark_rapids_tpu.exec.scan import FileScanBase
+
+
+class CsvScanExec(FileScanBase):
+    def __init__(self, paths: Sequence[str],
+                 schema: Optional[pa.Schema] = None,
+                 columns: Optional[Sequence[str]] = None,
+                 sep: str = ",", header: bool = True,
+                 null_value: str = "", comment: str = "",
+                 quote: str = '"', escape: str = "\\",
+                 timestamp_format: Optional[str] = None,
+                 **kw):
+        super().__init__(paths, columns, **kw)
+        self.user_schema = schema
+        self.sep = sep
+        self.header = header
+        self.null_value = null_value
+        self.comment = comment
+        self.quote = quote
+        self.escape = escape
+        self.timestamp_format = timestamp_format
+
+    def _parse_opts(self):
+        return pacsv.ParseOptions(
+            delimiter=self.sep,
+            quote_char=self.quote,
+            escape_char=self.escape if self.escape else False,
+        )
+
+    def _read_opts(self):
+        if self.header or self.user_schema is None:
+            return pacsv.ReadOptions()
+        return pacsv.ReadOptions(column_names=[f.name for f in
+                                               self.user_schema])
+
+    def _convert_opts(self):
+        kw = dict(null_values=[self.null_value],
+                  strings_can_be_null=True,
+                  quoted_strings_can_be_null=True)
+        if self.user_schema is not None:
+            kw["column_types"] = {f.name: f.type for f in self.user_schema}
+        if self.timestamp_format:
+            kw["timestamp_parsers"] = [self.timestamp_format]
+        return pacsv.ConvertOptions(**kw)
+
+    def _read_schema(self) -> pa.Schema:
+        if self.user_schema is not None:
+            return self.user_schema
+        return self._read_path(self.paths[0]).schema
+
+    def _read_path(self, path: str) -> pa.Table:
+        return pacsv.read_csv(
+            path,
+            read_options=self._read_opts(),
+            parse_options=self._parse_opts(),
+            convert_options=self._convert_opts(),
+        )

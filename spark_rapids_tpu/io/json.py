@@ -1,0 +1,36 @@
+"""JSON-lines scan.
+
+Reference: GpuJsonScan / GpuJsonReadCommon (via jni JSONUtils). Arrow C++
+does the host decode of newline-delimited JSON; an explicit schema pins
+column types (Spark's from_json/read.json with schema), otherwise types are
+inferred from the first file.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.json as pajson
+
+from spark_rapids_tpu.exec.scan import FileScanBase
+
+
+class JsonScanExec(FileScanBase):
+    def __init__(self, paths: Sequence[str],
+                 schema: Optional[pa.Schema] = None,
+                 columns: Optional[Sequence[str]] = None,
+                 **kw):
+        super().__init__(paths, columns, **kw)
+        self.user_schema = schema
+
+    def _read_schema(self) -> pa.Schema:
+        if self.user_schema is not None:
+            return self.user_schema
+        return self._read_path(self.paths[0]).schema
+
+    def _read_path(self, path: str) -> pa.Table:
+        opts = None
+        if self.user_schema is not None:
+            opts = pajson.ParseOptions(explicit_schema=self.user_schema)
+        return pajson.read_json(path, parse_options=opts)
