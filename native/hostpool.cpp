@@ -1,0 +1,146 @@
+// Host buffer pool: native arena allocator with accounting.
+//
+// Reference behavior: HostAlloc.scala (367 LoC) + the pinned-host pool set
+// up by GpuDeviceManager (GpuDeviceManager.scala:287-306) — a bounded host
+// memory arena that the shuffle/spill paths allocate bounce buffers from,
+// with byte accounting so the framework can throttle and spill by policy.
+//
+// Design: one contiguous mmap'd arena, first-fit free list with coalescing
+// on free, 64-byte alignment (cache lines / DMA friendliness). Thread-safe
+// via a simple spinlock (allocations are short). Out-of-pool requests
+// return 0 so the Python side can trigger spill/retry (the analog of
+// RmmSpark's alloc-failed callback driving the retry state machine).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <sys/mman.h>
+
+namespace {
+
+struct Block {
+  uint64_t offset;
+  uint64_t size;
+  Block* next;
+};
+
+struct Pool {
+  uint8_t* base;
+  uint64_t capacity;
+  Block* free_list;
+  uint64_t in_use;
+  uint64_t high_watermark;
+  uint64_t n_allocs;
+  uint64_t n_frees;
+  std::atomic_flag lock = ATOMIC_FLAG_INIT;
+};
+
+constexpr uint64_t kAlign = 64;
+
+inline uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+struct Guard {
+  Pool* p;
+  explicit Guard(Pool* p) : p(p) {
+    while (p->lock.test_and_set(std::memory_order_acquire)) {}
+  }
+  ~Guard() { p->lock.clear(std::memory_order_release); }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hostpool_create(uint64_t capacity) {
+  void* mem = mmap(nullptr, capacity, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (mem == MAP_FAILED) return nullptr;
+  Pool* p = new Pool();
+  p->base = (uint8_t*)mem;
+  p->capacity = capacity;
+  p->free_list = new Block{0, capacity, nullptr};
+  p->in_use = 0;
+  p->high_watermark = 0;
+  p->n_allocs = 0;
+  p->n_frees = 0;
+  return p;
+}
+
+void hostpool_destroy(void* pool) {
+  Pool* p = (Pool*)pool;
+  munmap(p->base, p->capacity);
+  Block* b = p->free_list;
+  while (b) { Block* n = b->next; delete b; b = n; }
+  delete p;
+}
+
+// Returns a pointer into the arena, or null when the pool cannot satisfy
+// the request (caller triggers spill/retry).
+void* hostpool_alloc(void* pool, uint64_t size) {
+  Pool* p = (Pool*)pool;
+  uint64_t need = align_up(size ? size : 1) + kAlign;  // header slot
+  Guard g(p);
+  Block** prev = &p->free_list;
+  for (Block* b = p->free_list; b; prev = &b->next, b = b->next) {
+    if (b->size >= need) {
+      uint64_t off = b->offset;
+      b->offset += need;
+      b->size -= need;
+      if (b->size == 0) { *prev = b->next; delete b; }
+      // stash the allocation size in the header slot
+      uint64_t* hdr = (uint64_t*)(p->base + off);
+      hdr[0] = need;
+      p->in_use += need;
+      p->n_allocs += 1;
+      if (p->in_use > p->high_watermark) p->high_watermark = p->in_use;
+      return p->base + off + kAlign;
+    }
+  }
+  return nullptr;
+}
+
+void hostpool_free(void* pool, void* ptr) {
+  if (!ptr) return;
+  Pool* p = (Pool*)pool;
+  uint8_t* raw = (uint8_t*)ptr - kAlign;
+  uint64_t need = *(uint64_t*)raw;
+  uint64_t off = (uint64_t)(raw - p->base);
+  Guard g(p);
+  p->in_use -= need;
+  p->n_frees += 1;
+  // insert sorted by offset, coalescing neighbors
+  Block* prev_blk = nullptr;
+  Block** prev = &p->free_list;
+  Block* b = p->free_list;
+  while (b && b->offset < off) { prev_blk = b; prev = &b->next; b = b->next; }
+  Block* nb = new Block{off, need, b};
+  *prev = nb;
+  // coalesce with next
+  if (b && nb->offset + nb->size == b->offset) {
+    nb->size += b->size;
+    nb->next = b->next;
+    delete b;
+  }
+  // coalesce with previous
+  if (prev_blk && prev_blk->offset + prev_blk->size == nb->offset) {
+    prev_blk->size += nb->size;
+    prev_blk->next = nb->next;
+    delete nb;
+  }
+}
+
+uint64_t hostpool_in_use(void* pool) {
+  Pool* p = (Pool*)pool;
+  Guard g(p);
+  return p->in_use;
+}
+
+uint64_t hostpool_high_watermark(void* pool) {
+  Pool* p = (Pool*)pool;
+  Guard g(p);
+  return p->high_watermark;
+}
+
+uint64_t hostpool_capacity(void* pool) { return ((Pool*)pool)->capacity; }
+
+}  // extern "C"

@@ -1,0 +1,256 @@
+// Kudo-style columnar wire codec: native hot path for shuffle.
+//
+// Reference behavior: spark-rapids-jni's kudo serializer (KudoSerializer /
+// KudoTableHeader / KudoHostMergeResult, consumed at
+// GpuColumnarBatchSerializer.scala:95-146 and GpuShuffleCoalesceExec.scala) —
+// a compact header + concatenated buffers, designed so many serialized
+// tables can be merged ON THE HOST into one set of flat column buffers and
+// uploaded to the device once.
+//
+// Wire layout (must stay bit-compatible with shuffle/serializer.py):
+//   magic  u32 = 0x54505553 ("SPUT")
+//   n_rows u32, n_cols u32, codec u8, pad 3B
+//   per column: type_code u8, has_offsets u8, pad 2B,
+//               data_len u32, validity_len u32, offsets_len u32
+//   body_len u32, body bytes (per column: data, packed validity, offsets)
+//
+// The merge entry points are two-phase: *_sizes computes output buffer
+// sizes so the caller (Python/numpy) owns all allocations; *_fill writes
+// merged data / per-row validity bytes / rebased offsets directly into the
+// caller's buffers — zero intermediate copies, no Arrow on the merge path.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x54505553u;
+
+struct ColMeta {
+  uint8_t type_code;
+  uint8_t has_offsets;
+  uint32_t data_len;
+  uint32_t validity_len;
+  uint32_t offsets_len;
+};
+
+struct TableView {
+  uint32_t n_rows;
+  uint32_t n_cols;
+  const ColMeta* meta;     // points into a caller-provided scratch array
+  const uint8_t* body;     // uncompressed body
+};
+
+// Parses one wire table at buf+pos. Returns next offset or 0 on error.
+// meta_out must hold at least n_cols entries (caller sizes via first parse).
+size_t parse_table(const uint8_t* buf, size_t len, size_t pos,
+                   ColMeta* meta_out, TableView* view) {
+  if (pos + 16 > len) return 0;
+  uint32_t magic, n_rows, n_cols;
+  std::memcpy(&magic, buf + pos, 4);
+  std::memcpy(&n_rows, buf + pos + 4, 4);
+  std::memcpy(&n_cols, buf + pos + 8, 4);
+  uint8_t codec = buf[pos + 12];
+  if (magic != kMagic || codec != 0) return 0;  // native path: uncompressed
+  pos += 16;
+  for (uint32_t c = 0; c < n_cols; ++c) {
+    if (pos + 16 > len) return 0;
+    ColMeta& m = meta_out[c];
+    m.type_code = buf[pos];
+    m.has_offsets = buf[pos + 1];
+    std::memcpy(&m.data_len, buf + pos + 4, 4);
+    std::memcpy(&m.validity_len, buf + pos + 8, 4);
+    std::memcpy(&m.offsets_len, buf + pos + 12, 4);
+    pos += 16;
+  }
+  uint32_t body_len;
+  if (pos + 4 > len) return 0;
+  std::memcpy(&body_len, buf + pos, 4);
+  pos += 4;
+  if (pos + body_len > len) return 0;
+  view->n_rows = n_rows;
+  view->n_cols = n_cols;
+  view->meta = meta_out;
+  view->body = buf + pos;
+  return pos + body_len;
+}
+
+inline void unpack_bits(const uint8_t* packed, uint8_t* out, size_t n) {
+  for (size_t i = 0; i < n; ++i)
+    out[i] = (packed[i >> 3] >> (i & 7)) & 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Validity bitmask helpers (packbits/unpackbits, little-endian bit order)
+// ---------------------------------------------------------------------------
+
+void kudo_pack_validity(const uint8_t* valid_bytes, size_t n,
+                        uint8_t* out_packed) {
+  size_t nbytes = (n + 7) / 8;
+  std::memset(out_packed, 0, nbytes);
+  for (size_t i = 0; i < n; ++i)
+    out_packed[i >> 3] |= (valid_bytes[i] ? 1u : 0u) << (i & 7);
+}
+
+void kudo_unpack_validity(const uint8_t* packed, size_t n,
+                          uint8_t* out_bytes) {
+  unpack_bits(packed, out_bytes, n);
+}
+
+// ---------------------------------------------------------------------------
+// Serialize: assemble one wire table from raw column buffers.
+// Caller passes, per column: data ptr+len, per-row validity bytes (or null
+// for all-valid), offsets ptr (int32, n_rows+1 entries, or null).
+// Two-phase: size then fill.
+// ---------------------------------------------------------------------------
+
+size_t kudo_serialize_size(uint32_t n_rows, uint32_t n_cols,
+                           const size_t* data_lens,
+                           const uint8_t* const* validity,
+                           const uint8_t* const* offsets) {
+  size_t total = 16 + 16 * (size_t)n_cols + 4;
+  for (uint32_t c = 0; c < n_cols; ++c) {
+    total += data_lens[c];
+    if (validity[c]) total += (n_rows + 7) / 8;
+    if (offsets[c]) total += 4 * ((size_t)n_rows + 1);
+  }
+  return total;
+}
+
+size_t kudo_serialize_fill(uint32_t n_rows, uint32_t n_cols,
+                           const uint8_t* const* data,
+                           const size_t* data_lens,
+                           const uint8_t* const* validity,
+                           const uint8_t* const* offsets,
+                           const uint8_t* type_codes,
+                           uint8_t* out) {
+  uint8_t* p = out;
+  std::memcpy(p, &kMagic, 4);
+  std::memcpy(p + 4, &n_rows, 4);
+  std::memcpy(p + 8, &n_cols, 4);
+  p[12] = 0; p[13] = p[14] = p[15] = 0;
+  p += 16;
+  size_t vbytes = (n_rows + 7) / 8;
+  size_t obytes = 4 * ((size_t)n_rows + 1);
+  uint32_t body_len = 0;
+  for (uint32_t c = 0; c < n_cols; ++c) {
+    uint32_t dlen = (uint32_t)data_lens[c];
+    uint32_t vlen = validity[c] ? (uint32_t)vbytes : 0;
+    uint32_t olen = offsets[c] ? (uint32_t)obytes : 0;
+    p[0] = type_codes[c];
+    p[1] = offsets[c] ? 1 : 0;
+    p[2] = p[3] = 0;
+    std::memcpy(p + 4, &dlen, 4);
+    std::memcpy(p + 8, &vlen, 4);
+    std::memcpy(p + 12, &olen, 4);
+    p += 16;
+    body_len += dlen + vlen + olen;
+  }
+  std::memcpy(p, &body_len, 4);
+  p += 4;
+  for (uint32_t c = 0; c < n_cols; ++c) {
+    std::memcpy(p, data[c], data_lens[c]);
+    p += data_lens[c];
+    if (validity[c]) {
+      kudo_pack_validity(validity[c], n_rows, p);
+      p += vbytes;
+    }
+    if (offsets[c]) {
+      std::memcpy(p, offsets[c], obytes);
+      p += obytes;
+    }
+  }
+  return (size_t)(p - out);
+}
+
+// ---------------------------------------------------------------------------
+// Merge: N wire blocks (each holding >=1 concatenated tables) -> flat
+// per-column output buffers. The kudo host-merge.
+// ---------------------------------------------------------------------------
+
+// Pass 1: total rows and per-column data byte totals.
+// out_sizes must hold n_cols entries; returns total rows, or (size_t)-1 on
+// parse error. max_cols guards the scratch meta array.
+long long kudo_merge_sizes(const uint8_t* const* blocks, const size_t* lens,
+                           int n_blocks, uint32_t n_cols,
+                           unsigned long long* out_data_sizes) {
+  ColMeta meta[256];
+  if (n_cols > 256) return -1;
+  unsigned long long rows = 0;
+  for (uint32_t c = 0; c < n_cols; ++c) out_data_sizes[c] = 0;
+  for (int b = 0; b < n_blocks; ++b) {
+    size_t pos = 0;
+    while (pos < lens[b]) {
+      TableView v;
+      pos = parse_table(blocks[b], lens[b], pos, meta, &v);
+      if (pos == 0) return -1;
+      if (v.n_cols != n_cols) return -1;
+      rows += v.n_rows;
+      for (uint32_t c = 0; c < n_cols; ++c)
+        out_data_sizes[c] += meta[c].data_len;
+    }
+  }
+  return (long long)rows;
+}
+
+// Pass 2: fill caller buffers.
+//   out_data[c]      : concatenated data bytes (size from pass 1)
+//   out_validity[c]  : per-row validity BYTES (1 byte per row, total rows)
+//   out_offsets[c]   : rebased int32 offsets (total_rows+1) or null for
+//                      fixed-width columns
+// Returns 0 on success.
+int kudo_merge_fill(const uint8_t* const* blocks, const size_t* lens,
+                    int n_blocks, uint32_t n_cols,
+                    uint8_t* const* out_data,
+                    uint8_t* const* out_validity,
+                    int32_t* const* out_offsets) {
+  ColMeta meta[256];
+  if (n_cols > 256) return -1;
+  unsigned long long row_base = 0;
+  unsigned long long data_base[256] = {0};
+  for (int b = 0; b < n_blocks; ++b) {
+    size_t pos = 0;
+    while (pos < lens[b]) {
+      TableView v;
+      pos = parse_table(blocks[b], lens[b], pos, meta, &v);
+      if (pos == 0) return -1;
+      const uint8_t* body = v.body;
+      for (uint32_t c = 0; c < n_cols; ++c) {
+        const ColMeta& m = meta[c];
+        const uint8_t* data = body;
+        const uint8_t* validity = body + m.data_len;
+        const uint8_t* offs = validity + m.validity_len;
+        body = offs + m.offsets_len;
+        std::memcpy(out_data[c] + data_base[c], data, m.data_len);
+        uint8_t* vout = out_validity[c] + row_base;
+        if (m.validity_len) {
+          unpack_bits(validity, vout, v.n_rows);
+        } else {
+          std::memset(vout, 1, v.n_rows);
+        }
+        if (out_offsets[c]) {
+          int32_t* oout = out_offsets[c] + row_base;
+          int32_t base = (int32_t)data_base[c];
+          if (m.offsets_len) {
+            const int32_t* oin = (const int32_t*)offs;
+            // entry i..n: rebased by running data base; entry 0 written by
+            // previous table (or the initial 0)
+            if (row_base == 0) oout[0] = 0;
+            for (uint32_t i = 1; i <= v.n_rows; ++i)
+              oout[i] = oin[i] + base;
+          }
+        }
+        data_base[c] += m.data_len;
+      }
+      row_base += v.n_rows;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

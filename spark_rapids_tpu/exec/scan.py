@@ -73,6 +73,7 @@ class FileScanBase(LeafExec):
         self.n_partitions = n_partitions
         self.min_bucket = min_bucket
         self._schema: Optional[T.Schema] = None
+        self._first_cache = None  # (item, table) saved by schema inference
         self._register_metric("scanTimeNs")
         self._register_metric("uploadTimeNs")
 
@@ -113,6 +114,18 @@ class FileScanBase(LeafExec):
         t = t.select(schema.names)
         return t.cast(schema)
 
+    def _cache_inferred(self, item, table):
+        """Schema-inferring subclasses park the decoded first file here so
+        execution doesn't decode it twice."""
+        self._first_cache = (item, table)
+
+    def _take_cached(self, item):
+        if self._first_cache is not None and self._first_cache[0] == item:
+            t = self._first_cache[1]
+            self._first_cache = None
+            return t
+        return None
+
     # work-splitting hooks: default = one item per file
     def _partition_items(self, partition: int) -> List:
         return self._files_for_partition(partition)
@@ -131,7 +144,10 @@ class FileScanBase(LeafExec):
 
         def read(it):
             with self.timer("scanTimeNs"):
-                return self._project(self._read_item(it))
+                t = self._take_cached(it)
+                if t is None:
+                    t = self._read_item(it)
+                return self._project(t)
 
         if self.reader_type == "PERFILE":
             yield from self.upload_batched(map(read, items))

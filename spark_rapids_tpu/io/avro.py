@@ -95,7 +95,8 @@ def _field_type(t) -> Tuple[str, bool, int]:
     if isinstance(t, str):
         if t not in _PRIMITIVE_ARROW:
             raise NotImplementedError(f"avro type {t!r}")
-        return t, t == "null", -1
+        # plain "null" occupies ZERO bytes per value (no union branch varint)
+        return t, False, -1
     if isinstance(t, list):  # union
         branches = [b for b in t if b != "null"]
         if len(branches) != 1 or not isinstance(branches[0], str) \
@@ -107,12 +108,10 @@ def _field_type(t) -> Tuple[str, bool, int]:
     raise NotImplementedError(f"avro type {t!r}")
 
 
-def read_avro(path: str, columns: Optional[Sequence[str]] = None) -> pa.Table:
-    """Decode one Avro object container file into an Arrow table."""
-    with open(path, "rb") as f:
-        raw = f.read()
+def _parse_header(raw: bytes):
+    """(metadata dict, position past the sync marker)."""
     if raw[:4] != _MAGIC:
-        raise ValueError(f"{path}: not an Avro object container file")
+        raise ValueError("not an Avro object container file")
     r = _Reader(raw)
     r.skip(4)
     meta = {}
@@ -126,8 +125,41 @@ def read_avro(path: str, columns: Optional[Sequence[str]] = None) -> pa.Table:
         for _ in range(n):
             k = r.read_bytes().decode()
             meta[k] = r.read_bytes()
-    sync = raw[r.pos:r.pos + 16]
-    r.skip(16)
+    r.skip(16)  # sync marker
+    return meta, r.pos
+
+
+def read_avro_schema(path: str) -> pa.Schema:
+    """Arrow schema from just the container header (no data decode)."""
+    chunk = 1 << 20
+    with open(path, "rb") as f:
+        raw = f.read(chunk)
+        while True:
+            try:
+                meta, _ = _parse_header(raw)
+                break
+            except IndexError:
+                more = f.read(chunk)
+                if not more:
+                    raise ValueError(f"{path}: truncated Avro header")
+                raw += more
+    schema = json.loads(meta["avro.schema"])
+    if schema.get("type") != "record":
+        raise NotImplementedError("only record top-level schemas")
+    fields = []
+    for f_ in schema["fields"]:
+        typ, nullable, _ = _field_type(f_["type"])
+        fields.append(pa.field(f_["name"], _PRIMITIVE_ARROW[typ], nullable))
+    return pa.schema(fields)
+
+
+def read_avro(path: str, columns: Optional[Sequence[str]] = None) -> pa.Table:
+    """Decode one Avro object container file into an Arrow table."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    meta, pos = _parse_header(raw)
+    r = _Reader(raw)
+    r.pos = pos
     codec = meta.get("avro.codec", b"null").decode()
     schema = json.loads(meta["avro.schema"])
     if schema.get("type") != "record":
@@ -260,7 +292,7 @@ def _w_value(v, t: pa.DataType) -> bytes:
 
 class AvroScanExec(FileScanBase):
     def _read_schema(self) -> pa.Schema:
-        return read_avro(self.paths[0]).schema
+        return read_avro_schema(self.paths[0])
 
     def _read_path(self, path: str) -> pa.Table:
         return read_avro(path, self.columns)

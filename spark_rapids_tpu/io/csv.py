@@ -44,8 +44,11 @@ class CsvScanExec(FileScanBase):
         )
 
     def _read_opts(self):
-        if self.header or self.user_schema is None:
+        if self.header:
             return pacsv.ReadOptions()
+        if self.user_schema is None:
+            # headerless + no schema: synthesize names, don't eat row 1
+            return pacsv.ReadOptions(autogenerate_column_names=True)
         return pacsv.ReadOptions(column_names=[f.name for f in
                                                self.user_schema])
 
@@ -62,7 +65,9 @@ class CsvScanExec(FileScanBase):
     def _read_schema(self) -> pa.Schema:
         if self.user_schema is not None:
             return self.user_schema
-        return self._read_path(self.paths[0]).schema
+        t = self._read_path(self.paths[0])
+        self._cache_inferred(self.paths[0], t)
+        return t.schema
 
     def _read_path(self, path: str) -> pa.Table:
         return pacsv.read_csv(

@@ -73,9 +73,11 @@ class FileCache:
             f.write(data)
         os.replace(tmp, self._local(key))
         with self._lock:
+            # concurrent misses can race to _put the same key: account the
+            # delta, not the full size, so _total never drifts
+            self._total += len(data) - self._entries.get(key, 0)
             self._entries[key] = len(data)
             self._entries.move_to_end(key)
-            self._total += len(data)
             while self._total > self.max_bytes and len(self._entries) > 1:
                 old, _ = next(iter(self._entries.items()))
                 self._drop(old)

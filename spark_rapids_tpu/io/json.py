@@ -27,7 +27,9 @@ class JsonScanExec(FileScanBase):
     def _read_schema(self) -> pa.Schema:
         if self.user_schema is not None:
             return self.user_schema
-        return self._read_path(self.paths[0]).schema
+        t = self._read_path(self.paths[0])
+        self._cache_inferred(self.paths[0], t)
+        return t.schema
 
     def _read_path(self, path: str) -> pa.Table:
         opts = None

@@ -170,7 +170,9 @@ def write_columnar(
     for batch in batches:
         t = batch_to_arrow(batch, schema)
         if not part_idx:
-            w = open_writers.setdefault((), new_writer(()))
+            w = open_writers.get(())
+            if w is None:
+                w = open_writers[()] = new_writer(())
             w.write(t)
             if w.rows >= rows_per_file:
                 close_writer(open_writers.pop(()))

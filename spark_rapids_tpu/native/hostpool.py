@@ -1,0 +1,85 @@
+"""HostMemoryPool: Python handle over the native arena allocator.
+
+Reference: HostAlloc.scala + the pinned pool sizing in GpuDeviceManager
+(SURVEY.md §2.6). Allocation failure returns None (never raises) so the
+memory layer can drive its spill/retry state machine, mirroring how device
+alloc failure feeds RmmRapidsRetryIterator.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_tpu.native import get_lib
+
+
+class HostBuffer:
+    """One allocation: exposes a numpy view over the pooled memory."""
+
+    __slots__ = ("pool", "ptr", "size", "_arr")
+
+    def __init__(self, pool: "HostMemoryPool", ptr: int, size: int):
+        self.pool = pool
+        self.ptr = ptr
+        self.size = size
+        self._arr = None
+
+    def as_numpy(self) -> np.ndarray:
+        if self._arr is None:
+            buf = (ctypes.c_uint8 * self.size).from_address(self.ptr)
+            self._arr = np.frombuffer(buf, np.uint8)
+        return self._arr
+
+    def free(self):
+        if self.ptr:
+            self.pool._free(self.ptr)
+            self.ptr = 0
+            self._arr = None
+
+
+class HostMemoryPool:
+    """Bounded host arena; None-on-exhaustion allocation discipline."""
+
+    def __init__(self, capacity_bytes: int):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._pool = lib.hostpool_create(capacity_bytes)
+        if not self._pool:
+            raise MemoryError("hostpool_create failed")
+
+    def alloc(self, size: int) -> Optional[HostBuffer]:
+        p = self._lib.hostpool_alloc(self._pool, size)
+        if not p:
+            return None
+        return HostBuffer(self, p, size)
+
+    def _free(self, ptr: int):
+        self._lib.hostpool_free(self._pool, ctypes.c_void_p(ptr))
+
+    @property
+    def in_use(self) -> int:
+        return self._lib.hostpool_in_use(self._pool)
+
+    @property
+    def high_watermark(self) -> int:
+        return self._lib.hostpool_high_watermark(self._pool)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.hostpool_capacity(self._pool)
+
+    def close(self):
+        if self._pool:
+            self._lib.hostpool_destroy(self._pool)
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

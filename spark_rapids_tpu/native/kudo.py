@@ -1,0 +1,97 @@
+"""numpy-facing wrappers over the native kudo codec.
+
+Two capabilities (both with the pure-Python serializer as fallback at the
+call sites in shuffle/serializer.py):
+
+- ``serialize_columns``: raw numpy column buffers -> one wire table, with
+  validity bit-packing done in C++.
+- ``merge_blocks``: N wire blocks -> flat numpy buffers per column (data,
+  per-row validity bytes, rebased offsets) in a single native pass — the
+  kudo host-merge that turns a pile of shuffle blocks into ONE device
+  upload without Arrow materialization.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.native import get_lib
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _ptr(a: Optional[np.ndarray]):
+    if a is None:
+        return ctypes.cast(None, _u8p)
+    return a.ctypes.data_as(_u8p)
+
+
+def serialize_columns(n_rows: int,
+                      data: Sequence[np.ndarray],
+                      validity: Sequence[Optional[np.ndarray]],
+                      offsets: Sequence[Optional[np.ndarray]],
+                      type_codes: Sequence[int]) -> Optional[bytes]:
+    """Columns -> wire bytes. validity entries are per-row uint8 (1=valid)
+    or None for all-valid; offsets are int32 (n_rows+1) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n_cols = len(data)
+    data = [np.ascontiguousarray(d).view(np.uint8).reshape(-1) for d in data]
+    validity = [None if v is None else np.ascontiguousarray(v, np.uint8)
+                for v in validity]
+    offsets = [None if o is None else np.ascontiguousarray(o, np.int32)
+               for o in offsets]
+    d_ptrs = (_u8p * n_cols)(*[_ptr(d) for d in data])
+    d_lens = (ctypes.c_size_t * n_cols)(*[d.nbytes for d in data])
+    v_ptrs = (_u8p * n_cols)(*[_ptr(v) for v in validity])
+    o_ptrs = (_u8p * n_cols)(
+        *[_ptr(None if o is None else o.view(np.uint8)) for o in offsets])
+    tcodes = (ctypes.c_uint8 * n_cols)(*type_codes)
+    size = lib.kudo_serialize_size(n_rows, n_cols, d_lens, v_ptrs, o_ptrs)
+    out = np.empty(size, np.uint8)
+    written = lib.kudo_serialize_fill(n_rows, n_cols, d_ptrs, d_lens,
+                                      v_ptrs, o_ptrs, tcodes, _ptr(out))
+    assert written == size, (written, size)
+    return out.tobytes()
+
+
+def merge_blocks(blocks: List[bytes], n_cols: int,
+                 has_offsets: Sequence[bool]
+                 ) -> Optional[Tuple[int, List[np.ndarray],
+                                     List[np.ndarray],
+                                     List[Optional[np.ndarray]]]]:
+    """N wire blocks -> (total_rows, data[], validity_bytes[], offsets[]).
+
+    Returns None when the native library is unavailable (caller falls back
+    to the Python merge) or on parse failure."""
+    lib = get_lib()
+    if lib is None or not blocks:
+        return None
+    bufs = [np.frombuffer(b, np.uint8) for b in blocks]
+    b_ptrs = (_u8p * len(bufs))(*[_ptr(b) for b in bufs])
+    b_lens = (ctypes.c_size_t * len(bufs))(*[b.nbytes for b in bufs])
+    sizes = (ctypes.c_ulonglong * n_cols)()
+    rows = lib.kudo_merge_sizes(b_ptrs, b_lens, len(bufs), n_cols, sizes)
+    if rows < 0:
+        return None
+    total_rows = int(rows)
+    data = [np.empty(int(sizes[c]), np.uint8) for c in range(n_cols)]
+    validity = [np.empty(total_rows, np.uint8) for _ in range(n_cols)]
+    offsets: List[Optional[np.ndarray]] = [
+        np.zeros(total_rows + 1, np.int32) if has_offsets[c] else None
+        for c in range(n_cols)]
+    d_ptrs = (_u8p * n_cols)(*[_ptr(d) for d in data])
+    v_ptrs = (_u8p * n_cols)(*[_ptr(v) for v in validity])
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    o_ptrs = (i32p * n_cols)(*[
+        ctypes.cast(None, i32p) if o is None else o.ctypes.data_as(i32p)
+        for o in offsets])
+    rc = lib.kudo_merge_fill(b_ptrs, b_lens, len(bufs), n_cols,
+                             d_ptrs, v_ptrs, o_ptrs)
+    if rc != 0:
+        return None
+    return total_rows, data, validity, offsets

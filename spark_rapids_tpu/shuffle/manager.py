@@ -25,7 +25,9 @@ import pyarrow as pa
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.shuffle.partition import Partitioner
-from spark_rapids_tpu.shuffle.serializer import merge_tables, serialize_table
+from spark_rapids_tpu.shuffle.serializer import (
+    merge_tables, merge_to_batch, serialize_table,
+)
 
 
 class _MapOutput:
@@ -111,10 +113,9 @@ class ShuffleManager:
             reg.map_outputs.append(out)
 
     # -- read side ---------------------------------------------------------
-    def read_partition(self, reg: ShuffleRegistration,
-                       partition: int) -> Optional[pa.Table]:
-        """Fetch partition blocks from all map outputs (reader pool) and
-        host-merge them into one arrow table (single upload by the caller)."""
+    def _fetch_blocks(self, reg: ShuffleRegistration,
+                      partition: int) -> List[bytes]:
+        """Fetch a reduce partition's blocks from all map outputs (pool)."""
 
         def fetch(mo: _MapOutput) -> Optional[bytes]:
             if mo.cached is not None:
@@ -128,9 +129,21 @@ class ShuffleManager:
 
         with reg.lock:
             outputs = list(reg.map_outputs)
-        blocks = [b for b in self._read_pool.map(fetch, outputs)
-                  if b is not None]
-        return merge_tables(blocks, reg.schema)
+        return [b for b in self._read_pool.map(fetch, outputs)
+                if b is not None]
+
+    def read_partition(self, reg: ShuffleRegistration,
+                       partition: int) -> Optional[pa.Table]:
+        """Host-merge a reduce partition into one arrow table (single upload
+        by the caller)."""
+        return merge_tables(self._fetch_blocks(reg, partition), reg.schema)
+
+    def read_partition_batch(self, reg: ShuffleRegistration, partition: int,
+                             min_bucket: int = 1024):
+        """Like read_partition but merges straight into one device batch via
+        the native kudo merge (single upload, no Arrow on the merge path)."""
+        return merge_to_batch(self._fetch_blocks(reg, partition),
+                              reg.schema, min_bucket)
 
     def cleanup(self, reg: ShuffleRegistration) -> None:
         with reg.lock:

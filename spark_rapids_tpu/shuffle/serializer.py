@@ -200,3 +200,91 @@ def merge_tables(blocks: List[bytes], schema: T.Schema) -> Optional[pa.Table]:
     if not tables:
         return None
     return pa.concat_tables(tables)
+
+
+def merge_to_batch(blocks: List[bytes], schema: T.Schema,
+                   min_bucket: int = 1024):
+    """Merge wire blocks straight into ONE device batch.
+
+    Native fast path: the C++ kudo merge (native/kudo.cpp) parses every
+    block and writes flat data/validity/offsets buffers in a single pass —
+    no Arrow materialization — and those numpy buffers upload once.
+    Falls back to the Python merge + arrow conversion when the native
+    library is unavailable or blocks are compressed. Returns None for no
+    data.
+    """
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import (
+        batch_from_arrow, bucket_capacity,
+    )
+    from spark_rapids_tpu.columnar.column import DeviceColumn
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+    if not blocks:
+        return None
+    native_ok = all(len(b) >= 13 and b[12] == 0 for b in blocks)  # codec none
+    res = None
+    if native_ok and not any(isinstance(f.dtype, T.ArrayType)
+                             for f in schema):
+        from spark_rapids_tpu.native import kudo as NK
+
+        has_off = [not f.dtype.fixed_width for f in schema]
+        res = NK.merge_blocks(blocks, len(schema), has_off)
+    if res is None:
+        t = merge_tables(blocks, schema)
+        return None if t is None else batch_from_arrow(t, min_bucket)
+    total, data, validity, offsets = res
+    cap = bucket_capacity(max(total, 1), min_bucket)
+    cols = []
+    for c, field in enumerate(schema):
+        dt = field.dtype
+        vb = np.zeros(cap, np.bool_)
+        vb[:total] = validity[c].view(np.bool_)
+        if offsets[c] is None:
+            np_t = T.numpy_dtype(dt)
+            vals = data[c].view(np_t)
+            d = np.zeros(cap, np_t)
+            d[:total] = vals
+            d[~vb[:len(d)]] = 0  # deterministic nulls/padding
+            cols.append(DeviceColumn(dt, jnp.asarray(d), jnp.asarray(vb)))
+        else:
+            nbytes = int(offsets[c][total])
+            byte_cap = bucket_capacity(max(nbytes, 8), 8)
+            d = np.zeros(byte_cap, np.uint8)
+            d[:nbytes] = data[c][:nbytes]
+            off = np.full(cap + 1, nbytes, np.int32)
+            off[: total + 1] = offsets[c][: total + 1]
+            cols.append(DeviceColumn(dt, jnp.asarray(d), jnp.asarray(vb),
+                                     jnp.asarray(off)))
+    return ColumnarBatch(cols, jnp.int32(total))
+
+
+def serialize_batch_device(batch, schema: T.Schema) -> Optional[bytes]:
+    """Device batch -> wire bytes via the native codec (validity packing and
+    buffer assembly in C++), skipping Arrow. None when unavailable or the
+    schema has array columns (not in the wire format)."""
+    from spark_rapids_tpu.native import available
+    from spark_rapids_tpu.native import kudo as NK
+
+    if not available() or any(isinstance(f.dtype, T.ArrayType)
+                              for f in schema):
+        return None
+    n = batch.row_count()
+    data, validity, offsets, tcodes = [], [], [], []
+    for col, field in zip(batch.columns, schema):
+        v = np.asarray(col.validity)[:n]
+        if col.offsets is not None:
+            off = np.asarray(col.offsets)[: n + 1].astype(np.int32)
+            nb = int(off[-1]) if n else 0
+            data.append(np.asarray(col.data)[:nb])
+            offsets.append(off)
+        else:
+            d = np.asarray(col.data)[:n]
+            if d.dtype == np.bool_:
+                d = d.astype(np.uint8)
+            data.append(d)
+            offsets.append(None)
+        validity.append(None if bool(v.all()) else v.astype(np.uint8))
+        tcodes.append(_type_code(field.dtype))
+    return NK.serialize_columns(n, data, validity, offsets, tcodes)

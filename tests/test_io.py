@@ -105,6 +105,29 @@ def test_write_columnar_plain(tmp_path, sample_table):
     assert back.to_pylist() == sample_table.to_pylist()
 
 
+def test_write_columnar_multiple_batches(tmp_path, sample_table):
+    # regression: a second batch must append to the open writer, not leak a
+    # new truncated file
+    schema = T.Schema.from_arrow(sample_table.schema)
+    batches = [batch_from_arrow(sample_table.slice(i, 100), 16)
+               for i in range(0, 500, 100)]
+    stats = write_columnar(iter(batches), schema, str(tmp_path / "out"))
+    files = glob.glob(str(tmp_path / "out" / "*.parquet"))
+    assert stats.num_files == len(files) == 1
+    back = pq.read_table(files[0])
+    assert back.to_pylist() == sample_table.to_pylist()
+
+
+def test_csv_headerless_no_schema(tmp_path):
+    p = str(tmp_path / "h.csv")
+    with open(p, "w") as f:
+        f.write("1,2\n3,4\n")
+    node = CsvScanExec([p], header=False)
+    got = collect(node)
+    assert len(got) == 2  # row 1 must not be eaten as a header
+    assert sorted(v for r in got for v in r.values()) == [1, 2, 3, 4]
+
+
 def test_write_columnar_partitioned(tmp_path, rng):
     n = 300
     t = pa.table({

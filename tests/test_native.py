@@ -1,0 +1,145 @@
+"""Native runtime tests: the C++ kudo codec must be byte/bit-compatible
+with the pure-Python serializer, and the host pool must account correctly
+(reference: kudo serializer round-trip suites, HostAllocSuite)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.native import available
+from spark_rapids_tpu.shuffle.serializer import (
+    deserialize_table,
+    merge_tables,
+    merge_to_batch,
+    serialize_batch_device,
+    serialize_table,
+)
+
+needs_native = pytest.mark.skipif(not available(),
+                                  reason="native toolchain unavailable")
+
+
+@pytest.fixture
+def table(rng):
+    n = 300
+    return pa.table({
+        "i": pa.array([int(x) if x % 5 else None
+                       for x in rng.integers(-10**6, 10**6, n)], pa.int64()),
+        "f": pa.array(rng.normal(size=n), pa.float64()),
+        "b": pa.array([bool(x % 2) if x % 7 else None
+                       for x in rng.integers(0, 10, n)], pa.bool_()),
+        "s": pa.array([f"str_{int(x)}" if x % 3 else None
+                       for x in rng.integers(0, 999, n)], pa.string()),
+    })
+
+
+@needs_native
+def test_native_serialize_matches_python(table):
+    schema = T.Schema.from_arrow(table.schema)
+    b = batch_from_arrow(table, 16)
+    native = serialize_batch_device(b, schema)
+    assert native is not None
+    # python reference serialization of the same rows
+    pyb = serialize_table(table)
+    # both must deserialize to identical tables (byte equality can differ in
+    # padding-free areas only; require full logical equality)
+    tn, _ = deserialize_table(native, schema)
+    tp, _ = deserialize_table(pyb, schema)
+    assert tn.to_pylist() == tp.to_pylist() == table.to_pylist()
+
+
+@needs_native
+def test_native_merge_matches_python(table, rng):
+    schema = T.Schema.from_arrow(table.schema)
+    blocks = []
+    for i in range(0, table.num_rows, 64):
+        blocks.append(serialize_table(table.slice(i, 64)))
+    # python merge
+    exp = merge_tables(blocks, schema).to_pylist()
+    # native merge straight to device batch
+    got_batch = merge_to_batch(blocks, schema, 16)
+    got = batch_to_arrow(got_batch, schema).to_pylist()
+    assert got == exp
+
+
+@needs_native
+def test_native_merge_multi_table_blocks(table):
+    schema = T.Schema.from_arrow(table.schema)
+    # one block holding several concatenated wire tables
+    blob = b"".join(serialize_table(table.slice(i, 50))
+                    for i in range(0, 150, 50))
+    blocks = [blob, serialize_table(table.slice(150, 50))]
+    exp = merge_tables(blocks, schema).to_pylist()
+    got = batch_to_arrow(merge_to_batch(blocks, schema, 16),
+                         schema).to_pylist()
+    assert got == exp
+
+
+@needs_native
+def test_hostpool_accounting():
+    from spark_rapids_tpu.native.hostpool import HostMemoryPool
+
+    with HostMemoryPool(1 << 20) as pool:
+        a = pool.alloc(1000)
+        b = pool.alloc(2000)
+        assert a is not None and b is not None
+        assert pool.in_use >= 3000
+        arr = a.as_numpy()
+        arr[:] = 7  # writable memory
+        assert (arr == 7).all()
+        a.free()
+        c = pool.alloc(500)
+        assert c is not None
+        b.free()
+        c.free()
+        assert pool.in_use == 0
+        assert pool.high_watermark >= 3000
+        # exhaustion returns None, not an exception
+        big = pool.alloc(2 << 20)
+        assert big is None
+
+
+@needs_native
+def test_hostpool_reuse_after_free():
+    from spark_rapids_tpu.native.hostpool import HostMemoryPool
+
+    with HostMemoryPool(1 << 16) as pool:
+        bufs = []
+        while True:  # drain to exhaustion: must end with None, not raise
+            b = pool.alloc(4096)
+            if b is None:
+                break
+            bufs.append(b)
+        assert len(bufs) >= 10
+        for b in bufs:
+            b.free()
+        # coalescing must make the full arena usable again
+        big = pool.alloc(40000)
+        assert big is not None
+        big.free()
+
+
+def test_shuffle_manager_batch_read(tmp_path, table):
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    from spark_rapids_tpu.shuffle.partition import HashPartitioner
+
+    schema = T.Schema.from_arrow(table.schema)
+    mgr = ShuffleManager(local_dir=str(tmp_path), cache_only=False)
+    reg = mgr.register(schema, n_reduce=3)
+    part = HashPartitioner([0], 3)
+    b = batch_from_arrow(table, 16)
+    mgr.write_map_output(reg, part, [b])
+    total = 0
+    seen = []
+    for p in range(3):
+        batch = mgr.read_partition_batch(reg, p, 16)
+        if batch is None:
+            continue
+        rows = batch_to_arrow(batch, schema).to_pylist()
+        total += len(rows)
+        seen.extend(rows)
+    assert total == table.num_rows
+    assert sorted(seen, key=repr) == sorted(table.to_pylist(), key=repr)
+    mgr.cleanup(reg)
