@@ -43,9 +43,11 @@ struct TableView {
 };
 
 // Parses one wire table at buf+pos. Returns next offset or 0 on error.
-// meta_out must hold at least n_cols entries (caller sizes via first parse).
+// meta_out must hold at least max_cols entries; a wire-declared column
+// count above max_cols is a parse error BEFORE any meta write (shuffle
+// blocks can arrive from remote peers — never trust the header).
 size_t parse_table(const uint8_t* buf, size_t len, size_t pos,
-                   ColMeta* meta_out, TableView* view) {
+                   ColMeta* meta_out, uint32_t max_cols, TableView* view) {
   if (pos + 16 > len) return 0;
   uint32_t magic, n_rows, n_cols;
   std::memcpy(&magic, buf + pos, 4);
@@ -53,6 +55,7 @@ size_t parse_table(const uint8_t* buf, size_t len, size_t pos,
   std::memcpy(&n_cols, buf + pos + 8, 4);
   uint8_t codec = buf[pos + 12];
   if (magic != kMagic || codec != 0) return 0;  // native path: uncompressed
+  if (n_cols > max_cols) return 0;
   pos += 16;
   for (uint32_t c = 0; c < n_cols; ++c) {
     if (pos + 16 > len) return 0;
@@ -69,6 +72,19 @@ size_t parse_table(const uint8_t* buf, size_t len, size_t pos,
   std::memcpy(&body_len, buf + pos, 4);
   pos += 4;
   if (pos + body_len > len) return 0;
+  // per-column lengths must exactly tile the body, and offsets (when
+  // present) must be the full int32[n_rows+1] vector the merge indexes
+  uint64_t need = 0;
+  for (uint32_t c = 0; c < n_cols; ++c) {
+    const ColMeta& m = meta_out[c];
+    need += (uint64_t)m.data_len + m.validity_len + m.offsets_len;
+    if (m.has_offsets && m.offsets_len != 0 &&
+        m.offsets_len != 4 * ((uint64_t)n_rows + 1))
+      return 0;
+    if (m.validity_len != 0 && m.validity_len < ((uint64_t)n_rows + 7) / 8)
+      return 0;
+  }
+  if (need != body_len) return 0;
   view->n_rows = n_rows;
   view->n_cols = n_cols;
   view->meta = meta_out;
@@ -187,7 +203,7 @@ long long kudo_merge_sizes(const uint8_t* const* blocks, const size_t* lens,
     size_t pos = 0;
     while (pos < lens[b]) {
       TableView v;
-      pos = parse_table(blocks[b], lens[b], pos, meta, &v);
+      pos = parse_table(blocks[b], lens[b], pos, meta, 256, &v);
       if (pos == 0) return -1;
       if (v.n_cols != n_cols) return -1;
       rows += v.n_rows;
@@ -217,8 +233,9 @@ int kudo_merge_fill(const uint8_t* const* blocks, const size_t* lens,
     size_t pos = 0;
     while (pos < lens[b]) {
       TableView v;
-      pos = parse_table(blocks[b], lens[b], pos, meta, &v);
+      pos = parse_table(blocks[b], lens[b], pos, meta, 256, &v);
       if (pos == 0) return -1;
+      if (v.n_cols != n_cols) return -1;
       const uint8_t* body = v.body;
       for (uint32_t c = 0; c < n_cols; ++c) {
         const ColMeta& m = meta[c];

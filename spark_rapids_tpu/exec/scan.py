@@ -114,10 +114,15 @@ class FileScanBase(LeafExec):
         t = t.select(schema.names)
         return t.cast(schema)
 
+    _MAX_INFER_CACHE_BYTES = 256 << 20
+
     def _cache_inferred(self, item, table):
         """Schema-inferring subclasses park the decoded first file here so
-        execution doesn't decode it twice."""
-        self._first_cache = (item, table)
+        execution doesn't decode it twice. Oversized tables are not pinned
+        (planning-only processes would otherwise hold a multi-GB decode for
+        the node's lifetime)."""
+        if table.nbytes <= self._MAX_INFER_CACHE_BYTES:
+            self._first_cache = (item, table)
 
     def _take_cached(self, item):
         if self._first_cache is not None and self._first_cache[0] == item:

@@ -27,12 +27,21 @@ def _build(out_path: str) -> bool:
     srcs = [os.path.join(_SRC_DIR, f) for f in ("kudo.cpp", "hostpool.cpp")]
     if not all(os.path.exists(s) for s in srcs):
         return False
+    # compile to a private temp path and os.replace into place: concurrent
+    # processes must never dlopen a half-written .so or interleave linker
+    # output on the shared cache path
+    tmp = f"{out_path}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", out_path] + srcs
+           "-o", tmp] + srcs
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out_path)
         return True
-    except (subprocess.SubprocessError, FileNotFoundError):
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -35,6 +35,14 @@ class HostBuffer:
 
     def free(self):
         if self.ptr:
+            if self._arr is not None:
+                import sys
+                # refuse to free while callers still hold the view (or a
+                # slice of it): the arena region would be re-handed out and
+                # writes through the stale view would corrupt the new owner
+                if sys.getrefcount(self._arr) > 2:
+                    raise RuntimeError(
+                        "HostBuffer.free() with outstanding numpy views")
             self.pool._free(self.ptr)
             self.ptr = 0
             self._arr = None

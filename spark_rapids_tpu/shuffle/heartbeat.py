@@ -1,0 +1,123 @@
+"""Driver-mediated executor discovery for the shuffle transport.
+
+Reference: RapidsShuffleHeartbeatManager (driver) + heartbeat endpoint on
+executors (SURVEY.md §2.8 / Plugin.scala:458-466,546-552): executors
+register with the driver, periodic heartbeats return the delta of newly
+known peers so every executor can open transport connections early, and
+missed heartbeats mark a peer lost (failure detection for the data plane).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class PeerInfo:
+    __slots__ = ("executor_id", "host", "port", "last_seen", "seq")
+
+    def __init__(self, executor_id: str, host: str, port: int, seq: int):
+        self.executor_id = executor_id
+        self.host = host
+        self.port = port
+        self.last_seen = time.monotonic()
+        self.seq = seq  # registration order: lets heartbeats fetch deltas
+
+
+class ShuffleHeartbeatManager:
+    """Driver side: registration + heartbeat bookkeeping + lost-peer sweep."""
+
+    def __init__(self, timeout_s: float = 30.0):
+        self.timeout_s = timeout_s
+        self._peers: Dict[str, PeerInfo] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def register(self, executor_id: str, host: str,
+                 port: int) -> List[Tuple[str, str, int]]:
+        """Register an executor; returns ALL currently known peers."""
+        with self._lock:
+            self._seq += 1
+            self._peers[executor_id] = PeerInfo(executor_id, host, port,
+                                                self._seq)
+            return [(p.executor_id, p.host, p.port)
+                    for p in self._peers.values()
+                    if p.executor_id != executor_id]
+
+    def heartbeat(self, executor_id: str,
+                  last_seen_seq: int) -> Tuple[int, List[Tuple[str, str, int]]]:
+        """Refresh liveness; returns (new watermark, peers registered after
+        the executor's last watermark) — the delta protocol the reference
+        uses so heartbeats stay O(new peers)."""
+        with self._lock:
+            me = self._peers.get(executor_id)
+            if me is not None:
+                me.last_seen = time.monotonic()
+            new = [(p.executor_id, p.host, p.port)
+                   for p in self._peers.values()
+                   if p.seq > last_seen_seq and p.executor_id != executor_id]
+            return self._seq, new
+
+    def sweep_lost(self) -> List[str]:
+        """Drop peers that missed heartbeats; returns their ids."""
+        now = time.monotonic()
+        with self._lock:
+            lost = [eid for eid, p in self._peers.items()
+                    if now - p.last_seen > self.timeout_s]
+            for eid in lost:
+                del self._peers[eid]
+            return lost
+
+    def peers(self) -> List[Tuple[str, str, int]]:
+        with self._lock:
+            return [(p.executor_id, p.host, p.port)
+                    for p in self._peers.values()]
+
+
+class HeartbeatEndpoint:
+    """Executor side: periodic heartbeat thread maintaining a connection
+    callback for newly discovered peers."""
+
+    def __init__(self, manager: ShuffleHeartbeatManager, executor_id: str,
+                 host: str, port: int,
+                 on_new_peer: Callable[[str, str, int], None],
+                 interval_s: float = 5.0):
+        self.manager = manager
+        self.executor_id = executor_id
+        self.on_new_peer = on_new_peer
+        self.interval_s = interval_s
+        self._watermark = 0
+        self._stop = threading.Event()
+        known = set()
+        for peer in manager.register(executor_id, host, port):
+            known.add(peer[0])
+            on_new_peer(*peer)
+        # the watermark-initializing heartbeat may carry peers that
+        # registered between register() and now — deliver them (dedup
+        # against the registration snapshot), don't discard
+        self._watermark, new = manager.heartbeat(executor_id, 0)
+        for peer in new:
+            if peer[0] not in known:
+                on_new_peer(*peer)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def tick(self):
+        """One heartbeat (tests call this directly; the thread loops it)."""
+        self._watermark, new = self.manager.heartbeat(
+            self.executor_id, self._watermark)
+        for peer in new:
+            self.on_new_peer(*peer)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self.tick()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1)

@@ -1,0 +1,171 @@
+"""Shuffle transport wire protocol: metadata and transfer messages.
+
+Reference: the flatbuffers protocol in src/main/format/*.fbs
+(MetadataRequest/Response, TransferRequest/Response, ShuffleCommon) used by
+the UCX transport (SURVEY.md §2.8). Same message set here with a compact
+struct-based binary encoding:
+
+- MetadataRequest: which (shuffle, map, partition) blocks a reducer wants.
+- MetadataResponse: per-block sizes so the receiver can plan windows.
+- TransferRequest: start pushing a set of blocks.
+- BufferChunk: one bounce-buffer-sized piece of one block, with offsets so
+  chunks reassemble in any arrival order within a block stream.
+- DoneMessage / ErrorMessage: stream end / failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Tuple
+
+MSG_METADATA_REQ = 1
+MSG_METADATA_RESP = 2
+MSG_TRANSFER_REQ = 3
+MSG_BUFFER_CHUNK = 4
+MSG_DONE = 5
+MSG_ERROR = 6
+MSG_HEARTBEAT = 7
+MSG_HEARTBEAT_RESP = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockId:
+    """One shuffle block: output of one map task for one reduce partition."""
+
+    shuffle_id: int
+    map_id: int
+    partition: int
+
+    def pack(self) -> bytes:
+        return struct.pack("<III", self.shuffle_id, self.map_id,
+                           self.partition)
+
+    @staticmethod
+    def unpack(buf: bytes, off: int) -> Tuple["BlockId", int]:
+        s, m, p = struct.unpack_from("<III", buf, off)
+        return BlockId(s, m, p), off + 12
+
+
+@dataclasses.dataclass
+class MetadataRequest:
+    req_id: int
+    blocks: List[BlockId]
+
+    def encode(self) -> bytes:
+        head = struct.pack("<BxxxII", MSG_METADATA_REQ, self.req_id,
+                           len(self.blocks))
+        return head + b"".join(b.pack() for b in self.blocks)
+
+    @staticmethod
+    def decode(buf: bytes) -> "MetadataRequest":
+        _, req_id, n = struct.unpack_from("<BxxxII", buf, 0)
+        off = 12
+        blocks = []
+        for _ in range(n):
+            b, off = BlockId.unpack(buf, off)
+            blocks.append(b)
+        return MetadataRequest(req_id, blocks)
+
+
+@dataclasses.dataclass
+class MetadataResponse:
+    req_id: int
+    sizes: List[int]  # size per requested block; -1 = not present
+
+    def encode(self) -> bytes:
+        head = struct.pack("<BxxxII", MSG_METADATA_RESP, self.req_id,
+                           len(self.sizes))
+        return head + struct.pack(f"<{len(self.sizes)}q", *self.sizes)
+
+    @staticmethod
+    def decode(buf: bytes) -> "MetadataResponse":
+        _, req_id, n = struct.unpack_from("<BxxxII", buf, 0)
+        sizes = list(struct.unpack_from(f"<{n}q", buf, 12))
+        return MetadataResponse(req_id, sizes)
+
+
+@dataclasses.dataclass
+class TransferRequest:
+    req_id: int
+    blocks: List[BlockId]
+
+    def encode(self) -> bytes:
+        head = struct.pack("<BxxxII", MSG_TRANSFER_REQ, self.req_id,
+                           len(self.blocks))
+        return head + b"".join(b.pack() for b in self.blocks)
+
+    @staticmethod
+    def decode(buf: bytes) -> "TransferRequest":
+        _, req_id, n = struct.unpack_from("<BxxxII", buf, 0)
+        off = 12
+        blocks = []
+        for _ in range(n):
+            b, off = BlockId.unpack(buf, off)
+            blocks.append(b)
+        return TransferRequest(req_id, blocks)
+
+
+@dataclasses.dataclass
+class BufferChunk:
+    req_id: int
+    block_index: int   # index into the TransferRequest's block list
+    offset: int        # byte offset within the block
+    total: int         # total block size
+    payload: bytes
+
+    def encode(self) -> bytes:
+        head = struct.pack("<BxxxIIqqI", MSG_BUFFER_CHUNK, self.req_id,
+                           self.block_index, self.offset, self.total,
+                           len(self.payload))
+        return head + self.payload
+
+    @staticmethod
+    def decode(buf: bytes) -> "BufferChunk":
+        _, req_id, bi, off, total, plen = struct.unpack_from("<BxxxIIqqI",
+                                                             buf, 0)
+        start = struct.calcsize("<BxxxIIqqI")
+        return BufferChunk(req_id, bi, off, total,
+                           bytes(buf[start:start + plen]))
+
+
+@dataclasses.dataclass
+class DoneMessage:
+    req_id: int
+
+    def encode(self) -> bytes:
+        return struct.pack("<BxxxI", MSG_DONE, self.req_id)
+
+    @staticmethod
+    def decode(buf: bytes) -> "DoneMessage":
+        _, req_id = struct.unpack_from("<BxxxI", buf, 0)
+        return DoneMessage(req_id)
+
+
+@dataclasses.dataclass
+class ErrorMessage:
+    req_id: int
+    message: str
+
+    def encode(self) -> bytes:
+        mb = self.message.encode()
+        return struct.pack("<BxxxII", MSG_ERROR, self.req_id, len(mb)) + mb
+
+    @staticmethod
+    def decode(buf: bytes) -> "ErrorMessage":
+        _, req_id, n = struct.unpack_from("<BxxxII", buf, 0)
+        return ErrorMessage(req_id, buf[12:12 + n].decode())
+
+
+_DECODERS = {
+    MSG_METADATA_REQ: MetadataRequest.decode,
+    MSG_METADATA_RESP: MetadataResponse.decode,
+    MSG_TRANSFER_REQ: TransferRequest.decode,
+    MSG_BUFFER_CHUNK: BufferChunk.decode,
+    MSG_DONE: DoneMessage.decode,
+    MSG_ERROR: ErrorMessage.decode,
+}
+
+
+def decode_message(buf: bytes):
+    return _DECODERS[buf[0]](buf)

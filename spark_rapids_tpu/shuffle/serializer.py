@@ -216,10 +216,9 @@ def merge_to_batch(blocks: List[bytes], schema: T.Schema,
     import jax.numpy as jnp
 
     from spark_rapids_tpu.columnar.batch import (
-        batch_from_arrow, bucket_capacity,
+        ColumnarBatch, batch_from_arrow, bucket_capacity,
     )
     from spark_rapids_tpu.columnar.column import DeviceColumn
-    from spark_rapids_tpu.columnar.batch import ColumnarBatch
 
     if not blocks:
         return None

@@ -1,0 +1,396 @@
+"""Shuffle transport: connections, transactions, windowed bounce-buffer
+send/receive state machines, and peer-fetching client/server.
+
+Reference (SURVEY.md §2.8): RapidsShuffleTransport / ServerConnection /
+ClientConnection / Transaction abstractions; RapidsShuffleClient:95
+(doFetch:174); RapidsShuffleServer's BufferSendState — windowed sends
+through a bounded pool of bounce buffers so a server never materializes a
+whole fetch in flight; BufferReceiveState reassembling chunks;
+WindowedBlockIterator. The reference rides UCX active messages; the
+TPU-native data path is host-side DCN (here an in-process loopback and a
+TCP socket transport share the same protocol and state machines — the
+protocol layer is transport-agnostic exactly like the reference's).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_tpu.shuffle.protocol import (
+    BlockId,
+    BufferChunk,
+    DoneMessage,
+    ErrorMessage,
+    MetadataRequest,
+    MetadataResponse,
+    TransferRequest,
+    decode_message,
+)
+
+PENDING, SUCCESS, ERROR = "pending", "success", "error"
+
+
+class Transaction:
+    """One in-flight request: status + completion signaling (the reference's
+    Transaction abstraction)."""
+
+    def __init__(self, req_id: int):
+        self.req_id = req_id
+        self.status = PENDING
+        self.error: Optional[str] = None
+        self.result = None
+        self._done = threading.Event()
+
+    def complete(self, result=None):
+        self.result = result
+        self.status = SUCCESS
+        self._done.set()
+
+    def fail(self, message: str):
+        self.error = message
+        self.status = ERROR
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"transaction {self.req_id} timed out")
+        if self.status == ERROR:
+            raise RuntimeError(f"transaction {self.req_id}: {self.error}")
+        return self.result
+
+
+class Connection:
+    """Bidirectional message pipe; implementations deliver whole messages."""
+
+    def send(self, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class BounceBufferPool:
+    """Bounded pool of fixed-size send windows (BounceBufferManager analog).
+
+    Acquire blocks when all buffers are in flight — this is what bounds a
+    server's memory no matter how many fetches are outstanding."""
+
+    def __init__(self, buffer_size: int = 1 << 20, count: int = 4):
+        self.buffer_size = buffer_size
+        self._q: "queue.Queue[int]" = queue.Queue()
+        for i in range(count):
+            self._q.put(i)
+
+    def acquire(self) -> int:
+        return self._q.get()
+
+    def release(self, token: int):
+        self._q.put(token)
+
+
+class BufferSendState:
+    """Server-side windowed send of a set of blocks through bounce buffers.
+
+    Walks (block, offset) windows in order; each window takes one bounce
+    buffer, sends one BufferChunk, and releases the buffer when the
+    transport reports the send done (synchronous transports release
+    immediately)."""
+
+    def __init__(self, req_id: int, blocks: List[bytes], conn: Connection,
+                 pool: BounceBufferPool):
+        self.req_id = req_id
+        self.blocks = blocks
+        self.conn = conn
+        self.pool = pool
+        self.bytes_sent = 0
+
+    def run(self):
+        try:
+            for bi, data in enumerate(self.blocks):
+                total = len(data)
+                off = 0
+                while off < total or (total == 0 and off == 0):
+                    token = self.pool.acquire()
+                    try:
+                        end = min(off + self.pool.buffer_size, total)
+                        chunk = BufferChunk(self.req_id, bi, off, total,
+                                            data[off:end])
+                        self.conn.send(chunk.encode())
+                        self.bytes_sent += end - off
+                    finally:
+                        self.pool.release(token)
+                    if total == 0:
+                        break
+                    off = end
+            self.conn.send(DoneMessage(self.req_id).encode())
+        except Exception as e:  # fail the stream, not the server
+            self.conn.send(ErrorMessage(self.req_id, str(e)).encode())
+
+
+class BufferReceiveState:
+    """Client-side reassembly of BufferChunks into whole blocks."""
+
+    def __init__(self, n_blocks: int, sizes: List[int]):
+        self.buffers = [bytearray(max(s, 0)) for s in sizes]
+        self.received = [0] * n_blocks
+        self.sizes = sizes
+
+    def on_chunk(self, c: BufferChunk):
+        buf = self.buffers[c.block_index]
+        buf[c.offset:c.offset + len(c.payload)] = c.payload
+        self.received[c.block_index] += len(c.payload)
+
+    def is_complete(self) -> bool:
+        return all(r >= max(s, 0)
+                   for r, s in zip(self.received, self.sizes))
+
+    def blocks(self) -> List[bytes]:
+        return [bytes(b) for b in self.buffers]
+
+
+# ---------------------------------------------------------------------------
+# Server / client over an abstract connection
+# ---------------------------------------------------------------------------
+
+
+class ShuffleServer:
+    """Serves block metadata and windowed block transfers from a local
+    block store (RapidsShuffleServer analog)."""
+
+    def __init__(self, block_fetcher: Callable[[BlockId], Optional[bytes]],
+                 bounce_pool: Optional[BounceBufferPool] = None):
+        self.block_fetcher = block_fetcher
+        self.pool = bounce_pool or BounceBufferPool()
+
+    def handle(self, payload: bytes, conn: Connection):
+        msg = decode_message(payload)
+        if isinstance(msg, MetadataRequest):
+            sizes = []
+            for b in msg.blocks:
+                blob = self.block_fetcher(b)
+                sizes.append(-1 if blob is None else len(blob))
+            conn.send(MetadataResponse(msg.req_id, sizes).encode())
+        elif isinstance(msg, TransferRequest):
+            blocks = []
+            for b in msg.blocks:
+                blob = self.block_fetcher(b)
+                if blob is None:
+                    conn.send(ErrorMessage(
+                        msg.req_id, f"missing block {b}").encode())
+                    return
+                blocks.append(blob)
+            BufferSendState(msg.req_id, blocks, conn, self.pool).run()
+        else:
+            raise ValueError(f"server got unexpected message {msg!r}")
+
+
+class ShuffleClient:
+    """Fetches blocks from one peer: metadata round trip, then a windowed
+    transfer into a BufferReceiveState (RapidsShuffleClient.doFetch)."""
+
+    def __init__(self, conn: Connection):
+        self.conn = conn
+        self._next_req = 0
+        self._pending: Dict[int, Transaction] = {}
+        self._recv: Dict[int, BufferReceiveState] = {}
+        self._lock = threading.Lock()
+
+    def _new_txn(self) -> Transaction:
+        with self._lock:
+            self._next_req += 1
+            t = Transaction(self._next_req)
+            self._pending[t.req_id] = t
+            return t
+
+    # -- inbound -----------------------------------------------------------
+    def handle(self, payload: bytes):
+        msg = decode_message(payload)
+        txn = self._pending.get(msg.req_id)
+        if txn is None:
+            return
+        # terminal messages retire the transaction (a long-lived client must
+        # not accumulate completed transactions)
+        if isinstance(msg, MetadataResponse):
+            self._pending.pop(msg.req_id, None)
+            txn.complete(msg.sizes)
+        elif isinstance(msg, BufferChunk):
+            self._recv[msg.req_id].on_chunk(msg)
+        elif isinstance(msg, DoneMessage):
+            self._pending.pop(msg.req_id, None)
+            rs = self._recv.pop(msg.req_id)
+            if not rs.is_complete():
+                txn.fail("stream ended before all bytes arrived")
+            else:
+                txn.complete(rs.blocks())
+        elif isinstance(msg, ErrorMessage):
+            self._pending.pop(msg.req_id, None)
+            self._recv.pop(msg.req_id, None)
+            txn.fail(msg.message)
+
+    # -- outbound ----------------------------------------------------------
+    def request_metadata(self, blocks: List[BlockId]) -> Transaction:
+        txn = self._new_txn()
+        self.conn.send(MetadataRequest(txn.req_id, blocks).encode())
+        return txn
+
+    def fetch(self, blocks: List[BlockId],
+              timeout: Optional[float] = 30.0) -> List[bytes]:
+        """Full doFetch: metadata -> plan receive -> transfer -> blocks."""
+        meta_txn = self.request_metadata(blocks)
+        sizes = meta_txn.wait(timeout)
+        present = [i for i, s in enumerate(sizes) if s >= 0]
+        want = [blocks[i] for i in present]
+        if not want:
+            return []
+        txn = self._new_txn()
+        self._recv[txn.req_id] = BufferReceiveState(
+            len(want), [sizes[i] for i in present])
+        self.conn.send(TransferRequest(txn.req_id, want).encode())
+        return txn.wait(timeout)
+
+
+# ---------------------------------------------------------------------------
+# In-process transport (tests / local mode)
+# ---------------------------------------------------------------------------
+
+
+class LoopbackConnection(Connection):
+    """Synchronous in-process pipe: client sends -> server handles on the
+    same thread -> server replies land in client.handle. The protocol state
+    machines are exercised exactly as over a real wire."""
+
+    def __init__(self, server: ShuffleServer):
+        self.server = server
+        self.client: Optional[ShuffleClient] = None
+        self._server_side = _LoopbackServerSide(self)
+
+    def send(self, payload: bytes) -> None:  # client -> server
+        self.server.handle(payload, self._server_side)
+
+
+class _LoopbackServerSide(Connection):
+    def __init__(self, outer: LoopbackConnection):
+        self.outer = outer
+
+    def send(self, payload: bytes) -> None:  # server -> client
+        self.outer.client.handle(payload)
+
+
+def connect_loopback(server: ShuffleServer) -> ShuffleClient:
+    conn = LoopbackConnection(server)
+    client = ShuffleClient(conn)
+    conn.client = client
+    return client
+
+
+# ---------------------------------------------------------------------------
+# TCP transport (multi-host DCN path)
+# ---------------------------------------------------------------------------
+
+
+def _send_framed(sock: socket.socket, payload: bytes):
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_framed(sock: socket.socket) -> Optional[bytes]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack("<I", head)
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            return None
+        buf += part
+    return bytes(buf)
+
+
+class TcpServer:
+    """Socket server speaking the shuffle protocol (management port +
+    data plane in one, the moral analog of the UCX listener)."""
+
+    def __init__(self, shuffle_server: ShuffleServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.shuffle_server = shuffle_server
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen()
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, sock: socket.socket):
+        conn = _TcpConnection(sock)
+        while True:
+            payload = _recv_framed(sock)
+            if payload is None:
+                return
+            self.shuffle_server.handle(payload, conn)
+
+    def close(self):
+        self._stop.set()
+        self._sock.close()
+
+
+class _TcpConnection(Connection):
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._lock = threading.Lock()
+
+    def send(self, payload: bytes) -> None:
+        with self._lock:
+            _send_framed(self.sock, payload)
+
+
+class TcpClientConnection(Connection):
+    """Client side of a TCP shuffle connection; a reader thread dispatches
+    inbound messages to the ShuffleClient."""
+
+    def __init__(self, host: str, port: int):
+        self.sock = socket.create_connection((host, port))
+        self._lock = threading.Lock()
+        self.on_message: Optional[Callable[[bytes], None]] = None
+        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+        self._thread.start()
+
+    def _read_loop(self):
+        while True:
+            payload = _recv_framed(self.sock)
+            if payload is None:
+                return
+            if self.on_message is not None:
+                self.on_message(payload)
+
+    def send(self, payload: bytes) -> None:
+        with self._lock:
+            _send_framed(self.sock, payload)
+
+    def close(self):
+        self.sock.close()
+
+
+def connect_tcp(host: str, port: int) -> ShuffleClient:
+    conn = TcpClientConnection(host, port)
+    client = ShuffleClient(conn)
+    conn.on_message = client.handle
+    return client

@@ -78,6 +78,32 @@ def test_native_merge_multi_table_blocks(table):
 
 
 @needs_native
+def test_native_merge_rejects_corrupt_blocks(table, rng):
+    """Hostile/corrupt wire blocks must fail parse cleanly (return None via
+    fallback), never crash: the merge runs on bytes fetched from peers."""
+    from spark_rapids_tpu.native import kudo as NK
+    import struct
+
+    good = serialize_table(table.slice(0, 50))
+    # truncated block
+    assert NK.merge_blocks([good[: len(good) // 2]], 4,
+                           [False, False, False, True]) is None
+    # absurd column count in the header
+    evil = bytearray(good)
+    struct.pack_into("<I", evil, 8, 3000)
+    assert NK.merge_blocks([bytes(evil)], 4,
+                           [False, False, False, True]) is None
+    # column lengths that do not tile the body
+    evil2 = bytearray(good)
+    struct.pack_into("<I", evil2, 16 + 4, 2 ** 31 - 1)
+    assert NK.merge_blocks([bytes(evil2)], 4,
+                           [False, False, False, True]) is None
+    # random garbage
+    assert NK.merge_blocks([rng.bytes(500)], 4,
+                           [False, False, False, True]) is None
+
+
+@needs_native
 def test_hostpool_accounting():
     from spark_rapids_tpu.native.hostpool import HostMemoryPool
 
@@ -89,6 +115,9 @@ def test_hostpool_accounting():
         arr = a.as_numpy()
         arr[:] = 7  # writable memory
         assert (arr == 7).all()
+        with pytest.raises(RuntimeError, match="outstanding"):
+            a.free()  # live view held -> must refuse (use-after-free guard)
+        del arr
         a.free()
         c = pool.alloc(500)
         assert c is not None
