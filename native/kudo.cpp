@@ -78,7 +78,11 @@ size_t parse_table(const uint8_t* buf, size_t len, size_t pos,
   for (uint32_t c = 0; c < n_cols; ++c) {
     const ColMeta& m = meta_out[c];
     need += (uint64_t)m.data_len + m.validity_len + m.offsets_len;
-    if (m.has_offsets && m.offsets_len != 0 &&
+    // merge_fill sizes the offsets read from n_rows, and the CALLER's
+    // schema (not this flag) decides whether offsets are read — so a
+    // nonzero offsets_len must be the full vector no matter what the wire
+    // flag claims
+    if (m.offsets_len != 0 &&
         m.offsets_len != 4 * ((uint64_t)n_rows + 1))
       return 0;
     if (m.validity_len != 0 && m.validity_len < ((uint64_t)n_rows + 7) / 8)

@@ -45,11 +45,13 @@ class ShuffleHeartbeatManager:
                     for p in self._peers.values()
                     if p.executor_id != executor_id]
 
-    def heartbeat(self, executor_id: str,
-                  last_seen_seq: int) -> Tuple[int, List[Tuple[str, str, int]]]:
+    def heartbeat(self, executor_id: str, last_seen_seq: int
+                  ) -> Tuple[int, List[Tuple[str, str, int]], bool]:
         """Refresh liveness; returns (new watermark, peers registered after
-        the executor's last watermark) — the delta protocol the reference
-        uses so heartbeats stay O(new peers)."""
+        the executor's last watermark, known) — the delta protocol the
+        reference uses so heartbeats stay O(new peers). ``known=False``
+        means the executor was swept as lost and must re-register (a
+        transient stall must not leave it permanently invisible)."""
         with self._lock:
             me = self._peers.get(executor_id)
             if me is not None:
@@ -57,7 +59,7 @@ class ShuffleHeartbeatManager:
             new = [(p.executor_id, p.host, p.port)
                    for p in self._peers.values()
                    if p.seq > last_seen_seq and p.executor_id != executor_id]
-            return self._seq, new
+            return self._seq, new, me is not None
 
     def sweep_lost(self) -> List[str]:
         """Drop peers that missed heartbeats; returns their ids."""
@@ -89,6 +91,8 @@ class HeartbeatEndpoint:
         self.interval_s = interval_s
         self._watermark = 0
         self._stop = threading.Event()
+        self._host = host
+        self._port = port
         known = set()
         for peer in manager.register(executor_id, host, port):
             known.add(peer[0])
@@ -96,7 +100,7 @@ class HeartbeatEndpoint:
         # the watermark-initializing heartbeat may carry peers that
         # registered between register() and now — deliver them (dedup
         # against the registration snapshot), don't discard
-        self._watermark, new = manager.heartbeat(executor_id, 0)
+        self._watermark, new, _ = manager.heartbeat(executor_id, 0)
         for peer in new:
             if peer[0] not in known:
                 on_new_peer(*peer)
@@ -108,8 +112,13 @@ class HeartbeatEndpoint:
 
     def tick(self):
         """One heartbeat (tests call this directly; the thread loops it)."""
-        self._watermark, new = self.manager.heartbeat(
+        self._watermark, new, known = self.manager.heartbeat(
             self.executor_id, self._watermark)
+        if not known:
+            # swept as lost during a stall: re-register so peers can see us
+            self.manager.register(self.executor_id, self._host, self._port)
+            self._watermark, new, _ = self.manager.heartbeat(
+                self.executor_id, 0)
         for peer in new:
             self.on_new_peer(*peer)
 

@@ -95,22 +95,29 @@ class BounceBufferPool:
 class BufferSendState:
     """Server-side windowed send of a set of blocks through bounce buffers.
 
-    Walks (block, offset) windows in order; each window takes one bounce
-    buffer, sends one BufferChunk, and releases the buffer when the
-    transport reports the send done (synchronous transports release
-    immediately)."""
+    Blocks are FETCHED LAZILY one at a time (``block_loader(i)``) so a fetch
+    of N blocks holds one block + one bounce window resident, not the whole
+    response — the bounded-memory property the bounce pool exists for. Each
+    window takes one bounce buffer, sends one BufferChunk, and releases the
+    buffer when the transport reports the send done (synchronous transports
+    release immediately)."""
 
-    def __init__(self, req_id: int, blocks: List[bytes], conn: Connection,
-                 pool: BounceBufferPool):
+    def __init__(self, req_id: int, n_blocks: int,
+                 block_loader: Callable[[int], Optional[bytes]],
+                 conn: Connection, pool: BounceBufferPool):
         self.req_id = req_id
-        self.blocks = blocks
+        self.n_blocks = n_blocks
+        self.block_loader = block_loader
         self.conn = conn
         self.pool = pool
         self.bytes_sent = 0
 
     def run(self):
         try:
-            for bi, data in enumerate(self.blocks):
+            for bi in range(self.n_blocks):
+                data = self.block_loader(bi)
+                if data is None:
+                    raise KeyError(f"block {bi} disappeared mid-transfer")
                 total = len(data)
                 off = 0
                 while off < total or (total == 0 and off == 0):
@@ -132,17 +139,34 @@ class BufferSendState:
 
 
 class BufferReceiveState:
-    """Client-side reassembly of BufferChunks into whole blocks."""
+    """Client-side reassembly of BufferChunks into whole blocks.
+
+    Chunks arrive in order within a block (the sender walks windows
+    sequentially); validation enforces exactly that, so duplicates, holes,
+    out-of-range indices, and size lies from a hostile/buggy peer are
+    rejected instead of corrupting data or growing buffers."""
 
     def __init__(self, n_blocks: int, sizes: List[int]):
         self.buffers = [bytearray(max(s, 0)) for s in sizes]
         self.received = [0] * n_blocks
         self.sizes = sizes
 
-    def on_chunk(self, c: BufferChunk):
+    def on_chunk(self, c: BufferChunk) -> Optional[str]:
+        """Applies one chunk; returns an error string on protocol violation."""
+        if not (0 <= c.block_index < len(self.buffers)):
+            return f"chunk block_index {c.block_index} out of range"
+        want = max(self.sizes[c.block_index], 0)
+        if c.total != want:
+            return f"chunk total {c.total} != planned size {want}"
+        if c.offset != self.received[c.block_index]:
+            return (f"chunk offset {c.offset} != expected "
+                    f"{self.received[c.block_index]} (dup/hole)")
+        if c.offset + len(c.payload) > want:
+            return "chunk overruns block size"
         buf = self.buffers[c.block_index]
         buf[c.offset:c.offset + len(c.payload)] = c.payload
         self.received[c.block_index] += len(c.payload)
+        return None
 
     def is_complete(self) -> bool:
         return all(r >= max(s, 0)
@@ -175,15 +199,10 @@ class ShuffleServer:
                 sizes.append(-1 if blob is None else len(blob))
             conn.send(MetadataResponse(msg.req_id, sizes).encode())
         elif isinstance(msg, TransferRequest):
-            blocks = []
-            for b in msg.blocks:
-                blob = self.block_fetcher(b)
-                if blob is None:
-                    conn.send(ErrorMessage(
-                        msg.req_id, f"missing block {b}").encode())
-                    return
-                blocks.append(blob)
-            BufferSendState(msg.req_id, blocks, conn, self.pool).run()
+            wanted = list(msg.blocks)
+            BufferSendState(msg.req_id, len(wanted),
+                            lambda i: self.block_fetcher(wanted[i]),
+                            conn, self.pool).run()
         else:
             raise ValueError(f"server got unexpected message {msg!r}")
 
@@ -218,11 +237,17 @@ class ShuffleClient:
             self._pending.pop(msg.req_id, None)
             txn.complete(msg.sizes)
         elif isinstance(msg, BufferChunk):
-            self._recv[msg.req_id].on_chunk(msg)
+            rs = self._recv.get(msg.req_id)
+            err = "chunk for unknown transfer" if rs is None \
+                else rs.on_chunk(msg)
+            if err is not None:
+                self._pending.pop(msg.req_id, None)
+                self._recv.pop(msg.req_id, None)
+                txn.fail(err)
         elif isinstance(msg, DoneMessage):
             self._pending.pop(msg.req_id, None)
-            rs = self._recv.pop(msg.req_id)
-            if not rs.is_complete():
+            rs = self._recv.pop(msg.req_id, None)
+            if rs is None or not rs.is_complete():
                 txn.fail("stream ended before all bytes arrived")
             else:
                 txn.complete(rs.blocks())
@@ -230,6 +255,19 @@ class ShuffleClient:
             self._pending.pop(msg.req_id, None)
             self._recv.pop(msg.req_id, None)
             txn.fail(msg.message)
+
+    def fail_all(self, reason: str):
+        """Fail every in-flight transaction (connection lost / bad frame)."""
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+            self._recv.clear()
+        for txn in pending:
+            txn.fail(reason)
+
+    def _discard(self, req_id: int):
+        self._pending.pop(req_id, None)
+        self._recv.pop(req_id, None)
 
     # -- outbound ----------------------------------------------------------
     def request_metadata(self, blocks: List[BlockId]) -> Transaction:
@@ -239,9 +277,16 @@ class ShuffleClient:
 
     def fetch(self, blocks: List[BlockId],
               timeout: Optional[float] = 30.0) -> List[bytes]:
-        """Full doFetch: metadata -> plan receive -> transfer -> blocks."""
+        """Full doFetch: metadata -> plan receive -> transfer -> blocks.
+
+        Timed-out transactions are discarded so retries against a stalled
+        peer can't accumulate pre-allocated receive buffers."""
         meta_txn = self.request_metadata(blocks)
-        sizes = meta_txn.wait(timeout)
+        try:
+            sizes = meta_txn.wait(timeout)
+        except TimeoutError:
+            self._discard(meta_txn.req_id)
+            raise
         present = [i for i, s in enumerate(sizes) if s >= 0]
         want = [blocks[i] for i in present]
         if not want:
@@ -250,7 +295,11 @@ class ShuffleClient:
         self._recv[txn.req_id] = BufferReceiveState(
             len(want), [sizes[i] for i in present])
         self.conn.send(TransferRequest(txn.req_id, want).encode())
-        return txn.wait(timeout)
+        try:
+            return txn.wait(timeout)
+        except TimeoutError:
+            self._discard(txn.req_id)
+            raise
 
 
 # ---------------------------------------------------------------------------
@@ -345,7 +394,18 @@ class TcpServer:
             payload = _recv_framed(sock)
             if payload is None:
                 return
-            self.shuffle_server.handle(payload, conn)
+            try:
+                self.shuffle_server.handle(payload, conn)
+            except Exception as e:
+                # a bad frame must not silently kill the service thread —
+                # report to the peer if possible and drop the connection
+                try:
+                    (req_id,) = struct.unpack_from("<I", payload, 4)
+                    conn.send(ErrorMessage(req_id, str(e)).encode())
+                except Exception:
+                    pass
+                sock.close()
+                return
 
     def close(self):
         self._stop.set()
@@ -370,6 +430,7 @@ class TcpClientConnection(Connection):
         self.sock = socket.create_connection((host, port))
         self._lock = threading.Lock()
         self.on_message: Optional[Callable[[bytes], None]] = None
+        self.on_fail: Optional[Callable[[str], None]] = None
         self._thread = threading.Thread(target=self._read_loop, daemon=True)
         self._thread.start()
 
@@ -377,9 +438,19 @@ class TcpClientConnection(Connection):
         while True:
             payload = _recv_framed(self.sock)
             if payload is None:
+                if self.on_fail is not None:
+                    self.on_fail("connection closed")
                 return
-            if self.on_message is not None:
-                self.on_message(payload)
+            try:
+                if self.on_message is not None:
+                    self.on_message(payload)
+            except Exception as e:
+                # an undecodable/unknown frame must fail in-flight fetches
+                # loudly instead of hanging them on a dead reader thread
+                if self.on_fail is not None:
+                    self.on_fail(f"bad frame: {e}")
+                self.sock.close()
+                return
 
     def send(self, payload: bytes) -> None:
         with self._lock:
@@ -393,4 +464,5 @@ def connect_tcp(host: str, port: int) -> ShuffleClient:
     conn = TcpClientConnection(host, port)
     client = ShuffleClient(conn)
     conn.on_message = client.handle
+    conn.on_fail = client.fail_all
     return client

@@ -1,0 +1,20 @@
+"""Test-support tooling: seeded data generation and differential harness
+helpers (reference: integration_tests/src/main/python/data_gen.py and
+datagen/bigDataGen.scala — SURVEY.md §2.10, §4)."""
+
+from spark_rapids_tpu.testing.datagen import (  # noqa: F401
+    ArrayGen,
+    BooleanGen,
+    ByteGen,
+    DateGen,
+    DecimalGen,
+    DoubleGen,
+    FloatGen,
+    IntegerGen,
+    LongGen,
+    ShortGen,
+    StringGen,
+    TimestampGen,
+    gen_table,
+    seed_from_env,
+)

@@ -1,0 +1,252 @@
+"""Seeded, distribution-controlled data generators.
+
+Reference: integration_tests data_gen.py (per-type seeded generators with
+special values and null ratios; DATAGEN_SEED env printed on failure for
+repro — SURVEY.md §4.2) and datagen/bigDataGen.scala (distribution control:
+uniform/normal/zipf value ranges for scale testing).
+
+Every generator is deterministic for a (seed, length): tests that fail
+print the seed, and re-running with DATAGEN_SEED reproduces the exact data.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+DEFAULT_SEED = 1234
+
+
+def seed_from_env(default: int = DEFAULT_SEED) -> int:
+    """DATAGEN_SEED override, like the reference's repro knob."""
+    return int(os.environ.get("DATAGEN_SEED", default))
+
+
+class DataGen:
+    """Base: nullable-with-ratio + special-case injection around a core
+    value distribution."""
+
+    arrow_type: pa.DataType = None  # type: ignore[assignment]
+
+    def __init__(self, nullable: bool = True, null_ratio: float = 0.08,
+                 special_cases: Sequence = (), special_ratio: float = 0.05):
+        self.nullable = nullable
+        self.null_ratio = null_ratio if nullable else 0.0
+        self.special_cases = list(special_cases)
+        self.special_ratio = special_ratio if special_cases else 0.0
+
+    # subclass: vector of core values
+    def _values(self, rng: np.random.Generator, n: int) -> list:
+        raise NotImplementedError
+
+    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+        vals = list(self._values(rng, n))
+        if self.special_ratio:
+            take = rng.random(n) < self.special_ratio
+            picks = rng.integers(0, len(self.special_cases), n)
+            for i in np.nonzero(take)[0]:
+                vals[i] = self.special_cases[picks[i]]
+        if self.null_ratio:
+            nulls = rng.random(n) < self.null_ratio
+            for i in np.nonzero(nulls)[0]:
+                vals[i] = None
+        return pa.array(vals, type=self.arrow_type)
+
+
+class _IntGen(DataGen):
+    np_type = np.int64
+
+    def __init__(self, min_val=None, max_val=None,
+                 distribution: str = "uniform", **kw):
+        info = np.iinfo(self.np_type)
+        self.min_val = info.min if min_val is None else min_val
+        self.max_val = info.max if max_val is None else max_val
+        self.distribution = distribution
+        # specials stay INSIDE the requested range (a narrowed generator
+        # must never emit type extremes the caller excluded)
+        kw.setdefault("special_cases",
+                      [int(self.min_val), int(self.max_val),
+                       min(max(0, self.min_val), self.max_val)])
+        super().__init__(**kw)
+
+    def _values(self, rng, n):
+        lo, hi = self.min_val, self.max_val
+        if self.distribution == "zipf":
+            # heavy skew for scale tests (bigDataGen distribution control)
+            raw = rng.zipf(1.5, n)
+            vals = lo + (raw % max(hi - lo + 1, 1))
+        elif self.distribution == "normal":
+            mid = (lo + hi) / 2
+            span = max((hi - lo) / 8, 1)
+            vals = np.clip(rng.normal(mid, span, n), lo, hi).astype(np.int64)
+        else:
+            vals = rng.integers(lo, hi, n, dtype=np.int64,
+                                endpoint=True)
+        return [int(v) for v in vals]
+
+
+class ByteGen(_IntGen):
+    np_type = np.int8
+    arrow_type = pa.int8()
+
+
+class ShortGen(_IntGen):
+    np_type = np.int16
+    arrow_type = pa.int16()
+
+
+class IntegerGen(_IntGen):
+    np_type = np.int32
+    arrow_type = pa.int32()
+
+
+class LongGen(_IntGen):
+    np_type = np.int64
+    arrow_type = pa.int64()
+
+
+class BooleanGen(DataGen):
+    arrow_type = pa.bool_()
+
+    def _values(self, rng, n):
+        return [bool(v) for v in rng.integers(0, 2, n)]
+
+
+class _FloatGen(DataGen):
+    arrow_type = pa.float64()
+    cast = float
+
+    def __init__(self, min_exp: int = -30, max_exp: int = 30,
+                 no_nans: bool = False, **kw):
+        self.min_exp = min_exp
+        self.max_exp = max_exp
+        specials = [0.0, -0.0, 1.0, -1.0]
+        if not no_nans:
+            specials += [float("nan"), float("inf"), float("-inf")]
+        kw.setdefault("special_cases", specials)
+        super().__init__(**kw)
+
+    def _values(self, rng, n):
+        mant = rng.uniform(-1.0, 1.0, n)
+        exp = rng.integers(self.min_exp, self.max_exp, n)
+        return [self.cast(m * (2.0 ** int(e))) for m, e in zip(mant, exp)]
+
+
+class DoubleGen(_FloatGen):
+    arrow_type = pa.float64()
+
+
+class FloatGen(_FloatGen):
+    arrow_type = pa.float32()
+
+    def _values(self, rng, n):
+        return [np.float32(v).item() for v in super()._values(rng, n)]
+
+
+class StringGen(DataGen):
+    arrow_type = pa.string()
+
+    def __init__(self, charset: str = ("abcdefghijklmnopqrstuvwxyz"
+                                       "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+                                       " _-"),
+                 min_len: int = 0, max_len: int = 20, **kw):
+        self.charset = charset
+        self.min_len = min_len
+        self.max_len = max_len
+        kw.setdefault("special_cases", ["", " ", "\t", "NULL", "null",
+                                        "éü☃"])
+        super().__init__(**kw)
+
+    def _values(self, rng, n):
+        lens = rng.integers(self.min_len, self.max_len, n, endpoint=True)
+        chars = np.array(list(self.charset))
+        out = []
+        for ln in lens:
+            idx = rng.integers(0, len(chars), ln)
+            out.append("".join(chars[idx]))
+        return out
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision: int = 10, scale: int = 2, **kw):
+        self.precision = precision
+        self.scale = scale
+        self.arrow_type = pa.decimal128(precision, scale)
+        super().__init__(**kw)
+
+    def _values(self, rng, n):
+        import decimal
+        hi = 10 ** self.precision - 1
+        unscaled = rng.integers(-hi, hi, n, endpoint=True)
+        q = decimal.Decimal(1).scaleb(-self.scale)
+        return [decimal.Decimal(int(v)) * q for v in unscaled]
+
+
+class DateGen(DataGen):
+    arrow_type = pa.date32()
+
+    def __init__(self, start: str = "0001-01-03", end: str = "9999-12-29",
+                 **kw):
+        self.lo = (np.datetime64(start) - np.datetime64("1970-01-01")
+                   ).astype(int)
+        self.hi = (np.datetime64(end) - np.datetime64("1970-01-01")
+                   ).astype(int)
+        super().__init__(**kw)
+
+    def _values(self, rng, n):
+        days = rng.integers(self.lo, self.hi, n, endpoint=True)
+        epoch = datetime.date(1970, 1, 1)
+        return [epoch + datetime.timedelta(days=int(d)) for d in days]
+
+
+class TimestampGen(DataGen):
+    arrow_type = pa.timestamp("us", tz="UTC")
+
+    def __init__(self, start_us: int = -62135510400000000,
+                 end_us: int = 253402214400000000, **kw):
+        self.lo = start_us
+        self.hi = end_us
+        super().__init__(**kw)
+
+    def _values(self, rng, n):
+        return [int(v) for v in rng.integers(self.lo, self.hi, n)]
+
+
+class ArrayGen(DataGen):
+    def __init__(self, child: DataGen, min_len: int = 0, max_len: int = 8,
+                 **kw):
+        self.child = child
+        self.min_len = min_len
+        self.max_len = max_len
+        self.arrow_type = pa.list_(child.arrow_type)
+        super().__init__(**kw)
+
+    def _values(self, rng, n):
+        lens = rng.integers(self.min_len, self.max_len, n, endpoint=True)
+        total = int(lens.sum())
+        flat = self.child._values(rng, total)
+        out = []
+        pos = 0
+        for ln in lens:
+            out.append(flat[pos:pos + int(ln)])
+            pos += int(ln)
+        return out
+
+
+def gen_table(columns: Sequence[Tuple[str, DataGen]], n: int,
+              seed: Optional[int] = None) -> pa.Table:
+    """Deterministic table from (name, gen) pairs. Per-column child RNGs are
+    derived from the seed so adding a column never changes the others."""
+    seed = seed_from_env() if seed is None else seed
+    root = np.random.default_rng(seed)
+    child_seeds = root.integers(0, 2 ** 63, len(columns))
+    arrays = []
+    names = []
+    for (name, gen), s in zip(columns, child_seeds):
+        arrays.append(gen.generate(np.random.default_rng(int(s)), n))
+        names.append(name)
+    return pa.table(arrays, names=names)

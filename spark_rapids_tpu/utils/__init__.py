@@ -1,0 +1,10 @@
+"""Auxiliary runtime subsystems: tracing/profiling, LORE dump/replay,
+per-task metrics (SURVEY.md §5)."""
+
+from spark_rapids_tpu.utils.tracing import (  # noqa: F401
+    Profiler,
+    TraceRange,
+    trace_events,
+)
+from spark_rapids_tpu.utils.task_metrics import TaskMetrics  # noqa: F401
+from spark_rapids_tpu.utils.lore import dump_exec_input, replay  # noqa: F401

@@ -1,0 +1,116 @@
+"""LORE-style operator dump/replay.
+
+Reference: GpuLore (GpuOverrides.scala:4903 tagging + LORE dump hook in
+GpuExec.doExecuteColumnar) — dump a tagged operator's INPUT batches to
+files so a problematic operator can be re-run standalone (perf repro /
+debugging) without the full query.
+
+Here: ``dump_exec_input(node, dir)`` wraps an operator's children so every
+input batch is also written to parquet alongside a manifest; ``replay``
+reloads the dump as BatchSourceExec children and re-executes a fresh
+operator built by the caller's factory against identical input.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Iterator, List
+
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.exec.base import BatchSourceExec, TpuExec, UnaryExec
+
+
+class _TapExec(UnaryExec):
+    """Passes batches through while writing each to the dump directory."""
+
+    def __init__(self, child: TpuExec, out_dir: str, child_index: int):
+        super().__init__(child)
+        self.out_dir = out_dir
+        self.child_index = child_index
+        self._counts = {}
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self.child.output_schema
+
+    def num_partitions(self) -> int:
+        return self.child.num_partitions()
+
+    def node_description(self) -> str:
+        return f"LoreTap[{self.child_index}] -> {self.out_dir}"
+
+    def do_execute(self, partition: int) -> Iterator:
+        schema = self.child.output_schema
+        for b in self.child.execute(partition):
+            i = self._counts.get(partition, 0)
+            self._counts[partition] = i + 1
+            path = os.path.join(
+                self.out_dir,
+                f"child{self.child_index}_part{partition}_batch{i}.parquet")
+            pq.write_table(batch_to_arrow(b, schema), path)
+            yield b
+
+
+def dump_exec_input(node: TpuExec, out_dir: str) -> TpuExec:
+    """Wrap ``node`` so its inputs are dumped while it runs. Returns the
+    same node (children replaced with taps) and writes a manifest."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = {
+        "node": node.node_description(),
+        "node_class": type(node).__name__,
+        "children": [],
+    }
+    for ci, child in enumerate(list(node.children)):
+        manifest["children"].append({
+            "index": ci,
+            "partitions": child.num_partitions(),
+            "schema": [(f.name, repr(f.dtype), f.nullable)
+                       for f in child.output_schema],
+        })
+        node.children[ci] = _TapExec(child, out_dir, ci)
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    return node
+
+
+def load_dumped_children(dump_dir: str,
+                         min_bucket: int = 16) -> List[BatchSourceExec]:
+    """Rebuild each dumped child as a BatchSourceExec with identical batch
+    boundaries and partitioning."""
+    with open(os.path.join(dump_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    out = []
+    for child in manifest["children"]:
+        ci = child["index"]
+        parts = []
+        schema = None
+        for p in range(child["partitions"]):
+            batches = []
+            i = 0
+            while True:
+                path = os.path.join(
+                    dump_dir, f"child{ci}_part{p}_batch{i}.parquet")
+                if not os.path.exists(path):
+                    break
+                t = pq.read_table(path)
+                if schema is None:
+                    schema = T.Schema.from_arrow(t.schema)
+                batches.append(batch_from_arrow(t, min_bucket))
+                i += 1
+            parts.append(batches)
+        if schema is None:
+            raise ValueError(f"dump {dump_dir}: child {ci} has no batches")
+        out.append(BatchSourceExec(parts, schema))
+    return out
+
+
+def replay(dump_dir: str,
+           exec_factory: Callable[..., TpuExec]) -> TpuExec:
+    """Re-create the dumped operator over its recorded inputs:
+    ``exec_factory(*sources)`` receives one BatchSourceExec per child."""
+    sources = load_dumped_children(dump_dir)
+    return exec_factory(*sources)

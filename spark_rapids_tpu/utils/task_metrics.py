@@ -1,0 +1,97 @@
+"""Per-task metrics accumulators.
+
+Reference: GpuTaskMetrics.scala:185-311 — per-task retry counts, OOM
+counts, spill/read-spill bytes and times, semaphore wait, and max memory
+footprints, attached to Spark task metrics. Here a thread-local "current
+task" context collects the same counters; the memory/retry/spill layers
+call the hooks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class TaskMetrics:
+    """Accumulators for one task attempt."""
+
+    FIELDS = (
+        "retry_count", "split_and_retry_count", "oom_count",
+        "spill_to_host_bytes", "spill_to_disk_bytes",
+        "read_spill_bytes", "spill_time_ns", "read_spill_time_ns",
+        "semaphore_wait_ns",
+        "max_device_bytes", "max_host_bytes", "max_disk_bytes",
+    )
+
+    def __init__(self, task_id: int = 0):
+        self.task_id = task_id
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def add(self, field: str, v: int):
+        setattr(self, field, getattr(self, field) + v)
+
+    def watermark(self, field: str, v: int):
+        if v > getattr(self, field):
+            setattr(self, field, v)
+
+    def snapshot(self) -> Dict[str, int]:
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+
+_local = threading.local()
+_registry: Dict[int, TaskMetrics] = {}
+_reg_lock = threading.Lock()
+
+
+def current() -> Optional[TaskMetrics]:
+    return getattr(_local, "metrics", None)
+
+
+def start_task(task_id: int) -> TaskMetrics:
+    m = TaskMetrics(task_id)
+    _local.metrics = m
+    with _reg_lock:
+        _registry[task_id] = m
+    return m
+
+
+def finish_task() -> Optional[TaskMetrics]:
+    m = current()
+    _local.metrics = None
+    return m
+
+
+def get_task(task_id: int) -> Optional[TaskMetrics]:
+    with _reg_lock:
+        return _registry.get(task_id)
+
+
+def add(field: str, v: int):
+    """Record into the current task's metrics, if a task is active."""
+    m = current()
+    if m is not None:
+        m.add(field, v)
+
+
+def watermark(field: str, v: int):
+    m = current()
+    if m is not None:
+        m.watermark(field, v)
+
+
+class timed:
+    """Context manager adding elapsed ns to a field of the current task."""
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        add(self.field, time.perf_counter_ns() - self._t0)
+        return False

@@ -1,0 +1,103 @@
+"""Tracing and profiling.
+
+Reference (SURVEY.md §5): NVTX ranges everywhere (NvtxRange /
+NvtxWithMetrics, docs/dev/nvtx_profiling.md) feeding nsys timelines, plus a
+driver-coordinated async profiler (profiler.scala) writing traces to a
+directory. TPU-native mapping: jax.profiler — TraceAnnotation is the NVTX
+range analog (shows up on the XPlane/TensorBoard timeline), start_trace/
+stop_trace the capture window. A lightweight in-process event log rides
+along so tests and metrics can observe ranges without a trace viewer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+_events_lock = threading.Lock()
+_events: List[Dict] = []
+_capture_events = False
+
+
+def trace_events(clear: bool = False) -> List[Dict]:
+    """Recorded {name, start_ns, dur_ns, thread} events (when capturing)."""
+    with _events_lock:
+        out = list(_events)
+        if clear:
+            _events.clear()
+        return out
+
+
+class TraceRange:
+    """NvtxRange analog: annotates the jax profiler timeline and (during a
+    Profiler window or when event capture is on) records an event."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+        self._t0 = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        if _capture_events:
+            with _events_lock:
+                _events.append({
+                    "name": self.name,
+                    "start_ns": self._t0,
+                    "dur_ns": time.perf_counter_ns() - self._t0,
+                    "thread": threading.get_ident(),
+                })
+        return False
+
+
+class Profiler:
+    """Capture-window profiler (profiler.scala analog): start/stop writes a
+    jax profiler trace (XPlane, TensorBoard-viewable) to ``out_dir`` and
+    turns on the in-process event log for the window."""
+
+    def __init__(self, out_dir: str):
+        self.out_dir = out_dir
+        self._active = False
+
+    def start(self):
+        global _capture_events
+        if self._active:
+            return
+        try:
+            jax.profiler.start_trace(self.out_dir)
+        except Exception:
+            pass  # tracing unavailable in some environments; events still on
+        _capture_events = True
+        self._active = True
+
+    def stop(self):
+        global _capture_events
+        if not self._active:
+            return
+        _capture_events = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._active = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,97 @@
+"""Aux subsystem tests: tracing/profiler, task metrics, LORE dump/replay,
+docs generators (reference: NvtxWithMetrics usage, GpuTaskMetrics,
+GpuLore, RapidsConf docs gen / TypeChecks supported_ops gen)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.exec import BatchSourceExec, FilterExec, HashJoinExec
+from spark_rapids_tpu.exprs.expr import col, lit
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.utils import (
+    Profiler, TaskMetrics, TraceRange, dump_exec_input, replay, trace_events,
+)
+from spark_rapids_tpu.utils import task_metrics as TM
+
+
+def test_trace_ranges_recorded(tmp_path):
+    trace_events(clear=True)
+    with Profiler(str(tmp_path / "prof")):
+        with TraceRange("outer"):
+            with TraceRange("inner"):
+                pass
+    ev = trace_events(clear=True)
+    names = [e["name"] for e in ev]
+    assert names == ["inner", "outer"]  # exit order
+    assert all(e["dur_ns"] >= 0 for e in ev)
+    # outside a window, ranges don't record
+    with TraceRange("quiet"):
+        pass
+    assert trace_events() == []
+
+
+def test_task_metrics_lifecycle():
+    m = TM.start_task(42)
+    TM.add("retry_count", 2)
+    TM.add("spill_to_host_bytes", 1 << 20)
+    TM.watermark("max_device_bytes", 100)
+    TM.watermark("max_device_bytes", 50)  # lower: no change
+    with TM.timed("spill_time_ns"):
+        pass
+    done = TM.finish_task()
+    assert done is m
+    snap = m.snapshot()
+    assert snap["retry_count"] == 2
+    assert snap["spill_to_host_bytes"] == 1 << 20
+    assert snap["max_device_bytes"] == 100
+    assert snap["spill_time_ns"] >= 0
+    assert TM.current() is None
+    TM.add("retry_count", 1)  # no active task: silently ignored
+    assert TM.get_task(42) is m
+
+
+def _join_tables(rng):
+    lt = pa.table({"k": pa.array(rng.integers(0, 10, 100), pa.int64()),
+                   "v": pa.array(rng.normal(size=100), pa.float64())})
+    rt = pa.table({"rk": pa.array(rng.integers(0, 10, 30), pa.int64()),
+                   "w": pa.array(rng.integers(0, 99, 30), pa.int64())})
+    return lt, rt
+
+
+def test_lore_dump_and_replay(tmp_path, rng):
+    lt, rt = _join_tables(rng)
+    ls, rs = T.Schema.from_arrow(lt.schema), T.Schema.from_arrow(rt.schema)
+    left = BatchSourceExec([[batch_from_arrow(lt.slice(i, 32), 16)
+                             for i in range(0, 100, 32)]], ls)
+    right = BatchSourceExec([[batch_from_arrow(rt, 16)]], rs)
+    node = HashJoinExec([col("k")], [col("rk")], "inner", left, right)
+    node = dump_exec_input(node, str(tmp_path / "lore"))
+    orig = []
+    for b in node.execute_all():
+        orig.extend(batch_to_arrow(b, node.output_schema).to_pylist())
+    assert os.path.exists(tmp_path / "lore" / "manifest.json")
+    assert os.path.exists(tmp_path / "lore" / "child0_part0_batch1.parquet")
+    # replay against the recorded inputs
+    replayed_node = replay(
+        str(tmp_path / "lore"),
+        lambda l, r: HashJoinExec([col("k")], [col("rk")], "inner", l, r))
+    got = []
+    for b in replayed_node.execute_all():
+        got.extend(batch_to_arrow(b, replayed_node.output_schema).to_pylist())
+    assert sorted(got, key=repr) == sorted(orig, key=repr)
+
+
+def test_docs_generators(tmp_path):
+    from spark_rapids_tpu.plan.docs import generate_supported_ops, write_docs
+
+    md = generate_supported_ops()
+    assert "| Expression |" in md
+    assert "RLike" in md and "HashAggregateExec" in md
+    paths = write_docs(str(tmp_path / "docs"))
+    assert all(os.path.exists(p) for p in paths)
+    cfg = open(paths[0]).read()
+    assert "spark.rapids.tpu" in cfg

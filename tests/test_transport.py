@@ -84,7 +84,8 @@ def test_send_state_windows_bounded():
             sent.append(decode_message(payload))
 
     pool = BounceBufferPool(buffer_size=100, count=1)
-    BufferSendState(1, [b"x" * 450, b"y" * 30], Capture(), pool).run()
+    blocks = [b"x" * 450, b"y" * 30]
+    BufferSendState(1, 2, lambda i: blocks[i], Capture(), pool).run()
     chunks = [m for m in sent if isinstance(m, BufferChunk)]
     assert all(len(c.payload) <= 100 for c in chunks)
     assert len(chunks) == 5 + 1
@@ -168,6 +169,46 @@ def test_heartbeat_discovery_and_loss():
     lost = mgr.sweep_lost()
     assert sorted(lost) == ["b", "c"]
     assert [p[0] for p in mgr.peers()] == ["a"]
+    # a swept peer's next heartbeat re-registers it (transient stall must
+    # not leave it permanently invisible)
+    eps["b"].tick()
+    assert sorted(p[0] for p in mgr.peers()) == ["a", "b"]
+
+
+def test_receive_state_rejects_bad_chunks():
+    from spark_rapids_tpu.shuffle.protocol import BufferChunk
+
+    rs = BufferReceiveState(2, [100, 50])
+    assert rs.on_chunk(BufferChunk(1, 5, 0, 100, b"x")) is not None  # range
+    assert rs.on_chunk(BufferChunk(1, 0, 0, 999, b"x")) is not None  # size lie
+    assert rs.on_chunk(BufferChunk(1, 0, 0, 100, b"a" * 60)) is None
+    # duplicate/hole: offset must continue from received bytes
+    assert rs.on_chunk(BufferChunk(1, 0, 0, 100, b"a" * 60)) is not None
+    assert rs.on_chunk(BufferChunk(1, 0, 60, 100, b"b" * 41)) is not None  # overrun
+    assert rs.on_chunk(BufferChunk(1, 0, 60, 100, b"b" * 40)) is None
+    assert not rs.is_complete()
+    assert rs.on_chunk(BufferChunk(1, 1, 0, 50, b"c" * 50)) is None
+    assert rs.is_complete()
+
+
+def test_client_unknown_frame_fails_fetches_fast(rng):
+    """A connection failure must fail in-flight fetches, not hang them."""
+    import socket
+
+    # mute listener: accepts but never replies, so the transaction stays
+    # in flight until the failure path fires
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen()
+    try:
+        from spark_rapids_tpu.shuffle.transport import connect_tcp as ct
+        client = ct(*lsock.getsockname())
+        txn = client.request_metadata([BlockId(0, 0, 0)])
+        client.conn.on_fail("injected: bad frame")
+        with pytest.raises(RuntimeError, match="bad frame|injected"):
+            txn.wait(timeout=5)
+    finally:
+        lsock.close()
 
 
 def test_shuffle_manager_served_over_transport(tmp_path, rng):
