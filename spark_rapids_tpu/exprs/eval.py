@@ -344,6 +344,13 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         child = eval_expr(expr.child, ctx)
         return cast_val(child, expr.child.dtype, expr.to, ctx.ansi or expr.ansi, cap)
 
+    if hasattr(expr, "eval_columnar"):
+        # columnar UDF protocol (RapidsUDF.evaluateColumnar analog): the
+        # user kernel traces into this same XLA computation
+        vals = [eval_expr(c, ctx) for c in expr.children]
+        data, validity = expr.eval_columnar(vals)
+        return ColVal(data, validity)
+
     if isinstance(expr, E.BinaryArithmetic):
         return _eval_arith(expr, ctx)
     if isinstance(expr, E.BinaryComparison):

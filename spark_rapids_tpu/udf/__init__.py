@@ -1,0 +1,16 @@
+"""User-defined functions (SURVEY.md §2.4 UDF rows).
+
+Three tiers, mirroring the reference:
+- ``TpuUDF`` — user supplies a jax columnar kernel (RapidsUDF's
+  ``evaluateColumnar`` analog): runs fused inside the expression engine.
+- ``compile_udf`` — the udf-compiler analog: translate a plain Python
+  lambda/function into the engine's Expression tree (runs on device with no
+  user kernel at all); returns None on unsupported constructs so callers
+  fall back.
+- ``ArrowEvalPythonExec`` — the Pandas-UDF analog: stream batches to a
+  Python worker process over Arrow IPC and read results back.
+"""
+
+from spark_rapids_tpu.udf.columnar import TpuUDF  # noqa: F401
+from spark_rapids_tpu.udf.compiler import compile_udf  # noqa: F401
+from spark_rapids_tpu.udf.arrow_eval import ArrowEvalPythonExec  # noqa: F401

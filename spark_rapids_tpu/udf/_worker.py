@@ -1,0 +1,81 @@
+"""Standalone Python UDF worker: Arrow IPC over stdin/stdout.
+
+Launched by file path (NOT imported as part of the package) so the worker
+process never imports jax and never touches the TPU — it is a pure
+host-side pandas/pyarrow sandbox, like the reference's Python workers
+(python/rapids/worker.py initializes the worker process specially for the
+same reason).
+
+Protocol: [u32 len][pickled fn] once, then per batch [u32 len][arrow IPC
+stream]; responses are [u32 len][b"O" + IPC] or [u32 len][b"E" + message].
+"""
+
+import pickle
+import struct
+import sys
+
+import pyarrow as pa
+
+
+def _normalize(res, n_rows, name="_udf_out"):
+    """Shared with the parent process (arrow_eval imports this module —
+    safe: this module itself imports only pyarrow/stdlib)."""
+    if isinstance(res, pa.Table):
+        out = res
+    elif isinstance(res, pa.Array):
+        out = pa.table([res], names=[name])
+    elif isinstance(res, pa.ChunkedArray):
+        out = pa.table([res.combine_chunks()], names=[name])
+    else:
+        import pandas as pd
+
+        if isinstance(res, pd.Series):
+            out = pa.table([pa.Array.from_pandas(res)], names=[name])
+        elif isinstance(res, pd.DataFrame):
+            out = pa.Table.from_pandas(res, preserve_index=False)
+        else:
+            raise TypeError(f"UDF returned {type(res).__name__}")
+    if out.num_rows != n_rows:
+        raise ValueError(
+            f"scalar UDF must return {n_rows} rows, got {out.num_rows}")
+    return out
+
+
+def main():
+    import os
+
+    stdin = sys.stdin.buffer
+    # fd 1 is the length-prefixed protocol channel: steal it, then point
+    # fd 1 (and sys.stdout) at stderr so a print() inside the user UDF
+    # cannot corrupt the framing
+    stdout = os.fdopen(os.dup(1), "wb")
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+    # frame 1: parent's sys.path (so the fn's defining module resolves);
+    # frame 2: the pickled fn itself
+    (n,) = struct.unpack("<I", stdin.read(4))
+    for p in pickle.loads(stdin.read(n)):
+        if p not in sys.path:
+            sys.path.append(p)
+    (n,) = struct.unpack("<I", stdin.read(4))
+    fn = pickle.loads(stdin.read(n))
+    while True:
+        head = stdin.read(4)
+        if len(head) < 4:
+            return
+        (n,) = struct.unpack("<I", head)
+        table = pa.ipc.open_stream(pa.py_buffer(stdin.read(n))).read_all()
+        try:
+            res = _normalize(fn(table), table.num_rows)
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, res.schema) as w:
+                w.write_table(res)
+            blob = b"O" + sink.getvalue().to_pybytes()
+        except Exception as e:
+            blob = b"E" + str(e).encode()
+        stdout.write(struct.pack("<I", len(blob)) + blob)
+        stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""Arrow-IPC Python worker execution (Pandas UDF path).
+
+Reference: GpuArrowEvalPythonExec and friends
+(org/apache/spark/sql/rapids/execution/python/, SURVEY.md §2.4): device
+batches are serialized as Arrow and streamed to a Python worker process;
+results stream back and rejoin the columnar pipeline. Same shape here —
+the worker is a subprocess fed Arrow IPC over pipes (the fn travels
+pickled); a fn that can't pickle (lambdas/closures) runs in-process
+instead, which is semantically identical and still batch-columnar.
+
+The UDF contract is Spark's scalar Pandas-UDF shape: ``fn(table) ->
+pa.Table|pa.Array|pandas`` per input batch; output columns are appended to
+the child's output (one result column for the common case).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import subprocess
+import sys
+from typing import Callable, Iterator, Optional, Sequence
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+
+
+from spark_rapids_tpu.udf._worker import _normalize as _normalize_result
+
+
+class _SubprocessWorker:
+    """Python worker process: pickled fn once, then Arrow IPC per batch.
+
+    The worker script is launched BY FILE PATH so it never imports this
+    package (and thus never imports jax / touches the TPU device)."""
+
+    def __init__(self, fn_blob: bytes):
+        import os
+
+        worker = os.path.join(os.path.dirname(__file__), "_worker.py")
+        self.proc = subprocess.Popen(
+            [sys.executable, worker],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+        # the fn's defining module must resolve in the worker
+        paths = pickle.dumps([p for p in sys.path if p])
+        self.proc.stdin.write(struct.pack("<I", len(paths)) + paths)
+        self.proc.stdin.write(struct.pack("<I", len(fn_blob)) + fn_blob)
+        self.proc.stdin.flush()
+
+    def eval(self, table: pa.Table) -> pa.Table:
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as w:
+            w.write_table(table)
+        blob = sink.getvalue().to_pybytes()
+        self.proc.stdin.write(struct.pack("<I", len(blob)) + blob)
+        self.proc.stdin.flush()
+        head = self._read_exact(4)
+        if head is None:
+            raise RuntimeError("python worker died")
+        (n,) = struct.unpack("<I", head)
+        out = self._read_exact(n)
+        if out is None:
+            raise RuntimeError("python worker died mid-response")
+        if out[:1] == b"E":
+            raise RuntimeError(f"python worker: {out[1:].decode()}")
+        return pa.ipc.open_stream(pa.py_buffer(out[1:])).read_all()
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            part = self.proc.stdout.read(n - len(buf))
+            if not part:
+                return None
+            buf += part
+        return bytes(buf)
+
+    def close(self):
+        try:
+            self.proc.stdin.close()
+            self.proc.wait(timeout=5)
+        except Exception:
+            self.proc.kill()
+
+
+class ArrowEvalPythonExec(UnaryExec):
+    """Appends UDF result column(s) to the child output.
+
+    ``fn(pa.Table) -> Table/Array/pandas`` is called once per batch with the
+    selected input columns. Runs in a worker subprocess when the fn is
+    picklable (process isolation like the reference's Python workers), else
+    in-process."""
+
+    def __init__(self, fn: Callable, result_fields: Sequence[T.Field],
+                 child: TpuExec,
+                 input_columns: Optional[Sequence[str]] = None,
+                 use_process: bool = True):
+        super().__init__(child)
+        self.fn = fn
+        self.result_fields = list(result_fields)
+        self.input_columns = list(input_columns) if input_columns else None
+        self.use_process = use_process
+        self._register_metric("udfTimeNs")
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return T.Schema(list(self.child.output_schema) + self.result_fields)
+
+    def node_description(self) -> str:
+        names = [f.name for f in self.result_fields]
+        return f"TpuArrowEvalPython {names}"
+
+    def do_execute(self, partition: int) -> Iterator:
+        cs = self.child.output_schema
+        worker = None
+        if self.use_process:
+            try:
+                worker = _SubprocessWorker(pickle.dumps(self.fn))
+            except Exception:
+                worker = None  # unpicklable: run in-process
+        try:
+            for b in self.child.execute(partition):
+                t = batch_to_arrow(b, cs)
+                inp = t.select(self.input_columns) \
+                    if self.input_columns else t
+                with self.timer("udfTimeNs"):
+                    if worker is not None:
+                        res = worker.eval(inp)
+                    else:
+                        res = _normalize_result(self.fn(inp), t.num_rows)
+                # the declared result_fields are the contract downstream
+                # operators bind against: enforce arity and cast dtypes
+                if res.num_columns != len(self.result_fields):
+                    raise ValueError(
+                        f"UDF returned {res.num_columns} columns, declared "
+                        f"{len(self.result_fields)}")
+                res = res.rename_columns(
+                    [f.name for f in self.result_fields])
+                res = res.cast(pa.schema(
+                    [pa.field(f.name, f.dtype.arrow_type(), f.nullable)
+                     for f in self.result_fields]))
+                combined = t
+                for name in res.column_names:
+                    combined = combined.append_column(
+                        res.schema.field(name), res.column(name))
+                yield batch_from_arrow(combined, 16)
+        finally:
+            if worker is not None:
+                worker.close()

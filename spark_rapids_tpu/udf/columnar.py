@@ -1,0 +1,55 @@
+"""Columnar jax UDFs evaluated inside the fused expression engine.
+
+Reference: the RapidsUDF interface (sql-plugin-api RapidsUDF.java +
+GpuUserDefinedFunction.scala): a user-provided columnar kernel invoked on
+device columns, composing with the rest of the expression tree. Here the
+kernel is a jax function over (data, validity) pairs — it traces into the
+same XLA computation as the surrounding expressions, so a TpuUDF costs no
+extra kernel launch at all.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs import expr as E
+
+
+class TpuUDF(E.Expression):
+    """Expression wrapping a user jax kernel.
+
+    ``fn(*colvals) -> (data, validity)`` receives one ``ColVal``
+    (data, validity) per child, already padded to the batch capacity, and
+    returns the output pair with the same capacity.
+    """
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: Sequence[E.Expression], name: str = "udf"):
+        if not return_type.fixed_width:
+            # the (data, validity) contract has no offsets; variable-width
+            # results need the ArrowEvalPython path instead
+            raise TypeError(
+                f"TpuUDF returns fixed-width types only, got {return_type}")
+        self.fn = fn
+        self.return_type = return_type
+        self.children = tuple(E._lit(c) for c in children)
+        self.name = name
+
+    @property
+    def dtype(self) -> T.DataType:
+        return self.return_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _rebuilt(self, children):
+        return TpuUDF(self.fn, self.return_type, children, self.name)
+
+    def eval_columnar(self, child_vals):
+        """Called by the expression engine with one ColVal per child."""
+        return self.fn(*child_vals)
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.children))})"

@@ -1,0 +1,264 @@
+"""Python UDF -> Expression compiler.
+
+Reference: udf-compiler/ (5.9k LoC Scala) decompiles JVM bytecode of Scala
+UDFs into Catalyst expressions so they run on device with no user kernel;
+unsupported constructs fall back to the original UDF. The TPU-native analog
+compiles a Python lambda/def's AST into this engine's Expression tree:
+arithmetic, comparisons, boolean logic, conditional expressions, a math
+whitelist, and common string methods. ``compile_udf`` returns None on
+anything it can't prove translatable — the caller then uses
+ArrowEvalPythonExec (the real-Python path) instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import inspect
+import math as _math
+import textwrap
+from typing import Callable, Dict, Optional
+
+from spark_rapids_tpu.exprs import expr as E
+
+_BINOPS = {
+    ast.Add: E.Add, ast.Sub: E.Subtract, ast.Mult: E.Multiply,
+    ast.Div: E.Divide, ast.Pow: E.Pow,
+    # Mod/FloorDiv handled specially: Python is FLOORED, the engine's
+    # Remainder/IntegralDivide are Java-truncated
+}
+_CMPOPS = {
+    ast.Eq: E.EqualTo, ast.NotEq: None,  # Not(EqualTo)
+    ast.Lt: E.LessThan, ast.LtE: E.LessThanOrEqual,
+    ast.Gt: E.GreaterThan, ast.GtE: E.GreaterThanOrEqual,
+}
+_MATH_FNS = {
+    "sqrt": E.Sqrt, "exp": E.Exp, "log": E.Log, "abs": E.Abs,
+    "floor": E.Floor, "ceil": E.Ceil,
+}
+#: the object each whitelist name must actually be bound to in the UDF's
+#: environment — a user rebinding `log`/`sqrt` must not silently get the
+#: whitelist semantic
+_EXPECTED_GLOBALS = {
+    "sqrt": (_math.sqrt,), "exp": (_math.exp,), "log": (_math.log,),
+    "abs": (builtins.abs, _math.fabs), "floor": (_math.floor,),
+    "ceil": (_math.ceil,), "len": (builtins.len,),
+}
+_PY_WHITESPACE = " \t\n\r\x0b\x0c"
+_STR_METHODS = {
+    "upper": E.Upper, "lower": E.Lower,
+}
+
+
+class _Unsupported(Exception):
+    pass
+
+
+def compile_udf(fn: Callable) -> Optional[Callable[..., E.Expression]]:
+    """Compile a Python function of N scalar args into an Expression
+    builder of N child expressions. None when not translatable."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return None
+    fdef = _find_function(tree)
+    if fdef is None:
+        return None
+    if isinstance(fdef, ast.Lambda):
+        params = [a.arg for a in fdef.args.args]
+        body = fdef.body
+    else:
+        params = [a.arg for a in fdef.args.args]
+        body = _single_return(fdef)
+        if body is None:
+            return None
+
+    fn_globals = getattr(fn, "__globals__", {})
+
+    def builder(*children: E.Expression) -> E.Expression:
+        if len(children) != len(params):
+            raise ValueError(f"udf takes {len(params)} args")
+        env = dict(zip(params, (E._lit(c) for c in children)))
+        return _compile_node(body, env, fn_globals)
+
+    try:  # probe once with dummy columns so failures surface at compile time
+        builder(*[E.col(p) for p in params])
+    except _Unsupported:
+        return None
+    return builder
+
+
+def _find_function(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+            return node
+    return None
+
+
+def _single_return(fdef: ast.FunctionDef):
+    """Support a straight-line body of assignments ending in a return by
+    inlining the assignments (SSA-ish), else None."""
+    assigns: Dict[str, ast.expr] = {}
+    for stmt in fdef.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            assigns[stmt.targets[0].id] = _inline(stmt.value, assigns)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            return _inline(stmt.value, assigns)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                       ast.Constant):
+            continue  # docstring
+        else:
+            return None
+    return None
+
+
+def _inline(node: ast.expr, assigns: Dict[str, ast.expr]) -> ast.expr:
+    class Sub(ast.NodeTransformer):
+        def visit_Name(self, n: ast.Name):
+            if isinstance(n.ctx, ast.Load) and n.id in assigns:
+                return assigns[n.id]
+            return n
+
+    return Sub().visit(node)
+
+
+def _is_boolish(node: ast.expr) -> bool:
+    """Syntactically guaranteed to evaluate to a boolean — Python's
+    truthiness-returning and/or over non-booleans is NOT translatable."""
+    if isinstance(node, (ast.Compare, ast.BoolOp)):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return True
+    if isinstance(node, ast.Constant) and isinstance(node.value, bool):
+        return True
+    return False
+
+
+def _positive_literal(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool)
+            and node.value > 0)
+
+
+def _compile_node(node: ast.expr, env, fn_globals) -> E.Expression:
+    rec = lambda n: _compile_node(n, env, fn_globals)
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise _Unsupported(f"free variable {node.id}")
+    if isinstance(node, ast.Constant):
+        if node.value is None or isinstance(node.value, (bool, int, float,
+                                                         str)):
+            return E._lit(node.value) if node.value is not None else \
+                E.Literal.of(None)
+        raise _Unsupported(f"constant {node.value!r}")
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, (ast.Mod, ast.FloorDiv)):
+            # Python % and // are FLOORED; for a positive literal divisor
+            # floored-mod == pmod, and floored-div = (a - pmod(a,b)) / b
+            if not _positive_literal(node.right):
+                raise _Unsupported(
+                    "%/'//' only with a positive literal divisor "
+                    "(Python floored vs engine truncated semantics)")
+            a = rec(node.left)
+            b = rec(node.right)
+            if isinstance(node.op, ast.Mod):
+                return E.Pmod(a, b)
+            return E.IntegralDivide(E.Subtract(a, E.Pmod(a, b)), b)
+        op = _BINOPS.get(type(node.op))
+        if op is None:
+            raise _Unsupported(ast.dump(node.op))
+        return op(rec(node.left), rec(node.right))
+    if isinstance(node, ast.UnaryOp):
+        if isinstance(node.op, ast.USub):
+            return E.UnaryMinus(rec(node.operand))
+        if isinstance(node.op, ast.Not):
+            return E.Not(rec(node.operand))
+        raise _Unsupported(ast.dump(node.op))
+    if isinstance(node, ast.BoolOp):
+        # Python and/or return the last VALUE via truthiness; only compile
+        # when every operand is provably boolean (then and/or == logic ops)
+        if not all(_is_boolish(v) for v in node.values):
+            raise _Unsupported("and/or over non-boolean operands")
+        op = E.And if isinstance(node.op, ast.And) else E.Or
+        out = rec(node.values[0])
+        for v in node.values[1:]:
+            out = op(out, rec(v))
+        return out
+    if isinstance(node, ast.Compare):
+        if len(node.ops) != 1:
+            raise _Unsupported("chained comparison")
+        cls = _CMPOPS.get(type(node.ops[0]), _Unsupported)
+        left = rec(node.left)
+        right = rec(node.comparators[0])
+        if cls is _Unsupported:
+            raise _Unsupported(ast.dump(node.ops[0]))
+        if cls is None:  # NotEq
+            return E.Not(E.EqualTo(left, right))
+        return cls(left, right)
+    if isinstance(node, ast.IfExp):
+        return E.If(rec(node.test), rec(node.body), rec(node.orelse))
+    if isinstance(node, ast.Call):
+        return _compile_call(node, env, fn_globals)
+    raise _Unsupported(type(node).__name__)
+
+
+def _check_binding(name: str, fn_globals) -> None:
+    """The name must resolve to the exact whitelisted object in the UDF's
+    environment (a rebinding like `from math import log10 as log` must
+    fall back, not silently compile to the wrong function)."""
+    expected = _EXPECTED_GLOBALS.get(name)
+    if expected is None:
+        raise _Unsupported(f"call {name}")
+    if name in fn_globals:
+        if fn_globals[name] not in expected:
+            raise _Unsupported(f"{name} is rebound in UDF globals")
+        return
+    if getattr(builtins, name, None) in expected:
+        return
+    raise _Unsupported(f"cannot resolve {name}")
+
+
+def _compile_call(node: ast.Call, env, fn_globals) -> E.Expression:
+    if node.keywords:
+        raise _Unsupported("keyword args")
+    args = [_compile_node(a, env, fn_globals) for a in node.args]
+    f = node.func
+    # math.sqrt(x) / plain sqrt(x) / abs(x)
+    name = None
+    if isinstance(f, ast.Name):
+        name = f.id
+        if name in _MATH_FNS or name == "len":
+            _check_binding(name, fn_globals)
+    elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) and \
+            f.value.id == "math":
+        if fn_globals.get("math") is not _math:
+            raise _Unsupported("math is rebound in UDF globals")
+        name = f.attr
+    if name is not None:
+        cls = _MATH_FNS.get(name)
+        if cls is not None and len(args) == 1:
+            return cls(args[0])
+        if name == "len" and len(args) == 1:
+            return E.Length(args[0])
+        raise _Unsupported(f"call {name}")
+    # string methods: x.upper() etc.
+    if isinstance(f, ast.Attribute):
+        recv = _compile_node(f.value, env, fn_globals)
+        cls = _STR_METHODS.get(f.attr)
+        if cls is not None and not args:
+            return cls(recv)
+        if f.attr == "strip" and not args:
+            # Python strip() removes ALL whitespace, not just spaces
+            return E.StringTrim(recv, _PY_WHITESPACE)
+        if f.attr == "startswith" and len(args) == 1:
+            return E.StartsWith(recv, args[0])
+        if f.attr == "endswith" and len(args) == 1:
+            return E.EndsWith(recv, args[0])
+        if f.attr == "replace" and len(args) == 2:
+            return E.StringReplace(recv, args[0], args[1])
+        raise _Unsupported(f"method {f.attr}")
+    raise _Unsupported("call form")
