@@ -1,0 +1,14 @@
+"""Delta Lake integration (lite).
+
+Reference: delta-lake/ (180 files / 40.6k LoC across delta versions —
+SURVEY.md §2.9): GPU-accelerated MERGE/UPDATE/DELETE commands,
+GpuDeltaParquetFileFormat with deletion-vector awareness, optimistic
+transaction log commits. This lite implementation covers the same command
+surface on the TPU engine over a JSON-action `_delta_log` (the open Delta
+protocol's action format: metaData/add/remove/commitInfo), with
+deletion-vector sidecars for DELETE and copy-on-write rewrites for
+UPDATE/MERGE.
+"""
+
+from spark_rapids_tpu.delta.log import DeltaLog, DeltaSnapshot  # noqa: F401
+from spark_rapids_tpu.delta.table import DeltaTable  # noqa: F401

@@ -1,0 +1,223 @@
+"""Delta table operations on the TPU engine: scan (DV-aware), append,
+DELETE, UPDATE, MERGE.
+
+Reference command surface (delta-lake/, SURVEY.md §2.9): GpuDeleteCommand,
+GpuUpdateCommand, GpuMergeIntoCommand, GpuDeltaParquetFileFormat (deletion-
+vector-aware scans via GpuDeltaParquetFileFormatBase). Semantics here:
+
+- scan: active files -> parquet read; files with a deletion vector get
+  their deleted rows filtered out on device (row-index filter — the same
+  thing the reference's DV-aware scan does after the metadata row-index
+  column is materialized).
+- DELETE with predicate: files with matches get a deletion-vector sidecar
+  (merge-on-read, the reference's DV write path).
+- UPDATE / MERGE: copy-on-write — matched files are rewritten through the
+  engine's expression/join operators, commit swaps add/remove actions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.delta.log import AddFile, DeltaLog, read_dv, write_dv
+from spark_rapids_tpu.exec import BatchSourceExec, FilterExec, HashJoinExec
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.exprs import eval as EV
+from spark_rapids_tpu.exprs import expr as E
+
+
+def _schema_to_delta_json(schema: pa.Schema) -> str:
+    _MAP = {"int64": "long", "int32": "integer", "double": "double",
+            "float": "float", "bool": "boolean", "string": "string",
+            "date32[day]": "date"}
+    fields = [{"name": f.name,
+               "type": _MAP.get(str(f.type), str(f.type)),
+               "nullable": f.nullable, "metadata": {}} for f in schema]
+    return json.dumps({"type": "struct", "fields": fields})
+
+
+class DeltaTable:
+    def __init__(self, path: str):
+        self.path = path
+        self.log = DeltaLog(path)
+
+    # -- write -------------------------------------------------------------
+    @staticmethod
+    def create(path: str, table: pa.Table) -> "DeltaTable":
+        t = DeltaTable(path)
+        os.makedirs(path, exist_ok=True)
+        add = t._write_file(table)
+        t.log.commit([add], [], "WRITE",
+                     schema_json=_schema_to_delta_json(table.schema))
+        return t
+
+    def append(self, table: pa.Table) -> int:
+        add = self._write_file(table)
+        return self.log.commit([add], [], "WRITE")
+
+    def _write_file(self, table: pa.Table) -> AddFile:
+        name = f"part-{uuid.uuid4().hex}.parquet"
+        full = os.path.join(self.path, name)
+        pq.write_table(table, full)
+        return AddFile(name, os.path.getsize(full), table.num_rows, {})
+
+    # -- read --------------------------------------------------------------
+    def _file_table(self, add: AddFile) -> pa.Table:
+        t = pq.read_table(os.path.join(self.path, add.path))
+        if add.deletion_vector:
+            deleted = read_dv(self.path, add.deletion_vector)
+            keep = np.ones(t.num_rows, bool)
+            keep[deleted[deleted < t.num_rows]] = False
+            t = t.filter(pa.array(keep))
+        return t
+
+    def to_arrow(self, version: Optional[int] = None) -> pa.Table:
+        snap = self.log.snapshot(version)
+        tables = [self._file_table(a) for a in snap.files]
+        if not tables:
+            raise ValueError("empty table")
+        return pa.concat_tables(tables)
+
+    def scan_exec(self, version: Optional[int] = None,
+                  min_bucket: int = 1024) -> TpuExec:
+        """DV-aware scan as an engine source node (one partition)."""
+        t = self.to_arrow(version)
+        schema = T.Schema.from_arrow(t.schema)
+        return BatchSourceExec([[batch_from_arrow(t, min_bucket)]], schema)
+
+    # -- DELETE (merge-on-read via deletion vectors) -----------------------
+    def delete(self, condition: E.Expression) -> int:
+        """Rows matching ``condition`` are deleted by DV sidecar."""
+        snap = self.log.snapshot()
+        adds, removes = [], []
+        for add in snap.files:
+            t = pq.read_table(os.path.join(self.path, add.path))
+            schema = T.Schema.from_arrow(t.schema)
+            mask = self._eval_mask(condition, t, schema)
+            if add.deletion_vector:
+                already = read_dv(self.path, add.deletion_vector)
+                mask[already[already < t.num_rows]] = False
+                prior = set(int(i) for i in already)
+            else:
+                prior = set()
+            hit = np.nonzero(mask)[0]
+            if hit.size == 0:
+                continue
+            all_deleted = sorted(prior | set(int(i) for i in hit))
+            if len(all_deleted) >= t.num_rows:
+                removes.append(add.path)  # fully deleted: drop the file
+                continue
+            dv = write_dv(self.path, np.asarray(all_deleted))
+            removes.append(add.path)
+            adds.append(AddFile(add.path, add.size,
+                                t.num_rows - len(all_deleted),
+                                add.partition_values, dv))
+        if not adds and not removes:
+            return snap.version
+        return self.log.commit(adds, removes, "DELETE")
+
+    def _eval_mask(self, condition: E.Expression, t: pa.Table,
+                   schema: T.Schema) -> np.ndarray:
+        """Device-evaluate a predicate over one file's rows."""
+        b = batch_from_arrow(t, 16)
+        bound = E.resolve(condition, schema)
+        res = EV.eval_expr(bound, EV.EvalContext(b))
+        data = np.asarray(res.data)[: t.num_rows]
+        valid = np.asarray(res.validity)[: t.num_rows]
+        return data & valid
+
+    # -- UPDATE (copy-on-write) --------------------------------------------
+    def update(self, condition: E.Expression,
+               assignments: Dict[str, E.Expression]) -> int:
+        """Rewrite files containing matches through the engine's projection:
+        each column becomes If(cond, assignment, col)."""
+        from spark_rapids_tpu.exec import ProjectExec
+
+        snap = self.log.snapshot()
+        adds, removes = [], []
+        for add in snap.files:
+            t = self._file_table(add)
+            schema = T.Schema.from_arrow(t.schema)
+            mask = self._eval_mask(condition, t, schema)
+            if not mask.any():
+                continue
+            src = BatchSourceExec([[batch_from_arrow(t, 16)]], schema)
+            exprs = []
+            for f in schema:
+                if f.name in assignments:
+                    exprs.append(E.Alias(
+                        E.If(condition, assignments[f.name], E.col(f.name)),
+                        f.name))
+                else:
+                    exprs.append(E.Alias(E.col(f.name), f.name))
+            node = ProjectExec(exprs, src)
+            new_t = pa.concat_tables(
+                batch_to_arrow(b, node.output_schema)
+                for b in node.execute_all()).cast(t.schema)
+            adds.append(self._write_file(new_t))
+            removes.append(add.path)
+        if not adds:
+            return snap.version
+        return self.log.commit(adds, removes, "UPDATE")
+
+    # -- MERGE -------------------------------------------------------------
+    def merge(self, source: pa.Table, on_target: str, on_source: str,
+              when_matched_update: Optional[Dict[str, str]] = None,
+              when_not_matched_insert: bool = True) -> int:
+        """MERGE INTO target USING source ON target.k = source.k
+        WHEN MATCHED THEN UPDATE SET tcol = scol ...
+        WHEN NOT MATCHED THEN INSERT (columns matched by name).
+
+        Copy-on-write per matched file (GpuMergeIntoCommand's low-shuffle
+        shape: only files containing matches are rewritten); the matched-row
+        substitution itself is host-side in this lite version."""
+        snap = self.log.snapshot()
+        src_by_key = {r[on_source]: r for r in source.to_pylist()}
+        src_keys = set(src_by_key)
+        adds, removes = [], []
+        matched_target_keys = set()
+        for add in snap.files:
+            t = self._file_table(add)
+            tkeys = t.column(on_target).to_pylist()
+            hits = [i for i, k in enumerate(tkeys) if k in src_keys]
+            matched_target_keys.update(tkeys[i] for i in hits)
+            if not hits:
+                continue
+            # rewrite this file: matched rows take source values
+            rows = t.to_pylist()
+            for i in hits:
+                srow = src_by_key[tkeys[i]]
+                if when_matched_update:
+                    for tcol, scol in when_matched_update.items():
+                        rows[i][tcol] = srow[scol]
+            new_t = pa.Table.from_pylist(rows, schema=t.schema)
+            adds.append(self._write_file(new_t))
+            removes.append(add.path)
+        if when_not_matched_insert:
+            if snap.files:
+                target_schema = pq.read_schema(
+                    os.path.join(self.path, snap.files[0].path))
+            else:
+                target_schema = source.schema
+            unmatched = [r for r in source.to_pylist()
+                         if r[on_source] not in matched_target_keys]
+            if unmatched:
+                ins_rows = []
+                names = set(target_schema.names)
+                for r in unmatched:
+                    ins_rows.append({k: v for k, v in r.items()
+                                     if k in names})
+                ins = pa.Table.from_pylist(ins_rows, schema=target_schema)
+                adds.append(self._write_file(ins))
+        if not adds and not removes:
+            return snap.version
+        return self.log.commit(adds, removes, "MERGE")

@@ -1,0 +1,76 @@
+"""Bloom filter build + might_contain on device (runtime filter joins).
+
+Reference: BloomFilterMightContain / BloomFilterAggregate via jni
+BloomFilter (SURVEY.md §2.4) — Spark's InjectRuntimeFilter builds a bloom
+filter over the build side's join keys and pushes a might_contain filter
+into the probe side's scan. Here the filter is a device uint32 bit array:
+build is one scatter over k hash positions per row, probe is k gathers —
+both single fused XLA ops.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec import kernels as K
+
+
+class BloomFilter(NamedTuple):
+    bits: jax.Array       # bool, one entry per bit (scatter-set is
+    num_bits: int         # idempotent, so build order never matters)
+    num_hashes: int
+
+    def nbytes(self) -> int:
+        return int(self.bits.shape[0])
+
+
+def optimal_params(expected_items: int, fpp: float = 0.03):
+    """Standard bloom sizing (matches Spark's BloomFilter.optimalNumOfBits)."""
+    m = max(64, int(-expected_items * math.log(fpp) / (math.log(2) ** 2)))
+    k = max(1, round(m / max(expected_items, 1) * math.log(2)))
+    return m, min(k, 8)
+
+
+def _positions(h: jax.Array, num_bits: int, num_hashes: int):
+    """k derived positions per row via the double-hashing scheme Spark's
+    bloom filter uses (h1 + i*h2)."""
+    h1 = (h & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint64)
+    h2 = (h >> jnp.uint64(32)).astype(jnp.uint64) | jnp.uint64(1)
+    out = []
+    for i in range(num_hashes):
+        out.append(((h1 + jnp.uint64(i) * h2)
+                    % jnp.uint64(num_bits)).astype(jnp.int32))
+    return out
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def build_bloom_filter(batch: ColumnarBatch, key_cols: Sequence[int],
+                       num_bits: int, num_hashes: int) -> jax.Array:
+    """BloomFilterAggregate: set k bits per live row (one idempotent
+    scatter per hash). Merging partial filters across batches/partitions is
+    elementwise OR."""
+    h = K.hash_keys(batch, list(key_cols))
+    live = batch.active_mask()
+    bits = jnp.zeros(num_bits, jnp.bool_)
+    for pos in _positions(h, num_bits, num_hashes):
+        pos = jnp.where(live, pos, num_bits)  # padding rows drop
+        bits = bits.at[pos].set(True, mode="drop")
+    return bits
+
+
+@partial(jax.jit, static_argnums=(1, 3, 4))
+def might_contain(batch: ColumnarBatch, key_cols: Sequence[int],
+                  bits: jax.Array, num_bits: int,
+                  num_hashes: int) -> jax.Array:
+    """BloomFilterMightContain: True when every derived bit is set."""
+    h = K.hash_keys(batch, list(key_cols))
+    out = jnp.ones(batch.capacity, jnp.bool_)
+    for pos in _positions(h, num_bits, num_hashes):
+        out = out & bits[jnp.clip(pos, 0, num_bits - 1)]
+    return out & batch.active_mask()

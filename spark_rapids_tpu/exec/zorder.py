@@ -1,0 +1,92 @@
+"""Z-order / Hilbert clustering indexes on device.
+
+Reference: org/apache/spark/sql/rapids/zorder/ (GpuInterleaveBits,
+GpuHilbertLongIndex backed by jni ZOrder) used for Delta OPTIMIZE ZORDER BY.
+Both are pure integer bit-kernels, a natural XLA fit: columns are rank-
+normalized to unsigned ints, then bit-interleaved (Z-curve) or walked
+through the Hilbert state machine via lax.fori-style unrolled rounds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec import kernels as K
+
+
+def _normalize_u32(col, capacity: int) -> jax.Array:
+    """Order-preserving uint32 normalization of a column: dense ranks
+    (argsort-of-argsort over the sortable key) scaled to fill the full u32
+    range, so the curve's TOP bits discriminate regardless of the raw value
+    distribution."""
+    keys = K.sortable_keys(col, ascending=True, nulls_first=True)
+    data_key = keys[-2]  # most significant data key
+    order = jnp.argsort(data_key, stable=True)
+    ranks = jnp.zeros(capacity, jnp.uint32)
+    ranks = ranks.at[order].set(jnp.arange(capacity, dtype=jnp.uint32))
+    shift = 32 - max((capacity - 1).bit_length(), 1)
+    return ranks << jnp.uint32(shift)
+
+
+def interleave_bits(batch: ColumnarBatch,
+                    key_cols: Sequence[int]) -> jax.Array:
+    """Z-curve index: interleave the top bits of each normalized key.
+
+    With k columns, emits a uint64 using the top floor(64/k) bits of each
+    (GpuInterleaveBits semantics on normalized inputs)."""
+    k = len(key_cols)
+    bits_per = min(64 // k, 32)  # normalized keys carry 32 bits each
+    cap = batch.capacity
+    cols = [_normalize_u32(batch.columns[i], cap) for i in key_cols]
+    out = jnp.zeros(cap, jnp.uint64)
+    for b in range(bits_per):
+        src_bit = 31 - b  # most significant first
+        for ci, c in enumerate(cols):
+            bit = (c >> jnp.uint32(src_bit)) & jnp.uint32(1)
+            pos = 63 - (b * k + ci)
+            out = out | (bit.astype(jnp.uint64) << jnp.uint64(pos))
+    return out
+
+
+def hilbert_index(batch: ColumnarBatch, key_cols: Sequence[int],
+                  order: int = 16) -> jax.Array:
+    """2D Hilbert curve index (GpuHilbertLongIndex analog) for two key
+    columns; better locality than the Z-curve for range queries."""
+    assert len(key_cols) == 2, "hilbert_index is 2-D"
+    cap = batch.capacity
+    x = (_normalize_u32(batch.columns[key_cols[0]], cap)
+         >> jnp.uint32(32 - order)).astype(jnp.uint32)
+    y = (_normalize_u32(batch.columns[key_cols[1]], cap)
+         >> jnp.uint32(32 - order)).astype(jnp.uint32)
+    d = jnp.zeros(cap, jnp.uint64)
+    s_val = 1 << (order - 1)  # static python loop: unrolls under jit
+    while s_val > 0:
+        s = jnp.uint32(s_val)
+        rx = jnp.where((x & s) > 0, jnp.uint32(1), jnp.uint32(0))
+        ry = jnp.where((y & s) > 0, jnp.uint32(1), jnp.uint32(0))
+        d = d + jnp.uint64(s_val) * jnp.uint64(s_val) * (
+            (jnp.uint64(3) * rx.astype(jnp.uint64))
+            ^ ry.astype(jnp.uint64))
+        # rotate quadrant
+        swap = ry == 0
+        flip = swap & (rx == 1)
+        x_flip = jnp.where(flip, jnp.uint32(s_val - 1) - x, x)
+        y_flip = jnp.where(flip, jnp.uint32(s_val - 1) - y, y)
+        x = jnp.where(swap, y_flip, x_flip)
+        y = jnp.where(swap, x_flip, y_flip)
+        s_val //= 2
+    return d
+
+
+def zorder_sort_indices(batch: ColumnarBatch, key_cols: Sequence[int],
+                        curve: str = "z") -> jax.Array:
+    """Row order that clusters by the chosen space-filling curve (the sort
+    OPTIMIZE ZORDER BY performs)."""
+    idx = (interleave_bits(batch, key_cols) if curve == "z"
+           else hilbert_index(batch, key_cols))
+    idx = jnp.where(batch.active_mask(), idx, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    return jnp.argsort(idx).astype(jnp.int32)

@@ -115,7 +115,10 @@ class ArrowEvalPythonExec(UnaryExec):
     def do_execute(self, partition: int) -> Iterator:
         cs = self.child.output_schema
         worker = None
-        if self.use_process:
+        # functions from __main__ pickle by reference but cannot unpickle in
+        # the worker (whose __main__ is the worker script) — run in-process
+        if self.use_process and getattr(self.fn, "__module__",
+                                        "__main__") != "__main__":
             try:
                 worker = _SubprocessWorker(pickle.dumps(self.fn))
             except Exception:

@@ -17,7 +17,7 @@ import builtins
 import inspect
 import math as _math
 import textwrap
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Sequence
 
 from spark_rapids_tpu.exprs import expr as E
 
@@ -54,9 +54,18 @@ class _Unsupported(Exception):
     pass
 
 
-def compile_udf(fn: Callable) -> Optional[Callable[..., E.Expression]]:
+def compile_udf(fn: Callable,
+                arg_types: Optional[Sequence] = None
+                ) -> Optional[Callable[..., E.Expression]]:
     """Compile a Python function of N scalar args into an Expression
-    builder of N child expressions. None when not translatable."""
+    builder of N child expressions. None when not translatable.
+
+    With ``arg_types`` (one DataType per argument) the probe also
+    TYPE-checks the compiled tree against real column types, so bodies
+    that parse but cannot evaluate (e.g. ``s + '!'`` over strings) fall
+    back instead of failing at query time. Numeric result types follow
+    engine/Spark semantics (e.g. ``**`` returns double, as Spark's pow
+    does), which can widen relative to the Python original."""
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
@@ -83,9 +92,19 @@ def compile_udf(fn: Callable) -> Optional[Callable[..., E.Expression]]:
         return _compile_node(body, env, fn_globals)
 
     try:  # probe once with dummy columns so failures surface at compile time
-        builder(*[E.col(p) for p in params])
+        probe = builder(*[E.col(p) for p in params])
     except _Unsupported:
         return None
+    if arg_types is not None:
+        from spark_rapids_tpu import types as T
+
+        schema = T.Schema([T.Field(p, t, True)
+                           for p, t in zip(params, arg_types)])
+        try:
+            # resolve + dtype computation exercises the engine's type rules
+            _ = E.resolve(probe, schema).dtype
+        except Exception:
+            return None
     return builder
 
 
@@ -258,7 +277,13 @@ def _compile_call(node: ast.Call, env, fn_globals) -> E.Expression:
             return E.StartsWith(recv, args[0])
         if f.attr == "endswith" and len(args) == 1:
             return E.EndsWith(recv, args[0])
-        if f.attr == "replace" and len(args) == 2:
-            return E.StringReplace(recv, args[0], args[1])
+        if f.attr == "replace" and len(node.args) == 2:
+            # StringReplace takes RAW strings, not expressions
+            raw = [a.value for a in node.args
+                   if isinstance(a, ast.Constant)
+                   and isinstance(a.value, str)]
+            if len(raw) != 2:
+                raise _Unsupported("replace needs string literals")
+            return E.StringReplace(recv, raw[0], raw[1])
         raise _Unsupported(f"method {f.attr}")
     raise _Unsupported("call form")

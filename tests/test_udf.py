@@ -153,6 +153,19 @@ def test_tpu_udf_rejects_string_return():
         TpuUDF(lambda s: s, T.STRING, [col("s")])
 
 
+def test_compile_udf_replace_and_typed_probe(tab):
+    b = compile_udf(lambda s: s.replace("w", "W"))
+    assert b is not None
+    node = ProjectExec([E.Alias(b(col("s")), "o")], source(tab))
+    got = [r["o"] for r in rows(node)]
+    assert got == [r["s"].replace("w", "W") for r in tab.to_pylist()]
+    # non-literal replace args are not translatable
+    assert compile_udf(lambda s, t: s.replace(t, "x")) is None
+    # typed probe rejects type-invalid bodies instead of failing at eval
+    assert compile_udf(lambda s: s + "!", arg_types=[T.STRING]) is None
+    assert compile_udf(lambda a: a + 1, arg_types=[T.LONG]) is not None
+
+
 def test_arrow_eval_python_inprocess(tab):
     def fn(t):
         return pa.compute.add(t.column("a"), t.column("b"))
