@@ -176,6 +176,36 @@ RETRY_MAX_ATTEMPTS = conf(
     "spark.rapids.tpu.memory.retry.maxAttempts", default=32,
     doc="Max OOM retry attempts before surfacing the failure.", internal=True)
 
+AQE_ENABLED = conf(
+    "spark.rapids.tpu.sql.adaptive.enabled", default=True,
+    doc="Adaptive query execution: after a shuffle stage materializes, plan "
+        "the downstream read from actual partition sizes — coalescing small "
+        "partitions and splitting skewed join partitions (reference: "
+        "GpuCustomShuffleReaderExec.scala:37, docs/dev/adaptive-query.md).")
+
+AQE_TARGET_PARTITION_BYTES = conf(
+    "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeBytes",
+    default=64 << 20,
+    doc="Advisory serialized size per post-shuffle partition; adjacent "
+        "partitions below it are coalesced into one reader task "
+        "(Spark spark.sql.adaptive.advisoryPartitionSizeInBytes).")
+
+AQE_SKEW_ENABLED = conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.enabled", default=True,
+    doc="Split skewed shuffle-join partitions into per-map-range chunks "
+        "(Spark spark.sql.adaptive.skewJoin.enabled).")
+
+AQE_SKEW_FACTOR = conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor",
+    default=5.0,
+    doc="A join partition is skewed when its size exceeds this multiple of "
+        "the median partition size (and the threshold below).")
+
+AQE_SKEW_THRESHOLD_BYTES = conf(
+    "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionThresholdBytes",
+    default=256 << 20,
+    doc="Minimum size for a join partition to be considered skewed.")
+
 
 class RapidsConf:
     """Immutable snapshot of configuration values.

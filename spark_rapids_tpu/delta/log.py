@@ -81,6 +81,11 @@ class DeltaLog:
             return DeltaSnapshot(-1, None, [])
         if version is None:
             version = vs[-1]
+        elif version not in vs:
+            # time travel to a version that was never committed must fail,
+            # not silently return the latest <= state
+            raise ValueError(
+                f"delta version {version} does not exist (have {vs[0]}..{vs[-1]})")
         files: Dict[str, AddFile] = {}
         schema_json = None
         for v in vs:

@@ -45,6 +45,20 @@ def _schema_to_delta_json(schema: pa.Schema) -> str:
     return json.dumps({"type": "struct", "fields": fields})
 
 
+def _delta_json_to_schema(schema_json: Optional[str]) -> pa.Schema:
+    if not schema_json:
+        return pa.schema([])
+    _MAP = {"long": pa.int64(), "integer": pa.int32(), "double": pa.float64(),
+            "float": pa.float32(), "boolean": pa.bool_(),
+            "string": pa.string(), "date": pa.date32()}
+    fields = [
+        pa.field(f["name"], _MAP.get(f["type"], pa.string()),
+                 f.get("nullable", True))
+        for f in json.loads(schema_json).get("fields", [])
+    ]
+    return pa.schema(fields)
+
+
 class DeltaTable:
     def __init__(self, path: str):
         self.path = path
@@ -84,7 +98,8 @@ class DeltaTable:
         snap = self.log.snapshot(version)
         tables = [self._file_table(a) for a in snap.files]
         if not tables:
-            raise ValueError("empty table")
+            # a fully-deleted table is legal: 0 rows with the logged schema
+            return _delta_json_to_schema(snap.schema_json).empty_table()
         return pa.concat_tables(tables)
 
     def scan_exec(self, version: Optional[int] = None,

@@ -24,8 +24,11 @@ def _normalize_u32(col, capacity: int) -> jax.Array:
     range, so the curve's TOP bits discriminate regardless of the raw value
     distribution."""
     keys = K.sortable_keys(col, ascending=True, nulls_first=True)
-    data_key = keys[-2]  # most significant data key
-    order = jnp.argsort(data_key, stable=True)
+    # rank by the column's full key stack (lexsort primary key is last):
+    # floats carry [value, nan_flag, null_key], strings [lo, hi, null_key] —
+    # a single key would drop the value for floats / half the prefix for
+    # strings
+    order = jnp.lexsort(tuple(keys))
     ranks = jnp.zeros(capacity, jnp.uint32)
     ranks = ranks.at[order].set(jnp.arange(capacity, dtype=jnp.uint32))
     shift = 32 - max((capacity - 1).bit_length(), 1)

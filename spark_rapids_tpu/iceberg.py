@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import List, Optional
 
 from spark_rapids_tpu.exec import ParquetScanExec
@@ -35,9 +36,15 @@ class IcebergTable:
             if os.path.exists(cand):
                 with open(cand) as f:
                     return json.load(f)
+        def _version_num(name: str):
+            # "v12.metadata.json" / "00012-<uuid>.metadata.json"; numeric
+            # sort — lexicographic would pick v9 over v10
+            m = re.match(r"^v?(\d+)", name)
+            return (int(m.group(1)) if m else -1, name)
+
         versions = sorted(
-            f for f in os.listdir(self.meta_dir)
-            if f.endswith(".metadata.json"))
+            (f for f in os.listdir(self.meta_dir)
+             if f.endswith(".metadata.json")), key=_version_num)
         if not versions:
             raise FileNotFoundError(f"no iceberg metadata in {self.meta_dir}")
         with open(os.path.join(self.meta_dir, versions[-1])) as f:

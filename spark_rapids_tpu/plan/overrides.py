@@ -250,19 +250,31 @@ class Overrides:
             from spark_rapids_tpu.plan.cpu_agg import CpuAggregateExec
 
             return CpuAggregateExec(node.group_exprs, node.agg_exprs, child)
-        if child.num_partitions() == 1:
+        if self._planned_parts(child) == 1:
             return HashAggregateExec(node.group_exprs, node.agg_exprs, child,
                                      mode="complete")
         partial = HashAggregateExec(node.group_exprs, node.agg_exprs, child,
                                     mode="partial")
         n_keys = len(node.group_exprs)
         if n_keys == 0:
-            exchange = ShuffleExchangeExec(SinglePartitioner(), partial)
+            exchange: TpuExec = ShuffleExchangeExec(SinglePartitioner(),
+                                                    partial)
         else:
             exchange = ShuffleExchangeExec(
                 HashPartitioner(list(range(n_keys)), self.shuffle_partitions),
                 partial)
+            exchange = self._maybe_aqe_read(exchange)
         return HashAggregateExec.final_from_partial(partial, exchange)
+
+    def _maybe_aqe_read(self, exchange: TpuExec) -> TpuExec:
+        """Wrap a hash/range exchange in an adaptive reader that coalesces
+        small post-shuffle partitions (GpuCustomShuffleReaderExec analog);
+        keys stay co-located so this is always sound for agg/sort."""
+        if not C.AQE_ENABLED.get(self.conf):
+            return exchange
+        from spark_rapids_tpu.shuffle.aqe import AQEShuffleReadExec
+
+        return AQEShuffleReadExec(exchange, self.conf)
 
     def _convert_sort(self, node: L.Sort, child: TpuExec,
                       on_dev: bool) -> TpuExec:
@@ -272,7 +284,7 @@ class Overrides:
             from spark_rapids_tpu.exec.misc import take_ordered_and_project
 
             return take_ordered_and_project(node.orders, node.limit, child)
-        if node.is_global and child.num_partitions() > 1:
+        if node.is_global and self._planned_parts(child) > 1:
             child = self._range_exchange(node, child)
         return SortExec(node.orders, child)
 
@@ -301,7 +313,8 @@ class Overrides:
         part = RangePartitioner.from_sample(
             values, self.shuffle_partitions, bound.index, first.ascending,
             first.nulls_first)
-        return ShuffleExchangeExec(part, child)
+        # adjacent range partitions stay globally ordered when coalesced
+        return self._maybe_aqe_read(ShuffleExchangeExec(part, child))
 
     def _convert_join(self, node: L.Join, kids: List[TpuExec],
                       on_dev: bool) -> TpuExec:
@@ -311,20 +324,36 @@ class Overrides:
 
             return CpuJoinExec(node.left_keys, node.right_keys,
                                node.join_type, left, right, node.condition)
-        if left.num_partitions() > 1:
+        if self._planned_parts(left) > 1:
             # shuffled join: co-partition both sides by key hash
             lk = [self._key_index(k, node.left.schema) for k in node.left_keys]
             rk = [self._key_index(k, node.right.schema) for k in node.right_keys]
-            left = ShuffleExchangeExec(
+            lex = ShuffleExchangeExec(
                 HashPartitioner(lk, self.shuffle_partitions), left)
-            right = ShuffleExchangeExec(
+            rex = ShuffleExchangeExec(
                 HashPartitioner(rk, self.shuffle_partitions), right)
-        elif right.num_partitions() > 1:
+            if C.AQE_ENABLED.get(self.conf):
+                from spark_rapids_tpu.shuffle.aqe import pair_for_skew_join
+
+                left, right = pair_for_skew_join(
+                    lex, rex, node.join_type, self.conf)
+            else:
+                left, right = lex, rex
+        elif self._planned_parts(right) > 1:
             # broadcast-style: collapse the build side into the stream's
             # single partition (GpuBroadcastHashJoin analog)
             right = ShuffleExchangeExec(SinglePartitioner(), right)
         return HashJoinExec(node.left_keys, node.right_keys, node.join_type,
                             left, right, condition=node.condition)
+
+    @staticmethod
+    def _planned_parts(node: TpuExec) -> int:
+        """Partition count for plan decisions without materializing stages
+        (AQE readers answer with their pre-materialization estimate)."""
+        from spark_rapids_tpu.shuffle.aqe import planning_scope
+
+        with planning_scope():
+            return node.num_partitions()
 
     @staticmethod
     def _key_index(k: E.Expression, schema: T.Schema) -> int:

@@ -23,3 +23,9 @@ from spark_rapids_tpu.shuffle.serializer import (  # noqa: F401
 )
 from spark_rapids_tpu.shuffle.manager import ShuffleManager  # noqa: F401
 from spark_rapids_tpu.shuffle.exchange_exec import ShuffleExchangeExec  # noqa: F401
+from spark_rapids_tpu.shuffle.aqe import (  # noqa: F401
+    AQEShuffleReadExec,
+    CoalescedPartitionSpec,
+    PartialReducerPartitionSpec,
+    pair_for_skew_join,
+)

@@ -1,0 +1,350 @@
+"""Adaptive query execution over materialized shuffles.
+
+Reference: GpuCustomShuffleReaderExec.scala:37 (the GPU reader for AQE
+coalesced/skew-split partition specs), docs/dev/adaptive-query.md, and
+Spark's ShufflePartitionsUtil / OptimizeSkewedJoin. The execution model here
+mirrors AQE's query-stage semantics: a shuffle exchange is a stage boundary;
+the first consumer materializes it, then the downstream partition layout is
+planned from the *actual* per-partition serialized sizes:
+
+  - coalescing: adjacent reduce partitions whose combined size fits the
+    advisory target are read by one task (CoalescedPartitionSpec);
+  - skew split: an oversized join partition is split into map-output ranges
+    (PartialReducerPartitionSpec), with the other join side's matching
+    partition replicated against each chunk.
+
+Both shapes are expressed as AQEShuffleReadExec over the exchange; skewed
+joins pair two readers via a shared SkewJoinPlanner so chunk lists line up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, batch_from_arrow
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.exec.base import UnaryExec
+from spark_rapids_tpu.shuffle.exchange_exec import ShuffleExchangeExec
+
+
+# ---------------------------------------------------------------------------
+# partition specs (Spark ShufflePartitionSpec analogs)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CoalescedPartitionSpec:
+    """Read reduce partitions [start, end) across all map outputs."""
+
+    start: int
+    end: int
+
+    def describe(self) -> str:
+        return (f"[{self.start}]" if self.end == self.start + 1
+                else f"[{self.start},{self.end})")
+
+
+@dataclasses.dataclass(frozen=True)
+class PartialReducerPartitionSpec:
+    """Read one reduce partition restricted to map outputs [map_start,
+    map_end) — one chunk of a skew-split partition."""
+
+    reducer: int
+    map_start: int
+    map_end: int
+
+    def describe(self) -> str:
+        return f"[{self.reducer}:maps {self.map_start}-{self.map_end})"
+
+
+Spec = object  # CoalescedPartitionSpec | PartialReducerPartitionSpec
+
+
+# ---------------------------------------------------------------------------
+# planning scope: partition-count queries that must not materialize stages
+# ---------------------------------------------------------------------------
+
+_PLANNING = threading.local()
+
+
+class planning_scope:
+    """Within this scope, AQEShuffleReadExec.num_partitions() answers with
+    its pre-materialization estimate instead of planning specs (which would
+    execute the upstream stage). The plan rewriter wraps its partition-count
+    decisions in this so building a physical plan never runs it."""
+
+    def __enter__(self):
+        self._old = getattr(_PLANNING, "on", False)
+        _PLANNING.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _PLANNING.on = self._old
+        return False
+
+
+def in_planning_scope() -> bool:
+    return getattr(_PLANNING, "on", False)
+
+
+# ---------------------------------------------------------------------------
+# planning helpers
+# ---------------------------------------------------------------------------
+
+
+def coalesce_specs(sizes: Sequence[int],
+                   target_bytes: int) -> List[CoalescedPartitionSpec]:
+    """Greedily pack adjacent reduce partitions up to the advisory size
+    (ShufflePartitionsUtil.coalescePartitions)."""
+    specs: List[CoalescedPartitionSpec] = []
+    start, acc = 0, 0
+    for i, sz in enumerate(sizes):
+        if i > start and acc + sz > target_bytes:
+            specs.append(CoalescedPartitionSpec(start, i))
+            start, acc = i, 0
+        acc += sz
+    specs.append(CoalescedPartitionSpec(start, len(sizes)))
+    return specs
+
+
+def split_map_ranges(sizes_by_map: Sequence[int],
+                     target_bytes: int) -> List[Tuple[int, int]]:
+    """Split one reduce partition's map outputs into contiguous ranges of
+    roughly target size (ShufflePartitionsUtil.createSkewPartitionSpecs)."""
+    ranges: List[Tuple[int, int]] = []
+    start, acc = 0, 0
+    for i, sz in enumerate(sizes_by_map):
+        if i > start and acc + sz > target_bytes:
+            ranges.append((start, i))
+            start, acc = i, 0
+        acc += sz
+    ranges.append((start, len(sizes_by_map)))
+    return ranges
+
+
+def _median(xs: Sequence[int]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return float(s[mid]) if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def skew_threshold(sizes: Sequence[int], factor: float,
+                   min_bytes: int) -> float:
+    """A partition is skewed when above max(factor*median, min_bytes)
+    (OptimizeSkewedJoin.isSkewed)."""
+    return max(factor * _median(sizes), float(min_bytes))
+
+
+# ---------------------------------------------------------------------------
+# the reader exec
+# ---------------------------------------------------------------------------
+
+
+class AQEShuffleReadExec(UnaryExec):
+    """Reads a materialized exchange through a list of partition specs
+    (GpuCustomShuffleReaderExec analog).
+
+    Specs are planned lazily: the first call to num_partitions()/do_execute()
+    materializes the exchange (the stage boundary) and derives the layout
+    from real sizes — exactly AQE's re-planning point. A paired planner (skew
+    joins) may inject the specs instead.
+    """
+
+    def __init__(self, exchange: ShuffleExchangeExec,
+                 conf: Optional[C.RapidsConf] = None,
+                 target_batch_rows: int = 1 << 20):
+        super().__init__(exchange)
+        self.conf = conf or C.RapidsConf()
+        self.target_batch_rows = target_batch_rows
+        self._specs: Optional[List[Spec]] = None
+        self._plan_lock = threading.Lock()
+
+    @property
+    def exchange(self) -> ShuffleExchangeExec:
+        return self.children[0]
+
+    # -- planning ----------------------------------------------------------
+    def _set_specs(self, specs: List[Spec]) -> None:
+        with self._plan_lock:
+            self._specs = list(specs)
+
+    def specs(self) -> List[Spec]:
+        with self._plan_lock:
+            if self._specs is None:
+                self._specs = self._plan()
+            return self._specs
+
+    def _plan(self) -> List[Spec]:
+        ex = self.exchange
+        ex._ensure_written()
+        sizes = ex.manager.partition_sizes(ex._reg)
+        target = self.conf[C.AQE_TARGET_PARTITION_BYTES]
+        return list(coalesce_specs(sizes, target))
+
+    # -- exec contract -----------------------------------------------------
+    def num_partitions(self) -> int:
+        if in_planning_scope():
+            # plan construction must never execute a stage: report the
+            # pre-materialization estimate (the exchange's reducer count)
+            with self._plan_lock:
+                if self._specs is not None:
+                    return len(self._specs)
+            return self.exchange.num_partitions()
+        return len(self.specs())
+
+    def node_description(self) -> str:
+        with self._plan_lock:
+            if self._specs is None:
+                return "TpuAQEShuffleRead (unplanned)"
+            n_co = sum(isinstance(s, CoalescedPartitionSpec)
+                       for s in self._specs)
+            n_sk = len(self._specs) - n_co
+            return (f"TpuAQEShuffleRead {len(self._specs)} specs"
+                    f" ({n_co} coalesced, {n_sk} skew-split)")
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        spec = self.specs()[partition]
+        ex = self.exchange
+        if isinstance(spec, CoalescedPartitionSpec):
+            table = ex.manager.read_spec(
+                ex._reg, range(spec.start, spec.end))
+        else:
+            table = ex.manager.read_spec(
+                ex._reg, [spec.reducer], spec.map_start, spec.map_end)
+        if table is None or table.num_rows == 0:
+            return
+        for start in range(0, table.num_rows, self.target_batch_rows):
+            yield batch_from_arrow(table.slice(start, self.target_batch_rows))
+
+
+# ---------------------------------------------------------------------------
+# skew-join planner
+# ---------------------------------------------------------------------------
+
+
+class SkewJoinPlanner:
+    """Plans paired spec lists for the two sides of a shuffled join
+    (OptimizeSkewedJoin analog).
+
+    For reducer r with sides (L, R):
+      - L skewed, R not: split L into map ranges, replicate R's reducer
+        against each chunk;
+      - symmetric for R;
+      - both skewed (inner join only): m x n chunk pairs — the union of all
+        chunk-pair joins equals the full partition join;
+      - neither: candidates for adjacent coalescing on both sides jointly.
+    """
+
+    def __init__(self, left: AQEShuffleReadExec, right: AQEShuffleReadExec,
+                 join_type: str, conf: Optional[C.RapidsConf] = None):
+        self.left = left
+        self.right = right
+        self.join_type = join_type
+        self.conf = conf or C.RapidsConf()
+        self._planned = False
+        self._lock = threading.Lock()
+
+    def ensure_planned(self) -> None:
+        with self._lock:
+            if self._planned:
+                return
+            self._plan()
+            self._planned = True
+
+    def _plan(self) -> None:
+        lex, rex = self.left.exchange, self.right.exchange
+        lex._ensure_written()
+        rex._ensure_written()
+        lsizes = lex.manager.partition_sizes(lex._reg)
+        rsizes = rex.manager.partition_sizes(rex._reg)
+        assert len(lsizes) == len(rsizes), "join sides must be co-partitioned"
+        conf = self.conf
+        target = conf[C.AQE_TARGET_PARTITION_BYTES]
+        skew_on = (conf[C.AQE_SKEW_ENABLED]
+                   and self.join_type in ("inner", "left", "right",
+                                          "left_semi", "left_anti"))
+        lthr = skew_threshold(lsizes, conf[C.AQE_SKEW_FACTOR],
+                              conf[C.AQE_SKEW_THRESHOLD_BYTES])
+        rthr = skew_threshold(rsizes, conf[C.AQE_SKEW_FACTOR],
+                              conf[C.AQE_SKEW_THRESHOLD_BYTES])
+
+        lspecs: List[Spec] = []
+        rspecs: List[Spec] = []
+        co_start = -1  # open coalesce run start on both sides
+        co_acc_l = co_acc_r = 0
+
+        def flush_run(end: int) -> None:
+            nonlocal co_start
+            if co_start >= 0:
+                lspecs.append(CoalescedPartitionSpec(co_start, end))
+                rspecs.append(CoalescedPartitionSpec(co_start, end))
+                co_start = -1
+
+        for r in range(len(lsizes)):
+            # splitting the stream side is only sound when that side's rows
+            # may be partitioned arbitrarily: left-outer/semi/anti pin the
+            # RIGHT side whole (split left only), and vice versa
+            can_split_l = skew_on and self.join_type in (
+                "inner", "left_semi", "left_anti", "left")
+            can_split_r = skew_on and self.join_type in ("inner", "right")
+            l_skew = can_split_l and lsizes[r] > lthr
+            r_skew = can_split_r and rsizes[r] > rthr
+            if l_skew or r_skew:
+                flush_run(r)
+                lranges = (split_map_ranges(
+                    lex.manager.partition_sizes_by_map(lex._reg, r), target)
+                    if l_skew else [(0, lex.manager.num_map_outputs(lex._reg))])
+                rranges = (split_map_ranges(
+                    rex.manager.partition_sizes_by_map(rex._reg, r), target)
+                    if r_skew else [(0, rex.manager.num_map_outputs(rex._reg))])
+                for lm in lranges:
+                    for rm in rranges:
+                        lspecs.append(
+                            PartialReducerPartitionSpec(r, lm[0], lm[1]))
+                        rspecs.append(
+                            PartialReducerPartitionSpec(r, rm[0], rm[1]))
+            else:
+                if co_start < 0:
+                    co_start, co_acc_l, co_acc_r = r, 0, 0
+                elif max(co_acc_l + lsizes[r], co_acc_r + rsizes[r]) > target:
+                    flush_run(r)
+                    co_start, co_acc_l, co_acc_r = r, 0, 0
+                co_acc_l += lsizes[r]
+                co_acc_r += rsizes[r]
+        flush_run(len(lsizes))
+        self.left._set_specs(lspecs)
+        self.right._set_specs(rspecs)
+
+
+class SkewAwareShuffleReadExec(AQEShuffleReadExec):
+    """An AQE read whose specs come from a shared SkewJoinPlanner."""
+
+    def __init__(self, exchange: ShuffleExchangeExec,
+                 conf: Optional[C.RapidsConf] = None,
+                 target_batch_rows: int = 1 << 20):
+        super().__init__(exchange, conf, target_batch_rows)
+        self.planner: Optional[SkewJoinPlanner] = None
+
+    def specs(self) -> List[Spec]:
+        if self.planner is not None:
+            self.planner.ensure_planned()
+        return super().specs()
+
+
+def pair_for_skew_join(left_exchange: ShuffleExchangeExec,
+                       right_exchange: ShuffleExchangeExec,
+                       join_type: str,
+                       conf: Optional[C.RapidsConf] = None,
+                       ) -> Tuple[AQEShuffleReadExec, AQEShuffleReadExec]:
+    """Build the paired readers for a shuffled join's two sides."""
+    lread = SkewAwareShuffleReadExec(left_exchange, conf)
+    rread = SkewAwareShuffleReadExec(right_exchange, conf)
+    planner = SkewJoinPlanner(lread, rread, join_type, conf)
+    lread.planner = planner
+    rread.planner = planner
+    return lread, rread

@@ -112,10 +112,45 @@ class ShuffleManager:
         with reg.lock:
             reg.map_outputs.append(out)
 
+    # -- stats (AQE) -------------------------------------------------------
+    def num_map_outputs(self, reg: ShuffleRegistration) -> int:
+        with reg.lock:
+            return len(reg.map_outputs)
+
+    def partition_sizes(self, reg: ShuffleRegistration) -> List[int]:
+        """Serialized bytes per reduce partition, summed over map outputs
+        (Spark's MapOutputStatistics.bytesByPartitionId, which AQE plans
+        coalescing/skew handling from)."""
+        sizes = [0] * reg.n_reduce
+        with reg.lock:
+            for mo in reg.map_outputs:
+                if mo.cached is not None:
+                    for pid, blob in mo.cached.items():
+                        sizes[pid] += len(blob)
+                else:
+                    for pid, (_, ln) in mo.index.items():
+                        sizes[pid] += ln
+        return sizes
+
+    def partition_sizes_by_map(self, reg: ShuffleRegistration,
+                               partition: int) -> List[int]:
+        """Per-map-output bytes for one reduce partition (skew splitting)."""
+        out: List[int] = []
+        with reg.lock:
+            for mo in reg.map_outputs:
+                if mo.cached is not None:
+                    out.append(len(mo.cached.get(partition, b"")))
+                else:
+                    loc = mo.index.get(partition)
+                    out.append(loc[1] if loc else 0)
+        return out
+
     # -- read side ---------------------------------------------------------
-    def _fetch_blocks(self, reg: ShuffleRegistration,
-                      partition: int) -> List[bytes]:
-        """Fetch a reduce partition's blocks from all map outputs (pool)."""
+    def _fetch_blocks(self, reg: ShuffleRegistration, partition: int,
+                      map_start: int = 0,
+                      map_end: Optional[int] = None) -> List[bytes]:
+        """Fetch a reduce partition's blocks from map outputs [map_start,
+        map_end) (pool). The map range supports AQE skew-split reads."""
 
         def fetch(mo: _MapOutput) -> Optional[bytes]:
             if mo.cached is not None:
@@ -128,7 +163,7 @@ class ShuffleManager:
                 return f.read(loc[1])
 
         with reg.lock:
-            outputs = list(reg.map_outputs)
+            outputs = reg.map_outputs[map_start:map_end]
         return [b for b in self._read_pool.map(fetch, outputs)
                 if b is not None]
 
@@ -137,6 +172,16 @@ class ShuffleManager:
         """Host-merge a reduce partition into one arrow table (single upload
         by the caller)."""
         return merge_tables(self._fetch_blocks(reg, partition), reg.schema)
+
+    def read_spec(self, reg: ShuffleRegistration, partitions,
+                  map_start: int = 0,
+                  map_end: Optional[int] = None) -> Optional[pa.Table]:
+        """Host-merge several reduce partitions (AQE coalesced read) and/or a
+        map-output range of one partition (AQE skew-split read)."""
+        blocks: List[bytes] = []
+        for p in partitions:
+            blocks.extend(self._fetch_blocks(reg, p, map_start, map_end))
+        return merge_tables(blocks, reg.schema)
 
     def read_partition_batch(self, reg: ShuffleRegistration, partition: int,
                              min_bucket: int = 1024):

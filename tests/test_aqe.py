@@ -1,0 +1,210 @@
+"""Adaptive query execution tests: partition coalescing, skew-split joins.
+
+Reference behavior: GpuCustomShuffleReaderExec.scala:37 (coalesced/skew
+partition specs over a GPU shuffle) and docs/dev/adaptive-query.md. The
+correctness bar mirrors the reference's differential harness: AQE plans must
+produce identical results to the non-AQE plan.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exprs.expr import Count, Sum, col
+from spark_rapids_tpu.plan import from_arrow
+from spark_rapids_tpu.shuffle.aqe import (
+    AQEShuffleReadExec,
+    CoalescedPartitionSpec,
+    PartialReducerPartitionSpec,
+    coalesce_specs,
+    skew_threshold,
+    split_map_ranges,
+)
+
+
+def test_coalesce_specs_greedy_pack():
+    specs = coalesce_specs([10, 10, 10, 100, 10], target_bytes=30)
+    assert specs == [
+        CoalescedPartitionSpec(0, 3),
+        CoalescedPartitionSpec(3, 4),
+        CoalescedPartitionSpec(4, 5),
+    ]
+
+
+def test_coalesce_specs_all_fit():
+    assert coalesce_specs([1, 2, 3], 100) == [CoalescedPartitionSpec(0, 3)]
+
+
+def test_coalesce_specs_oversized_partition_stays_alone():
+    # an oversized partition can't be split by coalescing; it gets its own spec
+    specs = coalesce_specs([500, 1, 1], 30)
+    assert specs[0] == CoalescedPartitionSpec(0, 1)
+
+
+def test_split_map_ranges():
+    assert split_map_ranges([10, 10, 10, 10], 20) == [(0, 2), (2, 4)]
+    assert split_map_ranges([100], 20) == [(0, 1)]
+
+
+def test_skew_threshold_median_factor():
+    sizes = [10, 10, 10, 10, 1000]
+    assert skew_threshold(sizes, 5.0, 40) == 50.0
+    assert skew_threshold(sizes, 5.0, 9999) == 9999.0
+
+
+def _agg_df(t, conf):
+    half = len(t) // 2
+    src = from_arrow(t.slice(0, half), conf).union(
+        from_arrow(t.slice(half), conf))
+    return (src.group_by("k")
+            .agg(Sum(col("v")).alias("sv"), Count().alias("n")))
+
+
+def _sorted_rows(rows):
+    return sorted(rows, key=lambda r: r["k"])
+
+
+def test_aqe_coalesced_agg_matches_non_aqe():
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 40, 2000), pa.int64()),
+        "v": pa.array(rng.random(2000), pa.float64()),
+    })
+    base = _sorted_rows(_agg_df(
+        t, RapidsConf({C.AQE_ENABLED.key: False})).collect())
+    # huge advisory size -> everything coalesces into one reader partition
+    conf = RapidsConf({C.AQE_TARGET_PARTITION_BYTES.key: 1 << 40})
+    df = _agg_df(t, conf)
+    node = df.physical_plan()
+
+    readers = []
+
+    def walk(n):
+        if isinstance(n, AQEShuffleReadExec):
+            readers.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    assert readers, "AQE reader not inserted for hash-partitioned aggregate"
+    got = _sorted_rows(df.collect())
+    assert got == base
+    specs = readers[0].specs()
+    assert specs == [CoalescedPartitionSpec(0, 4)]
+
+
+def test_aqe_tiny_target_keeps_partitions():
+    rng = np.random.default_rng(8)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 40, 1000), pa.int64()),
+        "v": pa.array(rng.random(1000), pa.float64()),
+    })
+    base = _sorted_rows(_agg_df(
+        t, RapidsConf({C.AQE_ENABLED.key: False})).collect())
+    conf = RapidsConf({C.AQE_TARGET_PARTITION_BYTES.key: 1})
+    got = _sorted_rows(_agg_df(t, conf).collect())
+    assert got == base
+
+
+def _join_dfs(left, right, conf, how="inner"):
+    l1 = from_arrow(left.slice(0, len(left) // 2), conf)
+    l2 = from_arrow(left.slice(len(left) // 2), conf)
+    return (l1.union(l2)
+            .join(from_arrow(right, conf), left_on="k", right_on="k2",
+                  how=how))
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi"])
+def test_aqe_skew_join_matches_non_aqe(how):
+    rng = np.random.default_rng(9)
+    # one heavy hitter key -> one skewed reduce partition on the left
+    keys = np.where(rng.random(3000) < 0.7, 3, rng.integers(0, 50, 3000))
+    left = pa.table({"k": pa.array(keys, pa.int64()),
+                     "lv": pa.array(np.arange(3000), pa.int64())})
+    right = pa.table({"k2": pa.array(np.arange(50), pa.int64()),
+                      "rv": pa.array(np.arange(50) * 10, pa.int64())})
+    base = _join_dfs(left, right, RapidsConf({C.AQE_ENABLED.key: False}),
+                     how).collect()
+    conf = RapidsConf({
+        C.AQE_TARGET_PARTITION_BYTES.key: 4096,
+        C.AQE_SKEW_THRESHOLD_BYTES.key: 4096,
+        C.AQE_SKEW_FACTOR.key: 1.5,
+    })
+    df = _join_dfs(left, right, conf, how)
+    node = df.physical_plan()
+    got = df.collect()
+
+    key = lambda r: tuple((v is None, v) for v in sorted(
+        r.items(), key=lambda kv: kv[0]))
+    assert sorted(got, key=key) == sorted(base, key=key)
+
+    readers = []
+
+    def walk(n):
+        if isinstance(n, AQEShuffleReadExec):
+            readers.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    assert len(readers) == 2
+    lspecs = readers[0].specs() + readers[1].specs()
+    assert any(isinstance(s, PartialReducerPartitionSpec) for s in lspecs), \
+        "skewed partition was not split"
+
+
+def test_aqe_skew_split_pairs_line_up():
+    rng = np.random.default_rng(10)
+    keys = np.where(rng.random(2000) < 0.8, 7, rng.integers(0, 30, 2000))
+    left = pa.table({"k": pa.array(keys, pa.int64()),
+                     "lv": pa.array(np.arange(2000), pa.int64())})
+    right = pa.table({"k2": pa.array(np.arange(30), pa.int64()),
+                      "rv": pa.array(np.arange(30), pa.int64())})
+    conf = RapidsConf({
+        C.AQE_TARGET_PARTITION_BYTES.key: 2048,
+        C.AQE_SKEW_THRESHOLD_BYTES.key: 2048,
+        C.AQE_SKEW_FACTOR.key: 1.0,
+    })
+    df = _join_dfs(left, right, conf)
+    node = df.physical_plan()
+    df.collect()
+    reads = []
+
+    def walk(n):
+        if isinstance(n, AQEShuffleReadExec):
+            reads.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    l, r = reads
+    assert len(l.specs()) == len(r.specs())
+    for ls, rs in zip(l.specs(), r.specs()):
+        if isinstance(ls, PartialReducerPartitionSpec):
+            red = ls.reducer
+        elif isinstance(rs, PartialReducerPartitionSpec):
+            red = rs.reducer
+        else:
+            assert ls == rs  # joint coalesced run
+            continue
+        for s in (ls, rs):
+            if isinstance(s, PartialReducerPartitionSpec):
+                assert s.reducer == red
+            else:
+                assert (s.start, s.end) == (red, red + 1)
+
+
+def test_aqe_disabled_leaves_plain_exchange():
+    rng = np.random.default_rng(11)
+    t = pa.table({"k": pa.array(rng.integers(0, 10, 500), pa.int64()),
+                  "v": pa.array(rng.random(500), pa.float64())})
+    node = _agg_df(t, RapidsConf({C.AQE_ENABLED.key: False})).physical_plan()
+
+    def walk(n):
+        assert not isinstance(n, AQEShuffleReadExec)
+        for c in n.children:
+            walk(c)
+
+    walk(node)

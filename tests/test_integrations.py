@@ -242,3 +242,52 @@ def test_bloom_filter(rng):
     miss = np.asarray(might_contain(batch_from_arrow(probe_miss, 16), (0,),
                                     bits, m, k))[:len(probe_miss_keys)]
     assert miss.mean() < 0.1  # fpp in the right ballpark
+
+
+def test_zorder_single_float_column_sorts_by_value(rng):
+    # regression: float keys carry [value, nan_flag, null_key] — ranking by
+    # a single key used the NaN flag and degenerated to input order
+    from spark_rapids_tpu.exec.zorder import zorder_sort_indices
+
+    vals = rng.permutation(64).astype(np.float64)
+    t = pa.table({"x": pa.array(vals, pa.float64())})
+    b = batch_from_arrow(t, 16)
+    order = np.asarray(zorder_sort_indices(b, (0,)))[:64]
+    assert sorted(vals[order].tolist()) == vals[order].tolist()
+
+
+def test_delta_read_fully_deleted_table(tmp_path, rng):
+    dt = DeltaTable.create(str(tmp_path / "tbl"), _tab(rng, 20))
+    dt.delete(lit(True))
+    out = dt.to_arrow()
+    assert out.num_rows == 0
+    assert "k" in out.schema.names
+
+
+def test_delta_snapshot_missing_version_raises(tmp_path, rng):
+    dt = DeltaTable.create(str(tmp_path / "tbl"), _tab(rng, 10))
+    with pytest.raises(ValueError, match="does not exist"):
+        dt.log.snapshot(version=10)
+
+
+def test_iceberg_metadata_version_numeric_order(tmp_path, rng):
+    from spark_rapids_tpu.iceberg import IcebergTable
+
+    root = tmp_path / "ice"
+    (root / "metadata").mkdir(parents=True)
+    (root / "data").mkdir()
+    t1 = _tab(rng, 10)
+    pq.write_table(t1, root / "data" / "f1.parquet")
+    manifest = [{"file_path": str(root / "data" / "f1.parquet")}]
+    with open(root / "metadata" / "m1.json", "w") as f:
+        json.dump(manifest, f)
+    # v2..v10: only v10 references the manifest; lexicographic picks v9
+    for v in range(2, 11):
+        md = {"format-version": 1, "current-snapshot-id": v,
+              "snapshots": ([{"snapshot-id": 10,
+                              "manifests": [str(root / "metadata" / "m1.json")]}]
+                            if v == 10 else [])}
+        with open(root / "metadata" / f"v{v}.metadata.json", "w") as f:
+            json.dump(md, f)
+    assert IcebergTable(str(root)).data_files() == \
+        [str(root / "data" / "f1.parquet")]
