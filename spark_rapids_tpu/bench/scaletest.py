@@ -1,0 +1,257 @@
+"""Scale/stress test harness: generated tables a-g + query sweep + report.
+
+Reference: integration_tests/ScaleTest.md, QuerySpecs.scala (q1-q28
+join/agg/window stress queries over generated tables a-g) and
+TestReport.scala (JSON timing report). Same shape here: seeded generators
+for seven tables of graded width/cardinality/skew/nullability, a named
+query catalog stressing each operator family, and ``run_suite`` producing a
+JSON report the driver or CI can diff over time.
+
+Scale model: ``scale`` multiplies base row counts; ``complexity`` widens
+value domains (cardinality) like the reference's complexity knob.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.exprs.expr import (
+    And, Average, Count, EqualTo, GreaterThan, Max, Min, Sum, col, lit,
+)
+from spark_rapids_tpu.exec.sort import SortOrder
+from spark_rapids_tpu.plan import DataFrame, from_arrow
+
+
+# ---------------------------------------------------------------------------
+# tables a-g
+# ---------------------------------------------------------------------------
+
+
+def gen_tables(scale: float = 1.0, complexity: int = 100,
+               seed: int = 0) -> Dict[str, pa.Table]:
+    rng = np.random.default_rng(seed)
+    n = max(int(100_000 * scale), 1000)
+    card = max(complexity, 2)
+
+    # a: wide fact — ints, floats, strings, dates, nulls
+    a_n = n
+    a = pa.table({
+        "a_key": pa.array(rng.integers(0, card, a_n), pa.int64()),
+        "a_key2": pa.array(rng.integers(0, card * 10, a_n), pa.int64()),
+        "a_int": pa.array(rng.integers(-1000, 1000, a_n), pa.int64()),
+        "a_f": pa.array(rng.random(a_n) * 1e4, pa.float64()),
+        "a_s": pa.array([f"s{int(v)}" for v in rng.integers(0, card, a_n)],
+                        pa.string()),
+        "a_date": pa.array(rng.integers(10_000, 20_000, a_n).astype("int32"),
+                           pa.int32()).cast(pa.date32()),
+        "a_null": pa.array([None if v % 7 == 0 else int(v)
+                            for v in rng.integers(0, 1000, a_n)], pa.int64()),
+    })
+    # b: skewed key-value (zipf-ish: 50% of rows on one key)
+    b_n = n
+    skewed = np.where(rng.random(b_n) < 0.5, 1,
+                      rng.integers(0, card, b_n))
+    b = pa.table({
+        "b_key": pa.array(skewed, pa.int64()),
+        "b_v": pa.array(rng.random(b_n), pa.float64()),
+    })
+    # c: string-heavy
+    c_n = n // 2
+    c = pa.table({
+        "c_key": pa.array(rng.integers(0, card, c_n), pa.int64()),
+        "c_s1": pa.array([f"prefix_{int(v):06d}_suffix"
+                          for v in rng.integers(0, card * 100, c_n)],
+                         pa.string()),
+        "c_s2": pa.array([("x" * int(v)) for v in rng.integers(0, 30, c_n)],
+                         pa.string()),
+    })
+    # d: temporal
+    d_n = n // 2
+    d = pa.table({
+        "d_key": pa.array(rng.integers(0, card, d_n), pa.int64()),
+        "d_date": pa.array(rng.integers(8_000, 22_000, d_n).astype("int32"),
+                           pa.int32()).cast(pa.date32()),
+        "d_v": pa.array(rng.integers(0, 10_000, d_n), pa.int64()),
+    })
+    # e: numeric-only dense
+    e_n = n
+    e = pa.table({
+        "e_key": pa.array(rng.integers(0, card * 100, e_n), pa.int64()),
+        "e_v1": pa.array(rng.random(e_n), pa.float64()),
+        "e_v2": pa.array(rng.integers(0, 1_000_000, e_n), pa.int64()),
+    })
+    # f: small dim (joinable to a_key)
+    f = pa.table({
+        "f_key": pa.array(np.arange(card), pa.int64()),
+        "f_name": pa.array([f"dim{j}" for j in range(card)], pa.string()),
+        "f_weight": pa.array(rng.random(card), pa.float64()),
+    })
+    # g: null-heavy
+    g_n = n // 4
+    g = pa.table({
+        "g_key": pa.array([None if v % 3 == 0 else int(v % card)
+                           for v in rng.integers(0, 10_000, g_n)], pa.int64()),
+        "g_v": pa.array([None if v % 5 == 0 else float(v)
+                         for v in rng.integers(0, 10_000, g_n)], pa.float64()),
+    })
+    return {"a": a, "b": b, "c": c, "d": d, "e": e, "f": f, "g": g}
+
+
+# ---------------------------------------------------------------------------
+# query catalog (QuerySpecs.scala analog)
+# ---------------------------------------------------------------------------
+
+
+def _dfs(tables: Dict[str, pa.Table], conf=None,
+         shuffle_partitions: int = 4) -> Dict[str, DataFrame]:
+    out = {}
+    for k, v in tables.items():
+        df = from_arrow(v, conf)
+        df.shuffle_partitions = shuffle_partitions
+        out[k] = df
+    return out
+
+
+def _q_agg_low_card(t):
+    return (t["a"].group_by("a_key")
+            .agg(Sum(col("a_f")).alias("s"), Count().alias("n"),
+                 Min(col("a_int")).alias("mn"), Max(col("a_int")).alias("mx")))
+
+
+def _q_agg_high_card(t):
+    return (t["e"].group_by("e_key")
+            .agg(Sum(col("e_v1")).alias("s"), Average(col("e_v2")).alias("a")))
+
+
+def _q_agg_multi_key(t):
+    return (t["a"].group_by("a_key", "a_s")
+            .agg(Count().alias("n"), Sum(col("a_f")).alias("s")))
+
+
+def _q_join_dim(t):
+    return (t["a"].join(t["f"], left_on="a_key", right_on="f_key")
+            .group_by("f_name").agg(Sum(col("a_f")).alias("s")))
+
+
+def _q_join_skewed(t):
+    return (t["b"].join(t["f"], left_on="b_key", right_on="f_key")
+            .group_by("f_name").agg(Sum(col("b_v")).alias("s")))
+
+
+def _q_join_left(t):
+    return t["g"].join(t["f"], left_on="g_key", right_on="f_key", how="left")
+
+
+def _q_join_semi(t):
+    return t["a"].join(t["f"].filter(GreaterThan(col("f_weight"), lit(0.5))),
+                       left_on="a_key", right_on="f_key", how="left_semi")
+
+
+def _q_join_anti(t):
+    return t["a"].join(t["f"].filter(GreaterThan(col("f_weight"), lit(0.5))),
+                       left_on="a_key", right_on="f_key", how="left_anti")
+
+
+def _q_fact_fact_join(t):
+    return (t["a"].join(t["b"], left_on="a_key", right_on="b_key")
+            .group_by("a_key").agg(Count().alias("n")))
+
+
+def _q_filter_project(t):
+    return (t["a"]
+            .filter(And(GreaterThan(col("a_f"), lit(100.0)),
+                        EqualTo(col("a_key"), col("a_key"))))
+            .select(col("a_key"), (col("a_f") * lit(2.0)).alias("f2"),
+                    col("a_s")))
+
+
+def _q_sort_limit(t):
+    return t["e"].sort(SortOrder(col("e_v1"), ascending=False), limit=100)
+
+
+def _q_global_sort(t):
+    return t["d"].sort(SortOrder(col("d_v")))
+
+
+def _q_union_agg(t):
+    u = t["a"].select(col("a_key").alias("k"), col("a_f").alias("v")).union(
+        t["b"].select(col("b_key").alias("k"), col("b_v").alias("v")))
+    return u.group_by("k").agg(Sum(col("v")).alias("s"), Count().alias("n"))
+
+
+def _q_string_agg(t):
+    return (t["c"].group_by("c_s1")
+            .agg(Count().alias("n"))
+            .sort(SortOrder(col("n"), ascending=False), limit=50))
+
+
+def _q_null_groups(t):
+    return (t["g"].group_by("g_key")
+            .agg(Count().alias("n"), Sum(col("g_v")).alias("s")))
+
+
+QUERIES: Dict[str, Callable] = {
+    "q1_agg_low_card": _q_agg_low_card,
+    "q2_agg_high_card": _q_agg_high_card,
+    "q3_agg_multi_key": _q_agg_multi_key,
+    "q4_join_dim": _q_join_dim,
+    "q5_join_skewed": _q_join_skewed,
+    "q6_join_left": _q_join_left,
+    "q7_join_semi": _q_join_semi,
+    "q8_join_anti": _q_join_anti,
+    "q9_fact_fact_join": _q_fact_fact_join,
+    "q10_filter_project": _q_filter_project,
+    "q11_sort_limit": _q_sort_limit,
+    "q12_global_sort": _q_global_sort,
+    "q13_union_agg": _q_union_agg,
+    "q14_string_agg": _q_string_agg,
+    "q15_null_groups": _q_null_groups,
+}
+
+
+# ---------------------------------------------------------------------------
+# runner + report (TestReport.scala analog)
+# ---------------------------------------------------------------------------
+
+
+def run_suite(scale: float = 0.01, complexity: int = 50, seed: int = 0,
+              queries: Optional[List[str]] = None, iterations: int = 1,
+              conf=None, report_path: Optional[str] = None) -> dict:
+    tables = gen_tables(scale, complexity, seed)
+    t = _dfs(tables, conf)
+    names = queries or list(QUERIES)
+    results = []
+    for name in names:
+        entry = {"query": name, "iterations": []}
+        try:
+            for _ in range(iterations):
+                t0 = time.perf_counter()
+                df = QUERIES[name](t)
+                out = df.to_arrow()
+                elapsed = time.perf_counter() - t0
+                entry["iterations"].append(round(elapsed * 1000, 2))
+                entry["rows"] = out.num_rows
+            entry["status"] = "success"
+            entry["best_ms"] = min(entry["iterations"])
+        except Exception as ex:  # report and continue, like the reference
+            entry["status"] = "failed"
+            entry["error"] = f"{type(ex).__name__}: {ex}"
+        results.append(entry)
+    report = {
+        "suite": "scaletest",
+        "scale": scale,
+        "complexity": complexity,
+        "seed": seed,
+        "queries": results,
+        "passed": sum(r["status"] == "success" for r in results),
+        "failed": sum(r["status"] != "success" for r in results),
+    }
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report

@@ -1,0 +1,298 @@
+"""TPC-DS-derived data generation and queries (north-star workload).
+
+BASELINE.md's target is TPC-DS; this module provides seeded, scale-factored
+generators for the core star-schema tables (store_sales fact + date_dim,
+item, store, time_dim, household_demographics, customer_demographics,
+promotion dims) and a representative query subset built on the DataFrame
+front-end so the full plan-rewrite path (tagging, shuffle insertion, AQE,
+DPP) is exercised — unlike bench/tpch.py which drives the exec layer
+directly.
+
+Queries follow the official shapes (predicates simplified where a generated
+domain makes the constant meaningless): q3, q42, q52, q55 (the classic
+date_dim x store_sales x item report family), q7 (demographics/promotion
+joins with averages), q96 (selective multi-dim count).
+
+Generation mirrors the reference's seeded datagen approach
+(datagen/src/main/scala/.../bigDataGen.scala): deterministic per
+(table, sf, seed).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.exprs.expr import (
+    And, Average, Count, EqualTo, GreaterThanOrEqual, Or, Sum, col, lit,
+)
+from spark_rapids_tpu.plan import DataFrame, from_arrow
+from spark_rapids_tpu.plan.dataframe import GroupedDataFrame  # noqa: F401
+from spark_rapids_tpu.exec.sort import SortOrder
+
+
+# ---------------------------------------------------------------------------
+# generators
+# ---------------------------------------------------------------------------
+
+_N_DATES = 365 * 5  # 1998-2002, d_date_sk dense
+_BASE_YEAR = 1998
+
+
+def gen_date_dim(seed: int = 0) -> pa.Table:
+    sk = np.arange(1, _N_DATES + 1)
+    year = _BASE_YEAR + (sk - 1) // 365
+    doy = (sk - 1) % 365
+    moy = np.minimum(doy // 30 + 1, 12)
+    return pa.table({
+        "d_date_sk": pa.array(sk, pa.int64()),
+        "d_year": pa.array(year.astype(np.int32), pa.int32()),
+        "d_moy": pa.array(moy.astype(np.int32), pa.int32()),
+        "d_dom": pa.array((doy % 30 + 1).astype(np.int32), pa.int32()),
+    })
+
+
+def gen_item(sf: float, seed: int = 1) -> pa.Table:
+    n = max(int(18_000 * min(sf, 10.0)), 100)
+    rng = np.random.default_rng(seed)
+    cats = np.array(["Books", "Home", "Electronics", "Jewelry", "Music",
+                     "Shoes", "Sports", "Women", "Men", "Children"])
+    cat_id = rng.integers(0, len(cats), n)
+    brand_id = rng.integers(1, 1000, n)
+    return pa.table({
+        "i_item_sk": pa.array(np.arange(1, n + 1), pa.int64()),
+        "i_item_id": pa.array([f"ITEM{j:08d}" for j in range(1, n + 1)],
+                              pa.string()),
+        "i_brand_id": pa.array(brand_id, pa.int64()),
+        "i_brand": pa.array([f"brand#{b}" for b in brand_id], pa.string()),
+        "i_category_id": pa.array(cat_id + 1, pa.int64()),
+        "i_category": pa.array(cats[cat_id], pa.string()),
+        "i_manufact_id": pa.array(rng.integers(1, 1000, n), pa.int64()),
+        "i_manager_id": pa.array(rng.integers(1, 100, n), pa.int64()),
+    })
+
+
+def gen_store(sf: float, seed: int = 2) -> pa.Table:
+    n = max(int(12 * np.sqrt(max(sf, 0.01))), 2)
+    rng = np.random.default_rng(seed)
+    names = np.array(["ese", "ought", "able", "pri", "bar"])
+    return pa.table({
+        "s_store_sk": pa.array(np.arange(1, n + 1), pa.int64()),
+        "s_store_name": pa.array(names[rng.integers(0, len(names), n)],
+                                 pa.string()),
+    })
+
+
+def gen_time_dim() -> pa.Table:
+    sk = np.arange(0, 86400, 60)  # one row per minute
+    return pa.table({
+        "t_time_sk": pa.array(sk, pa.int64()),
+        "t_hour": pa.array((sk // 3600).astype(np.int32), pa.int32()),
+        "t_minute": pa.array((sk % 3600 // 60).astype(np.int32), pa.int32()),
+    })
+
+
+def gen_household_demographics() -> pa.Table:
+    n = 7200
+    rng = np.random.default_rng(11)
+    return pa.table({
+        "hd_demo_sk": pa.array(np.arange(1, n + 1), pa.int64()),
+        "hd_dep_count": pa.array(rng.integers(0, 10, n).astype(np.int32),
+                                 pa.int32()),
+    })
+
+
+def gen_customer_demographics() -> pa.Table:
+    n = 19_200
+    rng = np.random.default_rng(12)
+    genders = np.array(["M", "F"])
+    marital = np.array(["S", "M", "D", "W", "U"])
+    edu = np.array(["Primary", "Secondary", "College", "2 yr Degree",
+                    "4 yr Degree", "Advanced Degree", "Unknown"])
+    return pa.table({
+        "cd_demo_sk": pa.array(np.arange(1, n + 1), pa.int64()),
+        "cd_gender": pa.array(genders[rng.integers(0, 2, n)], pa.string()),
+        "cd_marital_status": pa.array(marital[rng.integers(0, 5, n)],
+                                      pa.string()),
+        "cd_education_status": pa.array(edu[rng.integers(0, 7, n)],
+                                        pa.string()),
+    })
+
+
+def gen_promotion(seed: int = 13) -> pa.Table:
+    n = 300
+    rng = np.random.default_rng(seed)
+    yn = np.array(["Y", "N"])
+    return pa.table({
+        "p_promo_sk": pa.array(np.arange(1, n + 1), pa.int64()),
+        "p_channel_email": pa.array(yn[rng.integers(0, 2, n)], pa.string()),
+        "p_channel_event": pa.array(yn[rng.integers(0, 2, n)], pa.string()),
+    })
+
+
+def gen_store_sales(sf: float, seed: int = 3,
+                    n_items: Optional[int] = None,
+                    n_stores: Optional[int] = None) -> pa.Table:
+    n = int(2_880_000 * sf)
+    rng = np.random.default_rng(seed)
+    n_items = n_items or max(int(18_000 * min(sf, 10.0)), 100)
+    n_stores = n_stores or max(int(12 * np.sqrt(max(sf, 0.01))), 2)
+    qty = rng.integers(1, 101, n)
+    list_price = np.round(rng.uniform(1.0, 200.0, n), 2)
+    sales_price = np.round(list_price * rng.uniform(0.2, 1.0, n), 2)
+    return pa.table({
+        "ss_sold_date_sk": pa.array(rng.integers(1, _N_DATES + 1, n),
+                                    pa.int64()),
+        "ss_sold_time_sk": pa.array(
+            rng.integers(0, 86400 // 60, n) * 60, pa.int64()),
+        "ss_item_sk": pa.array(rng.integers(1, n_items + 1, n), pa.int64()),
+        "ss_store_sk": pa.array(rng.integers(1, n_stores + 1, n), pa.int64()),
+        "ss_hdemo_sk": pa.array(rng.integers(1, 7201, n), pa.int64()),
+        "ss_cdemo_sk": pa.array(rng.integers(1, 19_201, n), pa.int64()),
+        "ss_promo_sk": pa.array(rng.integers(1, 301, n), pa.int64()),
+        "ss_quantity": pa.array(qty.astype(np.float64), pa.float64()),
+        "ss_list_price": pa.array(list_price, pa.float64()),
+        "ss_sales_price": pa.array(sales_price, pa.float64()),
+        "ss_ext_sales_price": pa.array(
+            np.round(sales_price * qty, 2), pa.float64()),
+        "ss_coupon_amt": pa.array(
+            np.round(rng.uniform(0, 50.0, n), 2), pa.float64()),
+    })
+
+
+def tables_for(sf: float, seed: int = 0) -> Dict[str, pa.Table]:
+    return {
+        "date_dim": gen_date_dim(seed),
+        "item": gen_item(sf, seed + 1),
+        "store": gen_store(sf, seed + 2),
+        "time_dim": gen_time_dim(),
+        "household_demographics": gen_household_demographics(),
+        "customer_demographics": gen_customer_demographics(),
+        "promotion": gen_promotion(seed + 13),
+        "store_sales": gen_store_sales(sf, seed + 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# queries (DataFrame front-end -> full plan rewrite path)
+# ---------------------------------------------------------------------------
+
+
+def _dfs(tables: Dict[str, pa.Table], conf=None,
+         shuffle_partitions: int = 4) -> Dict[str, DataFrame]:
+    out = {}
+    for k, v in tables.items():
+        df = from_arrow(v, conf)
+        df.shuffle_partitions = shuffle_partitions
+        out[k] = df
+    return out
+
+
+def q3(d: Dict[str, DataFrame], manufact_id: int = 128) -> DataFrame:
+    """Brand revenue for one manufacturer in November, by year."""
+    ss = d["store_sales"]
+    dt = d["date_dim"].filter(EqualTo(col("d_moy"), lit(11)))
+    it = d["item"].filter(EqualTo(col("i_manufact_id"), lit(manufact_id)))
+    j = (ss.join(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .join(it, left_on="ss_item_sk", right_on="i_item_sk"))
+    return (j.group_by("d_year", "i_brand", "i_brand_id")
+            .agg(Sum(col("ss_ext_sales_price")).alias("sum_agg"))
+            .sort(SortOrder(col("d_year")),
+                  SortOrder(col("sum_agg"), ascending=False),
+                  SortOrder(col("i_brand_id")), limit=100))
+
+
+def q42(d: Dict[str, DataFrame], year: int = 2000) -> DataFrame:
+    """Category revenue for one November, by year/category."""
+    ss = d["store_sales"]
+    dt = d["date_dim"].filter(
+        And(EqualTo(col("d_moy"), lit(11)), EqualTo(col("d_year"), lit(year))))
+    it = d["item"]
+    j = (ss.join(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .join(it, left_on="ss_item_sk", right_on="i_item_sk"))
+    return (j.group_by("d_year", "i_category_id", "i_category")
+            .agg(Sum(col("ss_ext_sales_price")).alias("sum_agg"))
+            .sort(SortOrder(col("sum_agg"), ascending=False),
+                  SortOrder(col("d_year")),
+                  SortOrder(col("i_category_id")),
+                  SortOrder(col("i_category")), limit=100))
+
+
+def q52(d: Dict[str, DataFrame], year: int = 2000) -> DataFrame:
+    """Brand revenue for one November (q3 shape, year-pinned)."""
+    ss = d["store_sales"]
+    dt = d["date_dim"].filter(
+        And(EqualTo(col("d_moy"), lit(11)), EqualTo(col("d_year"), lit(year))))
+    it = d["item"]
+    j = (ss.join(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .join(it, left_on="ss_item_sk", right_on="i_item_sk"))
+    return (j.group_by("d_year", "i_brand", "i_brand_id")
+            .agg(Sum(col("ss_ext_sales_price")).alias("ext_price"))
+            .sort(SortOrder(col("d_year")),
+                  SortOrder(col("ext_price"), ascending=False),
+                  SortOrder(col("i_brand_id")), limit=100))
+
+
+def q55(d: Dict[str, DataFrame], manager_id: int = 28,
+        year: int = 1999) -> DataFrame:
+    """Brand revenue for one manager's items in one November."""
+    ss = d["store_sales"]
+    dt = d["date_dim"].filter(
+        And(EqualTo(col("d_moy"), lit(11)), EqualTo(col("d_year"), lit(year))))
+    it = d["item"].filter(EqualTo(col("i_manager_id"), lit(manager_id)))
+    j = (ss.join(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .join(it, left_on="ss_item_sk", right_on="i_item_sk"))
+    return (j.group_by("i_brand_id", "i_brand")
+            .agg(Sum(col("ss_ext_sales_price")).alias("ext_price"))
+            .sort(SortOrder(col("ext_price"), ascending=False),
+                  SortOrder(col("i_brand_id")), limit=100))
+
+
+def q7(d: Dict[str, DataFrame], year: int = 2000) -> DataFrame:
+    """Average sales metrics per item for one demographic slice."""
+    ss = d["store_sales"]
+    cd = d["customer_demographics"].filter(
+        And(And(EqualTo(col("cd_gender"), lit("M")),
+                EqualTo(col("cd_marital_status"), lit("S"))),
+            EqualTo(col("cd_education_status"), lit("College"))))
+    dt = d["date_dim"].filter(EqualTo(col("d_year"), lit(year)))
+    pr = d["promotion"].filter(
+        Or(EqualTo(col("p_channel_email"), lit("N")),
+           EqualTo(col("p_channel_event"), lit("N"))))
+    it = d["item"]
+    j = (ss.join(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+         .join(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .join(pr, left_on="ss_promo_sk", right_on="p_promo_sk")
+         .join(it, left_on="ss_item_sk", right_on="i_item_sk"))
+    return (j.group_by("i_item_id")
+            .agg(Average(col("ss_quantity")).alias("agg1"),
+                 Average(col("ss_list_price")).alias("agg2"),
+                 Average(col("ss_coupon_amt")).alias("agg3"),
+                 Average(col("ss_sales_price")).alias("agg4"))
+            .sort("i_item_id", limit=100))
+
+
+def q96(d: Dict[str, DataFrame]) -> DataFrame:
+    """Selective count through time/demographics/store dims."""
+    ss = d["store_sales"]
+    td = d["time_dim"].filter(
+        And(EqualTo(col("t_hour"), lit(20)),
+            GreaterThanOrEqual(col("t_minute"), lit(30))))
+    hd = d["household_demographics"].filter(
+        EqualTo(col("hd_dep_count"), lit(7)))
+    st = d["store"].filter(EqualTo(col("s_store_name"), lit("ese")))
+    j = (ss.join(td, left_on="ss_sold_time_sk", right_on="t_time_sk")
+         .join(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+         .join(st, left_on="ss_store_sk", right_on="s_store_sk"))
+    return j.agg(Count().alias("cnt"))
+
+
+QUERIES = {"q3": q3, "q42": q42, "q52": q52, "q55": q55, "q7": q7,
+           "q96": q96}
+
+
+def build_query(name: str, tables: Dict[str, pa.Table], conf=None,
+                shuffle_partitions: int = 4) -> DataFrame:
+    return QUERIES[name](_dfs(tables, conf, shuffle_partitions))

@@ -201,6 +201,46 @@ AQE_SKEW_FACTOR = conf(
     doc="A join partition is skewed when its size exceeds this multiple of "
         "the median partition size (and the threshold below).")
 
+PATHS_TO_REPLACE = conf(
+    "spark.rapids.tpu.alluxio.pathsToReplace", default="",
+    doc="Comma-separated 'src->dst' prefix rules applied to scan paths "
+        "before reading, e.g. 's3://bucket->/mnt/cache/bucket' "
+        "(reference: spark.rapids.alluxio.pathsToReplace, AlluxioUtils).")
+
+CBO_ENABLED = conf(
+    "spark.rapids.tpu.sql.optimizer.enabled", default=False,
+    doc="Cost-based optimizer: compare estimated device vs host cost "
+        "including host<->device transfer at placement boundaries, and keep "
+        "sections on CPU when acceleration doesn't pay (reference: "
+        "spark.rapids.sql.optimizer.enabled, CostBasedOptimizer.scala:36).")
+
+CBO_DEVICE_OP_COST = conf(
+    "spark.rapids.tpu.sql.optimizer.deviceOperatorCost", default=0.2,
+    doc="Relative per-row cost of an operator on device (reference: "
+        "spark.rapids.sql.optimizer.gpu.exec.default).", internal=True)
+
+CBO_CPU_OP_COST = conf(
+    "spark.rapids.tpu.sql.optimizer.cpuOperatorCost", default=1.0,
+    doc="Relative per-row cost of an operator on the CPU fallback engine.",
+    internal=True)
+
+CBO_TRANSFER_COST = conf(
+    "spark.rapids.tpu.sql.optimizer.transferCost", default=2.0,
+    doc="Relative per-row cost of crossing the host<->device boundary "
+        "(row<->columnar transition analog).", internal=True)
+
+DPP_ENABLED = conf(
+    "spark.rapids.tpu.sql.dynamicPartitionPruning.enabled", default=True,
+    doc="Dynamic partition pruning: collect a join's build-side key values "
+        "and prune the probe scan's parquet row groups whose statistics "
+        "prove no key can match (reference: GpuDynamicPruningExpression / "
+        "GpuSubqueryBroadcastExec; docs/dev/adaptive-query.md DPP).")
+
+DPP_MAX_KEYS = conf(
+    "spark.rapids.tpu.sql.dynamicPartitionPruning.maxKeys", default=1 << 16,
+    doc="Disable dynamic pruning when the build side has more distinct keys "
+        "than this (broadcast-threshold analog).", internal=True)
+
 AQE_SKEW_THRESHOLD_BYTES = conf(
     "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionThresholdBytes",
     default=256 << 20,

@@ -35,12 +35,32 @@ from spark_rapids_tpu.exprs import eval as EV
 from spark_rapids_tpu.exprs import expr as E
 
 
+# Delta primitive names <-> arrow types (Delta protocol schema
+# serialization); the two maps must stay inverses so an empty snapshot
+# reads back with the written types.
+_ARROW_TO_DELTA = {"int64": "long", "int32": "integer", "int16": "short",
+                   "int8": "byte", "double": "double", "float": "float",
+                   "bool": "boolean", "string": "string",
+                   "binary": "binary", "date32[day]": "date",
+                   "timestamp[us, tz=UTC]": "timestamp",
+                   "timestamp[us]": "timestamp_ntz"}
+_DELTA_TO_ARROW = {"long": pa.int64(), "integer": pa.int32(),
+                   "short": pa.int16(), "byte": pa.int8(),
+                   "double": pa.float64(), "float": pa.float32(),
+                   "boolean": pa.bool_(), "string": pa.string(),
+                   "binary": pa.binary(), "date": pa.date32(),
+                   "timestamp": pa.timestamp("us", "UTC"),
+                   "timestamp_ntz": pa.timestamp("us")}
+
+
+def _delta_type(t: pa.DataType) -> str:
+    if pa.types.is_decimal(t):
+        return f"decimal({t.precision},{t.scale})"
+    return _ARROW_TO_DELTA.get(str(t), str(t))
+
+
 def _schema_to_delta_json(schema: pa.Schema) -> str:
-    _MAP = {"int64": "long", "int32": "integer", "double": "double",
-            "float": "float", "bool": "boolean", "string": "string",
-            "date32[day]": "date"}
-    fields = [{"name": f.name,
-               "type": _MAP.get(str(f.type), str(f.type)),
+    fields = [{"name": f.name, "type": _delta_type(f.type),
                "nullable": f.nullable, "metadata": {}} for f in schema]
     return json.dumps({"type": "struct", "fields": fields})
 
@@ -48,14 +68,18 @@ def _schema_to_delta_json(schema: pa.Schema) -> str:
 def _delta_json_to_schema(schema_json: Optional[str]) -> pa.Schema:
     if not schema_json:
         return pa.schema([])
-    _MAP = {"long": pa.int64(), "integer": pa.int32(), "double": pa.float64(),
-            "float": pa.float32(), "boolean": pa.bool_(),
-            "string": pa.string(), "date": pa.date32()}
-    fields = [
-        pa.field(f["name"], _MAP.get(f["type"], pa.string()),
-                 f.get("nullable", True))
-        for f in json.loads(schema_json).get("fields", [])
-    ]
+
+    def _typ(name: str) -> pa.DataType:
+        if name in _DELTA_TO_ARROW:
+            return _DELTA_TO_ARROW[name]
+        # decimal(p,s) and any arrow-native name the writer passed through
+        if name.startswith("decimal"):
+            p, s = name[name.index("(") + 1:-1].split(",")
+            return pa.decimal128(int(p), int(s))
+        raise ValueError(f"unsupported delta type {name!r}")
+
+    fields = [pa.field(f["name"], _typ(f["type"]), f.get("nullable", True))
+              for f in json.loads(schema_json).get("fields", [])]
     return pa.schema(fields)
 
 

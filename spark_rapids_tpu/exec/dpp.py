@@ -1,0 +1,115 @@
+"""Dynamic partition pruning: runtime scan filters from join build sides.
+
+Reference: GpuDynamicPruningExpression + GpuSubqueryBroadcastExec
+(GpuOverrides DPP wiring, docs/dev/adaptive-query.md) and the runtime-filter
+join support (BloomFilterMightContain, SURVEY.md §2.4). Spark's DPP prunes a
+partitioned fact scan by the dim side's join key values; the standalone
+analog prunes parquet files/row groups by footer min/max statistics against
+the distinct key set collected from the join's build side — the same
+subquery-first execution shape, applied at the row-group granularity the
+scan already prunes statically.
+
+The filter executes its build subtree once (lazily, at first scan planning)
+and caches the distinct keys; oversized key sets disable pruning rather than
+blow up driver memory (Spark's broadcast threshold analog).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import List, Optional
+
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+
+
+class ReplayExec(UnaryExec):
+    """Materialize the child once, replay on every execute — the analog of
+    the reference reusing the broadcast exchange between
+    GpuSubqueryBroadcastExec (DPP key collection) and the join build side,
+    so attaching a runtime filter doesn't execute the build subtree twice.
+    Batches stay device-resident (build sides are dim-sized)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._cache = None
+        self._lock = threading.Lock()
+
+    def node_description(self) -> str:
+        return "TpuReplay (materialized build side)"
+
+    def _materialize(self):
+        with self._lock:
+            if self._cache is None:
+                self._cache = [list(self.child.execute(p))
+                               for p in range(self.child.num_partitions())]
+        return self._cache
+
+    def num_partitions(self) -> int:
+        return self.child.num_partitions()
+
+    def do_execute(self, partition: int):
+        yield from self._materialize()[partition]
+
+
+class DynamicPruningFilter:
+    """Distinct join-key values from a build-side plan, consulted by the
+    scan's row-group pruner (GpuSubqueryBroadcastExec analog)."""
+
+    def __init__(self, build: TpuExec, key_index: int, column: str,
+                 max_values: int = 1 << 16):
+        self.build = build
+        self.key_index = key_index
+        self.column = column  # scan-side column name the keys prune
+        self.max_values = max_values
+        self._values: Optional[List] = None
+        self._overflow = False
+        self._done = False
+        self._lock = threading.Lock()
+
+    def _collect(self) -> None:
+        from spark_rapids_tpu.columnar.batch import batch_to_arrow
+
+        distinct = set()
+        schema = self.build.output_schema
+        for p in range(self.build.num_partitions()):
+            for b in self.build.execute(p):
+                t = batch_to_arrow(b, schema)
+                col = t.column(self.key_index)
+                distinct.update(v for v in col.to_pylist() if v is not None)
+                if len(distinct) > self.max_values:
+                    self._overflow = True
+                    return
+        try:
+            self._values = sorted(distinct)
+        except TypeError:  # mixed/unorderable — disable
+            self._overflow = True
+
+    def values(self) -> Optional[List]:
+        """Sorted distinct keys, or None when pruning is disabled
+        (overflow)."""
+        with self._lock:
+            if not self._done:
+                self._collect()
+                self._done = True
+            return None if self._overflow else self._values
+
+    def may_match(self, mn, mx) -> bool:
+        """Could any collected key fall inside [mn, mx]? Conservative: True
+        on unknown stats or disabled filter."""
+        vals = self.values()
+        if vals is None:
+            return True
+        if mn is None or mx is None:
+            return True
+        try:
+            i = bisect.bisect_left(vals, mn)
+            return i < len(vals) and vals[i] <= mx
+        except TypeError:
+            return True
+
+    def describe(self) -> str:
+        if not self._done:
+            return f"dpp[{self.column}] (pending)"
+        n = "disabled" if self._overflow else len(self._values)
+        return f"dpp[{self.column}] ({n} keys)"

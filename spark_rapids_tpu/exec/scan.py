@@ -259,8 +259,12 @@ class ParquetScanExec(FileScanBase):
                  **kw):
         super().__init__(paths, columns, **kw)
         self.predicate = predicate
+        # runtime filters attached by the planner for dynamic partition
+        # pruning (exec/dpp.py); evaluated lazily at scan planning
+        self.dynamic_filters: List = []
         self._register_metric("numRowGroups")
         self._register_metric("numPrunedRowGroups")
+        self._register_metric("numDynPrunedRowGroups")
 
     def _read_schema(self) -> pa.Schema:
         return pq.read_schema(self.paths[0])
@@ -282,12 +286,30 @@ class ParquetScanExec(FileScanBase):
                 if self.predicate is not None and self._prune(md, rg):
                     self.metrics["numPrunedRowGroups"].add(1)
                     continue
+                if self.dynamic_filters and self._dyn_prune(md, rg):
+                    self.metrics["numDynPrunedRowGroups"].add(1)
+                    continue
                 keep.append(rg)
             if keep:
                 tasks.append(RowGroupTask(path, keep))
         return tasks
 
     def _prune(self, md, rg_index: int) -> bool:
+        stats_by_col = self._rg_stats(md, rg_index)
+        return not _stats_may_match(self.predicate, stats_by_col)
+
+    def _dyn_prune(self, md, rg_index: int) -> bool:
+        """Row group provably disjoint from every runtime filter's key set
+        (dynamic partition pruning)."""
+        stats_by_col = self._rg_stats(md, rg_index)
+        for f in self.dynamic_filters:
+            st = stats_by_col.get(f.column)
+            if st is not None and not f.may_match(st[0], st[1]):
+                return True
+        return False
+
+    @staticmethod
+    def _rg_stats(md, rg_index: int):
         rg = md.row_group(rg_index)
         stats_by_col = {}
         for ci in range(rg.num_columns):
@@ -296,7 +318,7 @@ class ParquetScanExec(FileScanBase):
             name = col.path_in_schema
             if st is not None and st.has_min_max:
                 stats_by_col[name] = (st.min, st.max)
-        return not _stats_may_match(self.predicate, stats_by_col)
+        return stats_by_col
 
     # -- reading: base dispatch over row-group tasks -----------------------
     def _partition_items(self, partition: int) -> List[RowGroupTask]:

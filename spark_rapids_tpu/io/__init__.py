@@ -24,3 +24,10 @@ from spark_rapids_tpu.io.async_write import (  # noqa: F401
     TrafficController,
 )
 from spark_rapids_tpu.io.filecache import FileCache  # noqa: F401
+from spark_rapids_tpu.io.hive import (  # noqa: F401
+    HiveTextScanExec,
+    discover_partitions,
+    parse_partition_values,
+    prune_partitions,
+)
+from spark_rapids_tpu.io.paths import replace_paths  # noqa: F401

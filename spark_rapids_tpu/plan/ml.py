@@ -1,0 +1,78 @@
+"""Zero-copy device handoff to ML (ColumnarRdd analog).
+
+Reference: sql-plugin-api ColumnarRdd.scala:42-51 — exposes an
+RDD[cudf.Table] from a DataFrame so XGBoost reads GPU-resident data without
+a host round trip (consumer side GpuBringBackToHost.scala,
+InternalColumnarRddConverter.scala). The TPU equivalent hands the query's
+output straight to JAX ML code: device ColumnarBatches (whose ``.data`` are
+live jax arrays) or a stacked feature matrix ready for jnp models — no
+device->host->device bounce.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.plan.dataframe import DataFrame
+
+
+def device_batches(df: DataFrame) -> Iterator[ColumnarBatch]:
+    """Execute the plan and yield TPU-resident batches (the RDD[Table]
+    analog). Batches stay on device; consumers read ``col.data``/``validity``
+    as jax arrays directly."""
+    node = df.physical_plan()
+    from spark_rapids_tpu.plan.cpu import CpuExec
+
+    if isinstance(node, CpuExec):
+        # CPU-fallback plans still hand off device batches (one upload)
+        from spark_rapids_tpu.columnar.batch import batch_from_arrow
+
+        for p in range(node.num_partitions()):
+            for t in node.execute_host(p):
+                yield batch_from_arrow(t)
+        return
+    for p in range(node.num_partitions()):
+        yield from node.execute(p)
+
+
+def feature_matrix(df: DataFrame,
+                   feature_cols: Optional[Sequence[str]] = None,
+                   label_col: Optional[str] = None,
+                   dtype=jnp.float32,
+                   ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Stack numeric columns into a dense [rows, features] device matrix
+    (the XGBoost-ingest shape), compacting away batch padding. Nulls become
+    NaN (XGBoost missing-value convention)."""
+    schema = df.schema
+    names = [f.name for f in schema]
+    feature_cols = list(feature_cols) if feature_cols is not None else [
+        n for n in names if n != label_col]
+    fidx = [names.index(c) for c in feature_cols]
+    lidx = names.index(label_col) if label_col is not None else None
+
+    xs: List[jax.Array] = []
+    ys: List[jax.Array] = []
+    for b in device_batches(df):
+        n = int(b.num_rows)
+        cols = []
+        for i in fidx:
+            c = b.columns[i]
+            data = c.data.astype(dtype)
+            data = jnp.where(c.validity, data, jnp.nan)
+            cols.append(data[:n])
+        xs.append(jnp.stack(cols, axis=1))
+        if lidx is not None:
+            c = b.columns[lidx]
+            ys.append(jnp.where(c.validity, c.data.astype(dtype),
+                                jnp.nan)[:n])
+    if not xs:
+        empty = jnp.zeros((0, len(fidx)), dtype)
+        return empty, (jnp.zeros((0,), dtype) if lidx is not None else None)
+    x = jnp.concatenate(xs, axis=0)
+    y = jnp.concatenate(ys, axis=0) if ys else None
+    return x, y

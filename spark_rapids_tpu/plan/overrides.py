@@ -143,6 +143,22 @@ class Overrides:
         self.conf = conf or C.RapidsConf()
         self.shuffle_partitions = shuffle_partitions
 
+    def _apply_path_rules(self, plan: L.LogicalPlan) -> None:
+        """Rewrite scan paths per the configured replacement rules before
+        anything reads footers (AlluxioUtils analog; io/paths.py). Rewrites
+        from each node's preserved original paths so re-planning under a
+        different conf stays correct."""
+        from spark_rapids_tpu.io.paths import replace_paths
+
+        if isinstance(plan, L.ParquetScan):
+            raw = getattr(plan, "_raw_paths", None)
+            if raw is None:
+                raw = list(plan.paths)
+                plan._raw_paths = raw
+            plan.paths = replace_paths(raw, self.conf)
+        for c in plan.children:
+            self._apply_path_rules(c)
+
     # -- tag ---------------------------------------------------------------
     def wrap_and_tag(self, plan: L.LogicalPlan) -> PlanMeta:
         meta = PlanMeta(plan, [self.wrap_and_tag(c) for c in plan.children])
@@ -188,7 +204,12 @@ class Overrides:
 
     # -- convert -----------------------------------------------------------
     def apply(self, plan: L.LogicalPlan) -> TpuExec:
+        self._apply_path_rules(plan)
         meta = self.wrap_and_tag(plan)
+        from spark_rapids_tpu.plan import cbo as _cbo
+
+        if self.conf[_cbo.CBO_ENABLED]:
+            _cbo.CostBasedOptimizer(self.conf).optimize(meta)
         ex = self._convert(meta)
         mode = C.EXPLAIN.get(self.conf)
         if mode != "NONE":
@@ -324,6 +345,7 @@ class Overrides:
 
             return CpuJoinExec(node.left_keys, node.right_keys,
                                node.join_type, left, right, node.condition)
+        probe = left  # pre-exchange subtree the DPP scan walk descends
         if self._planned_parts(left) > 1:
             # shuffled join: co-partition both sides by key hash
             lk = [self._key_index(k, node.left.schema) for k in node.left_keys]
@@ -339,12 +361,68 @@ class Overrides:
                     lex, rex, node.join_type, self.conf)
             else:
                 left, right = lex, rex
+            # build = the RAW right exchange, not the AQE-paired reader: DPP
+            # key collection still reuses the same materialized shuffle
+            # blocks the join reads, but consulting the paired reader here
+            # would re-enter the skew planner (and the left exchange's write
+            # lock) from inside the left stage's own write — deadlock
+            self._try_dynamic_pruning(node, probe, rex)
         elif self._planned_parts(right) > 1:
             # broadcast-style: collapse the build side into the stream's
             # single partition (GpuBroadcastHashJoin analog)
             right = ShuffleExchangeExec(SinglePartitioner(), right)
+            self._try_dynamic_pruning(node, probe, right)
+        else:
+            # no exchange to reuse: materialize the build side once and
+            # share it between the runtime filter and the join
+            from spark_rapids_tpu.exec.dpp import ReplayExec
+
+            cached = ReplayExec(right)
+            if self._try_dynamic_pruning(node, probe, cached):
+                right = cached
         return HashJoinExec(node.left_keys, node.right_keys, node.join_type,
                             left, right, condition=node.condition)
+
+    def _try_dynamic_pruning(self, node: L.Join, probe: TpuExec,
+                             build: TpuExec) -> bool:
+        """Attach a runtime key filter from the join's build side to a
+        parquet scan under the probe (left) subtree, when dropping provably
+        unmatched probe rows cannot change the join result
+        (GpuDynamicPruningExpression analog; exec/dpp.py). ``build`` should
+        be the join's actual build child (exchange / replay-cached) so key
+        collection reuses the join's own materialization. Returns whether a
+        filter was attached."""
+        if not C.DPP_ENABLED.get(self.conf):
+            return False
+        # sound only when unmatched LEFT rows are never emitted
+        if node.join_type not in ("inner", "left_semi", "right"):
+            return False
+        from spark_rapids_tpu.exec.dpp import DynamicPruningFilter
+
+        # descend through schema-preserving operators only (a projection
+        # could rename/derive the key column)
+        cur = probe
+        while isinstance(cur, (FilterExec, CoalesceBatchesExec)):
+            cur = cur.children[0]
+        if not isinstance(cur, ParquetScanExec):
+            return False
+        scan_cols = {f.name for f in cur.output_schema}
+        attached = False
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            try:
+                lb = E.resolve(lk, node.left.schema)
+                rb = E.resolve(rk, node.right.schema)
+            except (TypeError, KeyError, NotImplementedError):
+                continue
+            if not isinstance(lb, E.ColumnRef) or lb.name not in scan_cols:
+                continue
+            if not isinstance(rb, E.ColumnRef):
+                continue
+            cur.dynamic_filters.append(DynamicPruningFilter(
+                build, rb.index, lb.name,
+                max_values=C.DPP_MAX_KEYS.get(self.conf)))
+            attached = True
+        return attached
 
     @staticmethod
     def _planned_parts(node: TpuExec) -> int:

@@ -94,34 +94,36 @@ def in_planning_scope() -> bool:
 # ---------------------------------------------------------------------------
 
 
+def pack_ranges(sizes: Sequence[int], target_bytes: int,
+                offset: int = 0) -> List[Tuple[int, int]]:
+    """THE greedy size-packing rule, shared by every AQE planner: contiguous
+    [start, end) ranges over ``sizes`` accumulating up to the advisory
+    target (ShufflePartitionsUtil's accumulate-and-flush loop). ``offset``
+    shifts the emitted indices (for packing a sub-run of reducers)."""
+    ranges: List[Tuple[int, int]] = []
+    start, acc = 0, 0
+    for i, sz in enumerate(sizes):
+        if i > start and acc + sz > target_bytes:
+            ranges.append((offset + start, offset + i))
+            start, acc = i, 0
+        acc += sz
+    ranges.append((offset + start, offset + len(sizes)))
+    return ranges
+
+
 def coalesce_specs(sizes: Sequence[int],
                    target_bytes: int) -> List[CoalescedPartitionSpec]:
     """Greedily pack adjacent reduce partitions up to the advisory size
     (ShufflePartitionsUtil.coalescePartitions)."""
-    specs: List[CoalescedPartitionSpec] = []
-    start, acc = 0, 0
-    for i, sz in enumerate(sizes):
-        if i > start and acc + sz > target_bytes:
-            specs.append(CoalescedPartitionSpec(start, i))
-            start, acc = i, 0
-        acc += sz
-    specs.append(CoalescedPartitionSpec(start, len(sizes)))
-    return specs
+    return [CoalescedPartitionSpec(s, e)
+            for s, e in pack_ranges(sizes, target_bytes)]
 
 
 def split_map_ranges(sizes_by_map: Sequence[int],
                      target_bytes: int) -> List[Tuple[int, int]]:
     """Split one reduce partition's map outputs into contiguous ranges of
     roughly target size (ShufflePartitionsUtil.createSkewPartitionSpecs)."""
-    ranges: List[Tuple[int, int]] = []
-    start, acc = 0, 0
-    for i, sz in enumerate(sizes_by_map):
-        if i > start and acc + sz > target_bytes:
-            ranges.append((start, i))
-            start, acc = i, 0
-        acc += sz
-    ranges.append((start, len(sizes_by_map)))
-    return ranges
+    return pack_ranges(sizes_by_map, target_bytes)
 
 
 def _median(xs: Sequence[int]) -> float:
@@ -273,50 +275,51 @@ class SkewJoinPlanner:
         rthr = skew_threshold(rsizes, conf[C.AQE_SKEW_FACTOR],
                               conf[C.AQE_SKEW_THRESHOLD_BYTES])
 
+        # splitting the stream side is only sound when that side's rows may
+        # be partitioned arbitrarily: left-outer/semi/anti pin the RIGHT
+        # side whole (split left only), and vice versa
+        can_split_l = skew_on and self.join_type in (
+            "inner", "left_semi", "left_anti", "left")
+        can_split_r = skew_on and self.join_type in ("inner", "right")
+        l_skews = [can_split_l and s > lthr for s in lsizes]
+        r_skews = [can_split_r and s > rthr for s in rsizes]
+
         lspecs: List[Spec] = []
         rspecs: List[Spec] = []
-        co_start = -1  # open coalesce run start on both sides
-        co_acc_l = co_acc_r = 0
 
-        def flush_run(end: int) -> None:
-            nonlocal co_start
-            if co_start >= 0:
-                lspecs.append(CoalescedPartitionSpec(co_start, end))
-                rspecs.append(CoalescedPartitionSpec(co_start, end))
-                co_start = -1
+        def pack_run(start: int, end: int) -> None:
+            """Joint coalescing of a non-skewed reducer run: both sides use
+            the same ranges (keys must stay aligned), packed by the larger
+            side's size."""
+            joint = [max(lsizes[i], rsizes[i]) for i in range(start, end)]
+            for s, e in pack_ranges(joint, target, offset=start):
+                lspecs.append(CoalescedPartitionSpec(s, e))
+                rspecs.append(CoalescedPartitionSpec(s, e))
 
+        run_start = -1
         for r in range(len(lsizes)):
-            # splitting the stream side is only sound when that side's rows
-            # may be partitioned arbitrarily: left-outer/semi/anti pin the
-            # RIGHT side whole (split left only), and vice versa
-            can_split_l = skew_on and self.join_type in (
-                "inner", "left_semi", "left_anti", "left")
-            can_split_r = skew_on and self.join_type in ("inner", "right")
-            l_skew = can_split_l and lsizes[r] > lthr
-            r_skew = can_split_r and rsizes[r] > rthr
-            if l_skew or r_skew:
-                flush_run(r)
+            if l_skews[r] or r_skews[r]:
+                if run_start >= 0:
+                    pack_run(run_start, r)
+                    run_start = -1
                 lranges = (split_map_ranges(
                     lex.manager.partition_sizes_by_map(lex._reg, r), target)
-                    if l_skew else [(0, lex.manager.num_map_outputs(lex._reg))])
+                    if l_skews[r]
+                    else [(0, lex.manager.num_map_outputs(lex._reg))])
                 rranges = (split_map_ranges(
                     rex.manager.partition_sizes_by_map(rex._reg, r), target)
-                    if r_skew else [(0, rex.manager.num_map_outputs(rex._reg))])
+                    if r_skews[r]
+                    else [(0, rex.manager.num_map_outputs(rex._reg))])
                 for lm in lranges:
                     for rm in rranges:
                         lspecs.append(
                             PartialReducerPartitionSpec(r, lm[0], lm[1]))
                         rspecs.append(
                             PartialReducerPartitionSpec(r, rm[0], rm[1]))
-            else:
-                if co_start < 0:
-                    co_start, co_acc_l, co_acc_r = r, 0, 0
-                elif max(co_acc_l + lsizes[r], co_acc_r + rsizes[r]) > target:
-                    flush_run(r)
-                    co_start, co_acc_l, co_acc_r = r, 0, 0
-                co_acc_l += lsizes[r]
-                co_acc_r += rsizes[r]
-        flush_run(len(lsizes))
+            elif run_start < 0:
+                run_start = r
+        if run_start >= 0:
+            pack_run(run_start, len(lsizes))
         self.left._set_specs(lspecs)
         self.right._set_specs(rspecs)
 

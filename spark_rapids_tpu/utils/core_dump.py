@@ -1,0 +1,139 @@
+"""Device-state snapshot on failure (GPU core dump analog).
+
+Reference: GpuCoreDumpHandler.scala (194 LoC; docs/dev/gpu-core-dumps.md) —
+on a fatal GPU exception the reference streams a CUDA core dump through a
+named pipe to durable storage, driver-coordinated. A TPU has no process
+core dump to capture, so the equivalent postmortem artifact is a snapshot
+of the framework's device-facing state: HBM pool accounting + watermarks,
+spill store contents, recent trace events, and backend device info —
+everything needed to reconstruct "what was on the chip" when a query died.
+
+Use ``dump_state(dir)`` directly, or ``core_dump_on_failure(dir)`` around
+query execution to write a snapshot only when an exception escapes (the
+RapidsExecutorPlugin fatal-error path analog, Plugin.scala:560-568).
+Codec: gzip (the reference's optional dump codec).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import sys
+import time
+import traceback
+from typing import Optional
+
+import jax
+
+
+def _pool_state() -> dict:
+    try:
+        from spark_rapids_tpu.mem.pool import get_pool
+
+        pool = get_pool()
+        return {
+            "limit_bytes": pool.limit,
+            "used_bytes": pool.used,
+            "max_used_bytes": pool.max_used,
+            "alloc_count": pool.alloc_count,
+            "oom_count": pool.oom_count,
+            "spill_request_count": pool.spill_request_count,
+        }
+    except Exception as ex:
+        return {"error": repr(ex)}
+
+
+def _spill_state(framework) -> dict:
+    if framework is None:
+        return {"attached": False}
+    try:
+        handles = list(getattr(framework, "_handles", ()))
+        by_state: dict = {}
+        for h in handles:
+            by_state.setdefault(h.state, {"count": 0, "bytes": 0})
+            by_state[h.state]["count"] += 1
+            by_state[h.state]["bytes"] += h.nbytes
+        return {"attached": True, "handles": len(handles),
+                "by_state": by_state}
+    except Exception as ex:
+        return {"error": repr(ex)}
+
+
+def _device_state() -> dict:
+    out: dict = {"devices": []}
+    try:
+        for d in jax.devices():
+            info = {"id": d.id, "platform": d.platform,
+                    "kind": getattr(d, "device_kind", "?")}
+            try:
+                ms = d.memory_stats()
+                if ms:
+                    info["memory_stats"] = {
+                        k: v for k, v in ms.items()
+                        if isinstance(v, (int, float))}
+            except Exception:
+                pass
+            out["devices"].append(info)
+    except Exception as ex:
+        out["error"] = repr(ex)
+    return out
+
+
+def _trace_tail(n: int = 200) -> list:
+    try:
+        from spark_rapids_tpu.utils.tracing import trace_events
+
+        return trace_events()[-n:]
+    except Exception:
+        return []
+
+
+def dump_state(out_dir: str, exc: Optional[BaseException] = None,
+               spill_framework=None, tag: str = "tpu_core_dump") -> str:
+    """Write a compressed snapshot; returns the file path."""
+    os.makedirs(out_dir, exist_ok=True)
+    snap = {
+        "timestamp": time.time(),
+        "tag": tag,
+        "python": sys.version,
+        "jax": jax.__version__,
+        "exception": (
+            {"type": type(exc).__name__, "message": str(exc),
+             "traceback": traceback.format_exception(exc)}
+            if exc is not None else None),
+        "pool": _pool_state(),
+        "spill": _spill_state(spill_framework),
+        "device": _device_state(),
+        "trace_tail": _trace_tail(),
+    }
+    path = os.path.join(out_dir, f"{tag}_{int(time.time() * 1000)}.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump(snap, f, indent=1, default=repr)
+    return path
+
+
+class core_dump_on_failure:
+    """Context manager: snapshot device state when an exception escapes
+    (the executor fatal-error hook analog)."""
+
+    def __init__(self, out_dir: str, reraise: bool = True,
+                 spill_framework=None):
+        self.out_dir = out_dir
+        self.reraise = reraise
+        self.spill_framework = spill_framework
+        self.dump_path: Optional[str] = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None:
+            self.dump_path = dump_state(self.out_dir, exc,
+                                        self.spill_framework)
+        return not self.reraise if exc is not None else False
+
+
+def read_dump(path: str) -> dict:
+    with gzip.open(path, "rt") as f:
+        return json.load(f)

@@ -1,0 +1,112 @@
+"""API validation: device execs stay signature-compatible with their CPU
+fallback twins and the logical nodes that produce them.
+
+Reference: api_validation/src/main/scala/.../ApiValidation.scala — a
+reflection diff of each GpuExec case-class signature against the Spark exec
+it replaces, run across Spark versions. Standalone the contract is internal:
+for every logical operator the converter must be able to build BOTH the
+device exec and the CPU fallback exec, and each (device, CPU) pair must
+expose the same execution surface (schema/partitioning/iteration), since
+the planner swaps them per-node without adapters.
+"""
+
+import inspect
+
+import pytest
+
+from spark_rapids_tpu.exec import base as B
+from spark_rapids_tpu.plan import cpu as PC
+from spark_rapids_tpu.plan import cpu_agg as PCA
+from spark_rapids_tpu.plan import logical as L
+
+
+# (logical node, device exec, cpu exec) rows the converter pairs up
+# (Overrides._convert); ApiValidation's table analog
+def _pairs():
+    from spark_rapids_tpu import exec as X
+
+    return [
+        (L.ParquetScan, X.ParquetScanExec, PC.CpuParquetScanExec),
+        (L.Project, X.ProjectExec, PC.CpuProjectExec),
+        (L.Filter, X.FilterExec, PC.CpuFilterExec),
+        (L.Aggregate, X.HashAggregateExec, PCA.CpuAggregateExec),
+        (L.Sort, X.SortExec, PC.CpuSortExec),
+        (L.Join, X.HashJoinExec, PCA.CpuJoinExec),
+        (L.Limit, X.GlobalLimitExec, PC.CpuLimitExec),
+        (L.Union, X.UnionExec, PC.CpuUnionExec),
+    ]
+
+
+EXEC_SURFACE = ("output_schema", "num_partitions", "execute", "explain",
+                "collect_metrics")
+
+
+@pytest.mark.parametrize("logical,dev,cpu", _pairs())
+def test_exec_pair_exposes_execution_surface(logical, dev, cpu):
+    for cls in (dev, cpu):
+        for attr in EXEC_SURFACE:
+            assert hasattr(cls, attr), f"{cls.__name__} lacks {attr}"
+
+
+@pytest.mark.parametrize("logical,dev,cpu", _pairs())
+def test_cpu_exec_is_fallback_marked(logical, dev, cpu):
+    assert issubclass(cpu, PC.CpuExec), cpu.__name__
+    assert not issubclass(dev, PC.CpuExec), dev.__name__
+    assert issubclass(dev, B.TpuExec)
+
+
+def _required_params(cls):
+    sig = inspect.signature(cls.__init__)
+    return [p.name for p in sig.parameters.values()
+            if p.default is inspect.Parameter.empty
+            and p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)
+            and p.name not in ("self",)]
+
+
+@pytest.mark.parametrize("logical,dev,cpu", _pairs())
+def test_logical_fields_cover_exec_required_params(logical, dev, cpu):
+    """Every required ctor param of the device exec must be derivable from
+    the logical node's fields (the converter passes them through); a new
+    required param without a logical source breaks the rewrite silently."""
+    import dataclasses
+
+    if not dataclasses.is_dataclass(logical):
+        pytest.skip("non-dataclass logical node")
+    logical_fields = {f.name for f in dataclasses.fields(logical)}
+    # converter-supplied names that don't come from the logical node
+    supplied = {
+        "child", "children", "left", "right", "build", "probe", "paths",
+        "inputs", "orders", "exprs", "condition", "group_exprs", "agg_exprs",
+        "left_keys", "right_keys", "join_type", "n", "limit", "mode",
+        "partitioner", "columns", "predicate",
+    }
+    for cls in (dev, cpu):
+        for p in _required_params(cls):
+            assert p in logical_fields or p in supplied, (
+                f"{cls.__name__} requires ctor param {p!r} with no source "
+                f"on {logical.__name__}")
+
+
+def test_all_device_execs_implement_do_execute():
+    """Abstract-surface sweep: every concrete TpuExec in the exec package
+    overrides do_execute (the internalDoExecuteColumnar contract,
+    GpuExec.scala:475)."""
+    import importlib
+    import pkgutil
+
+    import spark_rapids_tpu.exec as exec_pkg
+
+    abstract_bases = {B.TpuExec, B.LeafExec, B.UnaryExec, B.BinaryExec}
+    missing = []
+    for mod_info in pkgutil.iter_modules(exec_pkg.__path__):
+        mod = importlib.import_module(f"spark_rapids_tpu.exec.{mod_info.name}")
+        for name, cls in inspect.getmembers(mod, inspect.isclass):
+            if (issubclass(cls, B.TpuExec) and cls.__module__ == mod.__name__
+                    and cls not in abstract_bases
+                    and not inspect.isabstract(cls)
+                    and not name.startswith("_")):
+                if (cls.do_execute is B.TpuExec.do_execute
+                        and cls.execute is B.TpuExec.execute
+                        and "Base" not in name):
+                    missing.append(f"{mod.__name__}.{name}")
+    assert not missing, f"execs without do_execute: {missing}"

@@ -4,6 +4,8 @@ GpuLore, RapidsConf docs gen / TypeChecks supported_ops gen)."""
 
 import os
 
+import pytest
+
 import numpy as np
 import pyarrow as pa
 
@@ -95,3 +97,75 @@ def test_docs_generators(tmp_path):
     assert all(os.path.exists(p) for p in paths)
     cfg = open(paths[0]).read()
     assert "spark.rapids.tpu" in cfg
+
+
+# -- core dump (GpuCoreDumpHandler analog) ----------------------------------
+
+
+def test_core_dump_snapshot(tmp_path):
+    from spark_rapids_tpu.utils.core_dump import (
+        core_dump_on_failure, dump_state, read_dump,
+    )
+
+    p = dump_state(str(tmp_path))
+    snap = read_dump(p)
+    assert snap["pool"]["limit_bytes"] > 0
+    assert snap["device"]["devices"]
+    assert snap["exception"] is None
+
+    with pytest.raises(RuntimeError):
+        with core_dump_on_failure(str(tmp_path)) as cd:
+            raise RuntimeError("simulated device failure")
+    snap = read_dump(cd.dump_path)
+    assert snap["exception"]["type"] == "RuntimeError"
+    assert "simulated device failure" in snap["exception"]["message"]
+
+
+def test_core_dump_swallow_mode(tmp_path):
+    from spark_rapids_tpu.utils.core_dump import core_dump_on_failure
+
+    with core_dump_on_failure(str(tmp_path), reraise=False) as cd:
+        raise ValueError("x")
+    assert cd.dump_path is not None
+
+
+# -- ColumnarRdd analog ------------------------------------------------------
+
+
+def test_device_batches_handoff():
+    import numpy as np
+    import pyarrow as pa
+    import jax
+
+    from spark_rapids_tpu.plan import from_arrow
+    from spark_rapids_tpu.plan.ml import device_batches
+    from spark_rapids_tpu.exprs.expr import col, lit
+
+    t = pa.table({"a": pa.array(np.arange(100, dtype=np.float64)),
+                  "b": pa.array(np.arange(100), pa.int64())})
+    df = from_arrow(t).filter(col("b") < lit(50))
+    batches = list(device_batches(df))
+    assert batches and all(isinstance(b.columns[0].data, jax.Array)
+                           for b in batches)
+    total = sum(int(b.num_rows) for b in batches)
+    assert total == 50
+
+
+def test_feature_matrix_stack():
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.plan import from_arrow
+    from spark_rapids_tpu.plan.ml import feature_matrix
+
+    t = pa.table({
+        "f1": pa.array([1.0, 2.0, None, 4.0], pa.float64()),
+        "f2": pa.array([10.0, 20.0, 30.0, 40.0], pa.float64()),
+        "y": pa.array([0.0, 1.0, 0.0, 1.0], pa.float64()),
+    })
+    x, y = feature_matrix(from_arrow(t), label_col="y")
+    assert x.shape == (4, 2)
+    assert y.shape == (4,)
+    xs = np.asarray(x)
+    assert np.isnan(xs[2, 0]) and xs[3, 1] == 40.0
+    assert np.asarray(y).tolist() == [0.0, 1.0, 0.0, 1.0]

@@ -291,3 +291,18 @@ def test_iceberg_metadata_version_numeric_order(tmp_path, rng):
             json.dump(md, f)
     assert IcebergTable(str(root)).data_files() == \
         [str(root / "data" / "f1.parquet")]
+
+
+def test_delta_empty_table_preserves_types(tmp_path):
+    # regression: unmapped types (timestamp) degraded to string on the
+    # empty-snapshot read path
+    t = pa.table({
+        "ts": pa.array([1000, 2000], pa.timestamp("us", "UTC")),
+        "d": pa.array([pa.scalar(1, pa.int16()).as_py()] * 2, pa.int16()),
+    })
+    dt = DeltaTable.create(str(tmp_path / "tbl"), t)
+    dt.delete(lit(True))
+    out = dt.to_arrow()
+    assert out.num_rows == 0
+    assert out.schema.field("ts").type == pa.timestamp("us", "UTC")
+    assert out.schema.field("d").type == pa.int16()

@@ -209,3 +209,92 @@ def test_filecache(tmp_path):
     for off in range(0, 9000, 3000):
         fc.get_range(str(src), off, 3000)
     assert fc.cached_bytes <= 6000
+
+
+# -- hive text scan (GpuHiveTableScanExec analog) ---------------------------
+
+
+def _write_hive_file(path, rows, delim="\x01"):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(delim.join("\\N" if v is None else str(v)
+                               for v in r) + "\n")
+
+
+def test_hive_text_scan_basic(tmp_path):
+    from spark_rapids_tpu.io import HiveTextScanExec
+
+    root = str(tmp_path / "tbl")
+    _write_hive_file(os.path.join(root, "000000_0"),
+                     [(1, "a", 1.5), (2, None, 2.5), (3, "c", None)])
+    schema = pa.schema([("id", pa.int64()), ("s", pa.string()),
+                       ("v", pa.float64())])
+    node = HiveTextScanExec(root, schema)
+    rows = []
+    for b in node.execute_all():
+        rows.extend(batch_to_arrow(b, node.output_schema).to_pylist())
+    assert rows == [
+        {"id": 1, "s": "a", "v": 1.5},
+        {"id": 2, "s": None, "v": 2.5},
+        {"id": 3, "s": "c", "v": None},
+    ]
+
+
+def test_hive_text_scan_partitioned(tmp_path):
+    from spark_rapids_tpu.io import HiveTextScanExec
+
+    root = str(tmp_path / "tbl")
+    _write_hive_file(os.path.join(root, "dt=2024-01-01", "000000_0"),
+                     [(1, 10), (2, 20)])
+    _write_hive_file(os.path.join(root, "dt=__HIVE_DEFAULT_PARTITION__",
+                                  "000000_0"), [(3, 30)])
+    schema = pa.schema([("id", pa.int64()), ("v", pa.int64())])
+    pschema = pa.schema([("dt", pa.string())])
+    node = HiveTextScanExec(root, schema, partition_schema=pschema)
+    rows = []
+    for b in node.execute_all():
+        rows.extend(batch_to_arrow(b, node.output_schema).to_pylist())
+    rows.sort(key=lambda r: r["id"])
+    assert [r["dt"] for r in rows] == ["2024-01-01", "2024-01-01", None]
+    assert [r["v"] for r in rows] == [10, 20, 30]
+
+
+def test_hive_partition_pruning(tmp_path):
+    from spark_rapids_tpu.io import discover_partitions, prune_partitions
+
+    root = str(tmp_path / "tbl")
+    _write_hive_file(os.path.join(root, "y=2023", "f"), [(1,)])
+    _write_hive_file(os.path.join(root, "y=2024", "f"), [(2,)])
+    files = discover_partitions(root)
+    assert len(files) == 2
+    kept = prune_partitions(files, root, lambda pv: pv.get("y") == "2024")
+    assert len(kept) == 1 and "y=2024" in kept[0]
+
+
+def test_path_replacement_rules(tmp_path):
+    from spark_rapids_tpu.config import conf as C
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.io.paths import PATHS_TO_REPLACE, replace_paths
+
+    conf = RapidsConf({PATHS_TO_REPLACE.key:
+                       "s3://bucket->/mnt/cache, gs://b2->/mnt/g"})
+    assert replace_paths(
+        ["s3://bucket/a.parquet", "gs://b2/x", "/local/y"], conf) == \
+        ["/mnt/cache/a.parquet", "/mnt/g/x", "/local/y"]
+
+
+def test_path_replacement_in_plan(tmp_path):
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.io.paths import PATHS_TO_REPLACE
+    from spark_rapids_tpu.plan import read_parquet
+
+    real = tmp_path / "real"
+    real.mkdir()
+    t = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+    pq.write_table(t, real / "f.parquet")
+    conf = RapidsConf({PATHS_TO_REPLACE.key:
+                       f"fake://tbl->{real}"})
+    df = read_parquet("fake://tbl/f.parquet", conf=conf)
+    assert [r["x"] for r in df.collect()] == [1, 2, 3]

@@ -1,0 +1,79 @@
+"""ScaleTest harness tests: every catalog query runs green at tiny scale and
+the JSON report has the TestReport shape. Spot-checks a few queries against
+pandas (differential bar)."""
+
+import json
+
+import pytest
+
+from spark_rapids_tpu.bench import scaletest
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return scaletest.gen_tables(scale=0.01, complexity=20, seed=5)
+
+
+def test_gen_tables_shapes(tables):
+    assert set(tables) == set("abcdefg")
+    assert tables["a"].num_rows >= 1000
+    assert tables["f"].num_rows == 20
+    # b is skewed: key 1 dominates
+    import collections
+
+    counts = collections.Counter(tables["b"].column("b_key").to_pylist())
+    assert counts[1] > tables["b"].num_rows * 0.4
+
+
+def test_run_suite_all_green(tmp_path, tables):
+    path = str(tmp_path / "report.json")
+    report = scaletest.run_suite(scale=0.01, complexity=20, seed=5,
+                                 report_path=path)
+    assert report["failed"] == 0, [
+        q for q in report["queries"] if q["status"] != "success"]
+    assert report["passed"] == len(scaletest.QUERIES)
+    on_disk = json.load(open(path))
+    assert on_disk["suite"] == "scaletest"
+    for q in on_disk["queries"]:
+        assert q["status"] == "success"
+        assert q["best_ms"] >= 0
+        assert "rows" in q
+
+
+def test_skewed_join_matches_pandas(tables):
+    t = scaletest._dfs(tables)
+    got = {r["f_name"]: r["s"]
+           for r in scaletest._q_join_skewed(t).collect()}
+    b = tables["b"].to_pandas()
+    f = tables["f"].to_pandas()
+    exp = (b.merge(f, left_on="b_key", right_on="f_key")
+           .groupby("f_name").b_v.sum())
+    assert set(got) == set(exp.index)
+    for k, v in exp.items():
+        assert got[k] == pytest.approx(v, rel=1e-9)
+
+
+def test_anti_semi_partition(tables):
+    """semi + anti of the same predicate partition the fact table."""
+    t = scaletest._dfs(tables)
+    n_semi = sum(1 for _ in scaletest._q_join_semi(t).collect())
+    n_anti = sum(1 for _ in scaletest._q_join_anti(t).collect())
+    assert n_semi + n_anti == tables["a"].num_rows
+
+
+def test_null_groups_matches_pandas(tables):
+    t = scaletest._dfs(tables)
+    got = {r["g_key"]: (r["n"], r["s"])
+           for r in scaletest._q_null_groups(t).collect()}
+    g = tables["g"].to_pandas()
+    exp_n = g.groupby("g_key", dropna=False).g_v.size()
+    exp_s = g.groupby("g_key", dropna=False).g_v.sum(min_count=1)
+    assert len(got) == len(exp_n)
+    for k in exp_n.index:
+        kk = None if k != k else k  # NaN -> None
+        n, s = got[kk]
+        assert n == exp_n[k]
+        if s is None:
+            assert exp_s[k] != exp_s[k]  # NaN
+        else:
+            assert s == pytest.approx(exp_s[k], rel=1e-9)

@@ -1,0 +1,155 @@
+"""TPC-DS subset: differential tests vs a host (pandas) reference.
+
+Mirrors the reference's primary correctness net (integration_tests
+asserts.py assert_gpu_and_cpu_are_equal_collect): same query on the device
+plan path and on pandas, identical results. Queries go through the
+DataFrame front-end so tagging, shuffle insertion, AQE and DPP all run.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.bench import tpcds
+
+SF = 0.002  # ~5.7k fact rows; compile-bounded, not data-bounded
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return tpcds.tables_for(SF, seed=42)
+
+
+@pytest.fixture(scope="module")
+def pdt(tables):
+    return {k: v.to_pandas() for k, v in tables.items()}
+
+
+def _rows(df):
+    return df.collect()
+
+
+def _group_map(rows, keys, val):
+    return {tuple(r[k] for k in keys): r[val] for r in rows}
+
+
+def _assert_groups_equal(got_rows, exp_map, keys, val, rel=1e-9):
+    got_map = _group_map(got_rows, keys, val)
+    assert set(got_map) == set(exp_map), (
+        f"group keys differ: extra={set(got_map) - set(exp_map)}, "
+        f"missing={set(exp_map) - set(got_map)}")
+    for k, v in exp_map.items():
+        assert got_map[k] == pytest.approx(v, rel=rel), k
+
+
+def test_q3(tables, pdt):
+    manufact_id = int(pdt["item"].i_manufact_id.iloc[0])
+    df = tpcds.q3(tpcds._dfs(tables), manufact_id=manufact_id)
+    got = _rows(df)
+
+    ss, dt, it = pdt["store_sales"], pdt["date_dim"], pdt["item"]
+    j = (ss.merge(dt[dt.d_moy == 11], left_on="ss_sold_date_sk",
+                  right_on="d_date_sk")
+         .merge(it[it.i_manufact_id == manufact_id], left_on="ss_item_sk",
+                right_on="i_item_sk"))
+    exp = (j.groupby(["d_year", "i_brand", "i_brand_id"])
+           .ss_ext_sales_price.sum())
+    assert len(got) == len(exp) and len(got) <= 100
+    _assert_groups_equal(got, dict(exp.items()),
+                         ("d_year", "i_brand", "i_brand_id"), "sum_agg")
+    # device-side ordering: d_year asc, sum desc, brand_id asc
+    keys = [(r["d_year"], -r["sum_agg"], r["i_brand_id"]) for r in got]
+    assert keys == sorted(keys)
+
+
+def test_q42_and_q52(tables, pdt):
+    ss, dt, it = pdt["store_sales"], pdt["date_dim"], pdt["item"]
+    base = (ss.merge(dt[(dt.d_moy == 11) & (dt.d_year == 2000)],
+                     left_on="ss_sold_date_sk", right_on="d_date_sk")
+            .merge(it, left_on="ss_item_sk", right_on="i_item_sk"))
+
+    got42 = _rows(tpcds.q42(tpcds._dfs(tables), year=2000))
+    exp42 = base.groupby(["d_year", "i_category_id", "i_category"]) \
+        .ss_ext_sales_price.sum()
+    _assert_groups_equal(got42, dict(exp42.items()),
+                         ("d_year", "i_category_id", "i_category"), "sum_agg")
+
+    got52 = _rows(tpcds.q52(tpcds._dfs(tables), year=2000))
+    exp52 = base.groupby(["d_year", "i_brand", "i_brand_id"]) \
+        .ss_ext_sales_price.sum()
+    if len(exp52) > 100:
+        exp_sorted = sorted(exp52.items(),
+                            key=lambda kv: (kv[0][0], -kv[1], kv[0][2]))[:100]
+        exp52 = dict(exp_sorted)
+        assert len(got52) == 100
+    _assert_groups_equal(got52, dict(exp52.items()),
+                         ("d_year", "i_brand", "i_brand_id"), "ext_price")
+
+
+def test_q55(tables, pdt):
+    manager_id = int(pdt["item"].i_manager_id.iloc[0])
+    got = _rows(tpcds.q55(tpcds._dfs(tables), manager_id=manager_id,
+                          year=1999))
+    ss, dt, it = pdt["store_sales"], pdt["date_dim"], pdt["item"]
+    j = (ss.merge(dt[(dt.d_moy == 11) & (dt.d_year == 1999)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(it[it.i_manager_id == manager_id], left_on="ss_item_sk",
+                right_on="i_item_sk"))
+    exp = j.groupby(["i_brand_id", "i_brand"]).ss_ext_sales_price.sum()
+    _assert_groups_equal(got, dict(exp.items()),
+                         ("i_brand_id", "i_brand"), "ext_price")
+
+
+def test_q7(tables, pdt):
+    got = _rows(tpcds.q7(tpcds._dfs(tables), year=2000))
+    ss = pdt["store_sales"]
+    cd = pdt["customer_demographics"]
+    cd = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+            & (cd.cd_education_status == "College")]
+    dt = pdt["date_dim"]
+    dt = dt[dt.d_year == 2000]
+    pr = pdt["promotion"]
+    pr = pr[(pr.p_channel_email == "N") | (pr.p_channel_event == "N")]
+    j = (ss.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+         .merge(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(pr, left_on="ss_promo_sk", right_on="p_promo_sk")
+         .merge(pdt["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    exp = j.groupby("i_item_id").agg(
+        agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+        agg3=("ss_coupon_amt", "mean"), agg4=("ss_sales_price", "mean"))
+    exp_items = sorted(exp.index)[:100]
+    assert [r["i_item_id"] for r in got] == exp_items
+    for r in got:
+        e = exp.loc[r["i_item_id"]]
+        for c in ("agg1", "agg2", "agg3", "agg4"):
+            assert r[c] == pytest.approx(e[c], rel=1e-9)
+
+
+def test_q96(tables, pdt):
+    store_name = pdt["store"].s_store_name.iloc[0]
+    d = tpcds._dfs(tables)
+    from spark_rapids_tpu.exprs.expr import (
+        And, Count, EqualTo, GreaterThanOrEqual, col, lit,
+    )
+
+    ss = d["store_sales"]
+    td = d["time_dim"].filter(
+        And(EqualTo(col("t_hour"), lit(20)),
+            GreaterThanOrEqual(col("t_minute"), lit(30))))
+    hd = d["household_demographics"].filter(
+        EqualTo(col("hd_dep_count"), lit(7)))
+    st = d["store"].filter(EqualTo(col("s_store_name"), lit(store_name)))
+    j = (ss.join(td, left_on="ss_sold_time_sk", right_on="t_time_sk")
+         .join(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+         .join(st, left_on="ss_store_sk", right_on="s_store_sk"))
+    got = j.agg(Count().alias("cnt")).collect()
+
+    ss_, td_, hd_, st_ = (pdt["store_sales"], pdt["time_dim"],
+                          pdt["household_demographics"], pdt["store"])
+    jj = (ss_.merge(td_[(td_.t_hour == 20) & (td_.t_minute >= 30)],
+                    left_on="ss_sold_time_sk", right_on="t_time_sk")
+          .merge(hd_[hd_.hd_dep_count == 7], left_on="ss_hdemo_sk",
+                 right_on="hd_demo_sk")
+          .merge(st_[st_.s_store_name == store_name], left_on="ss_store_sk",
+                 right_on="s_store_sk"))
+    assert got[0]["cnt"] == len(jj)
