@@ -27,14 +27,20 @@ _jax.config.update("jax_enable_x64", True)
 # instance, and bench/driver runs are separate processes — without this every
 # identical pipeline pays full compile (~20-40s/kernel through the TPU
 # tunnel); with it, recompiles of the same HLO load from disk in <1s.
-# Override the location with SRTPU_XLA_CACHE_DIR; empty string disables.
-_cache_dir = _os.environ.get("SRTPU_XLA_CACHE_DIR",
-                             _os.path.join(_os.path.expanduser("~"),
-                                           ".cache", "srtpu_xla"))
-if _cache_dir:
-    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# Respects an existing configuration: only set when neither the embedding
+# application nor the JAX env var configured a cache dir. Override the
+# location with SRTPU_XLA_CACHE_DIR; empty string disables.
+if (_jax.config.jax_compilation_cache_dir is None
+        and not _os.environ.get("JAX_COMPILATION_CACHE_DIR")):
+    _cache_dir = _os.environ.get("SRTPU_XLA_CACHE_DIR",
+                                 _os.path.join(_os.path.expanduser("~"),
+                                               ".cache", "srtpu_xla"))
+    if _cache_dir:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        # cache every kernel: the tunnel makes even trivial compiles ~20s,
+        # and the operator working set is bounded (per capacity bucket)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 __version__ = "0.1.0"
 

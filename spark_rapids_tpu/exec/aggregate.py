@@ -141,6 +141,13 @@ class HashAggregateExec(UnaryExec):
                 self._specs.append(_lower_agg(func, name, idx))
         self._pre_bound = tuple(pre_exprs)
         self._n_keys = n_keys
+        # hash-once aggregation: string group keys are hashed exactly once
+        # (in the first pass); the 128-bit pair rides along as two LONG
+        # buffer columns so merge passes regroup on ints, never re-hashing
+        # or re-comparing bytes
+        self._hash_carry = any(
+            _strip_alias(e)[0].dtype in (T.STRING, T.BINARY)
+            for e in self._group_bound)
         self._prepared = True
 
         jit = jax.jit
@@ -169,6 +176,9 @@ class HashAggregateExec(UnaryExec):
         for e in self._group_bound:
             inner, name = _strip_alias(e)
             fields.append(T.Field(name, inner.dtype, inner.nullable))
+        if self._hash_carry:
+            fields.append(T.Field("#gh1", T.LONG, False))
+            fields.append(T.Field("#gh2", T.LONG, False))
         for s in self._specs:
             for bi, bt in enumerate(s.buffer_types):
                 fields.append(T.Field(f"{s.name}#b{bi}", bt, True))
@@ -221,21 +231,38 @@ class HashAggregateExec(UnaryExec):
                 T.BOOLEAN, jnp.zeros(batch.capacity, jnp.bool_),
                 jnp.zeros(batch.capacity, jnp.bool_)))
         pre = ColumnarBatch(pre_cols, batch.num_rows)
+        if self._hash_carry:
+            key_cols = list(range(self._n_keys))
+            h1 = K.hash_keys(pre, key_cols)
+            h2 = K.hash_keys(pre, key_cols, variant=1)
+            gi = K.group_rows_prehashed(h1, h2, pre.active_mask())
+            return self._aggregate_grouped(pre, gi,
+                                           [s.ops for s in self._specs],
+                                           hashes=(h1, h2))
         gi = self._grouping(pre)
         return self._aggregate_grouped(pre, gi, [s.ops for s in self._specs])
 
     def _merge_pass(self, buffers: ColumnarBatch) -> ColumnarBatch:
         """re-group partial buffers and combine with merge ops."""
         merge_ops = [[_MERGE_OP[op] for op in s.ops] for s in self._specs]
+        if self._hash_carry:
+            h1 = buffers.columns[self._n_keys].data.astype(jnp.uint64)
+            h2 = buffers.columns[self._n_keys + 1].data.astype(jnp.uint64)
+            gi = K.group_rows_prehashed(h1, h2, buffers.active_mask())
+            return self._aggregate_grouped(buffers, gi, merge_ops,
+                                           buffers_input=True,
+                                           hashes=(h1, h2))
         gi = self._grouping(buffers)
         return self._aggregate_grouped(buffers, gi, merge_ops, buffers_input=True)
 
     def _aggregate_grouped(self, pre: ColumnarBatch, gi: K.GroupInfo,
-                           ops_per_spec, buffers_input: bool = False
-                           ) -> ColumnarBatch:
+                           ops_per_spec, buffers_input: bool = False,
+                           hashes=None) -> ColumnarBatch:
         cap = pre.capacity
         active = pre.active_mask()
         contributing = active[gi.perm]
+        # sorted-segment layout: scan-based reducers instead of scatters
+        seg_ends = K.segment_ends(gi.group_starts, gi.num_groups, cap)
         out_row_valid = jnp.arange(cap, dtype=jnp.int32) < gi.num_groups
         # keys: value at each group head (head -> original row via perm)
         head_rows = jnp.where(out_row_valid, gi.perm[jnp.clip(gi.group_starts, 0, cap - 1)], 0)
@@ -244,7 +271,13 @@ class HashAggregateExec(UnaryExec):
             out_cols.append(
                 K.gather_column(pre.columns[kc], head_rows, out_row_valid)
             )
-        buf_idx = self._n_keys
+        if hashes is not None:
+            for h in hashes:
+                hv = h.astype(jnp.int64)[head_rows]
+                out_cols.append(DeviceColumn(
+                    T.LONG, jnp.where(out_row_valid, hv, 0), out_row_valid))
+        buf_idx = self._n_keys + (2 if buffers_input and hashes is not None
+                                  else 0)
         for s, ops in zip(self._specs, ops_per_spec):
             for bi, (op, bt) in enumerate(zip(ops, s.buffer_types)):
                 if buffers_input:
@@ -269,7 +302,8 @@ class HashAggregateExec(UnaryExec):
                     )
                     continue
                 data, avalid = K.segment_agg(vals, valid, contributing, gi.segment_ids,
-                                             cap, op)
+                                             cap, op, ends=seg_ends,
+                                             starts=gi.group_starts)
                 np_t = T.numpy_dtype(bt)
                 data = data.astype(np_t)
                 out_cols.append(DeviceColumn(bt, jnp.where(out_row_valid & avalid, data,
@@ -314,7 +348,7 @@ class HashAggregateExec(UnaryExec):
         """buffers -> final values (Average division etc.)."""
         cap = buffers.capacity
         out_cols: List[DeviceColumn] = list(buffers.columns[: self._n_keys])
-        bi = self._n_keys
+        bi = self._n_keys + (2 if self._hash_carry else 0)  # skip #gh1/#gh2
         for s in self._specs:
             bufs = buffers.columns[bi: bi + len(s.ops)]
             bi += len(s.ops)

@@ -41,8 +41,15 @@ from spark_rapids_tpu.columnar.column import DeviceColumn
 
 
 def _string_row_ids(offsets: jax.Array, nbytes: int) -> jax.Array:
-    pos = jnp.arange(nbytes, dtype=jnp.int32)
-    return jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    """Row id owning each byte position: the last row whose start <= pos.
+
+    Scatter-count + cumsum instead of a per-byte binary search — one
+    bandwidth pass over the byte space beats nbytes*log(cap) gathers on
+    TPU (searchsorted lowers to serialized dependent gathers)."""
+    starts = jnp.clip(offsets[:-1], 0, nbytes)
+    marks = jnp.zeros(nbytes + 1, jnp.int32).at[starts].add(
+        1, mode="drop")
+    return jnp.cumsum(marks[:nbytes]) - 1
 
 
 def gather_column(
@@ -210,6 +217,41 @@ def sortable_keys(
     return data_keys + [null_key]
 
 
+def lexsort_chain(keys: Sequence[jax.Array]) -> jax.Array:
+    """Stable lexicographic argsort as a chain of single-key stable sorts
+    (LSD radix composition): sort by the least-significant key first, then
+    re-sort by each more-significant key; stability preserves prior order
+    within ties. Semantics match ``jnp.lexsort(keys)`` (last key primary).
+
+    Why not one variadic sort: TPU XLA sort compile time grows superlinearly
+    with operand count (~12s/23s/64s/128s for 2/3/5/7 operands), while each
+    chained pass is a fixed ~12s 2-operand sort — n keys compile in O(n).
+    Runtime does n passes over the data, but these sorts are
+    compile-dominated in practice and the passes are bandwidth-cheap.
+    """
+    assert keys, "lexsort_chain needs at least one key"
+
+    def passes(k: jax.Array) -> List[jax.Array]:
+        # 64-bit integer sorts are word-pair-emulated on the VPU (~18x the
+        # cost of native u32): split into (lo32, hi32) chained passes, which
+        # is the same total order under the stable chain
+        if k.dtype == jnp.int64:
+            k = k.astype(jnp.uint64) ^ jnp.uint64(_SIGN64)
+        if k.dtype == jnp.uint64:
+            lo = (k & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+            hi = (k >> jnp.uint64(32)).astype(jnp.uint32)
+            return [lo, hi]
+        return [k]
+
+    flat: List[jax.Array] = []
+    for k in keys:
+        flat.extend(passes(k))
+    perm = jnp.argsort(flat[0], stable=True)
+    for k in flat[1:]:
+        perm = perm[jnp.argsort(k[perm], stable=True)]
+    return perm
+
+
 class SortSpec(NamedTuple):
     column: int
     ascending: bool = True
@@ -230,8 +272,8 @@ def sort_indices(
     for spec in reversed(list(specs)):
         keys.extend(sortable_keys(batch.columns[spec.column], spec.ascending,
                                   spec.nulls_first))
-    keys.append(jnp.where(active, jnp.uint64(0), jnp.uint64(1)))  # padding last
-    return jnp.lexsort(tuple(keys)).astype(jnp.int32)
+    keys.append(jnp.where(active, jnp.uint32(0), jnp.uint32(1)))  # padding last
+    return lexsort_chain(keys).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -246,7 +288,15 @@ def _splitmix64(x: jax.Array) -> jax.Array:
     return x ^ (x >> jnp.uint64(31))
 
 
-def _string_hash(col: DeviceColumn) -> jax.Array:
+# per-variant constants: variant 1 is an INDEPENDENT second hash of the raw
+# bytes (not derived from variant 0), so the pair behaves as a 128-bit id
+_STR_P = (0x100000001B3, 0x9E3779B97F4A7C15)  # FNV prime / odd golden ratio
+_LEN_MIX = (0x9E3779B97F4A7C15, 0xC2B2AE3D27D4EB4F)
+_INT_SALT = (0, 0xA5A5A5A5A5A5A5A5)
+_COMBINE_MULT = (31, 0x100000001B3)
+
+
+def _string_hash(col: DeviceColumn, variant: int = 0) -> jax.Array:
     """Order-dependent polynomial hash of each row's bytes (mod 2^64).
 
     hash(row) = sum_k byte[k] * P^(len-1-rel_k); computed as a segment sum of
@@ -260,7 +310,7 @@ def _string_hash(col: DeviceColumn) -> jax.Array:
     rows = _string_row_ids(col.offsets, nbytes)
     rows_c = jnp.clip(rows, 0, cap - 1)
     rel = jnp.arange(nbytes, dtype=jnp.int32) - col.offsets[rows_c]
-    P = jnp.uint64(0x100000001B3)  # FNV prime
+    P = jnp.uint64(_STR_P[variant])
     powers = _pow_table(P, nbytes)
     contrib = (col.data.astype(jnp.uint64) + jnp.uint64(1)) * powers[
         jnp.clip(rel, 0, nbytes - 1)
@@ -270,7 +320,7 @@ def _string_hash(col: DeviceColumn) -> jax.Array:
     h = jax.ops.segment_sum(contrib, rows_c, num_segments=cap,
                             indices_are_sorted=True)
     lens = (col.offsets[1:] - col.offsets[:-1]).astype(jnp.uint64)
-    return _splitmix64(h ^ (lens * jnp.uint64(0x9E3779B97F4A7C15)))
+    return _splitmix64(h ^ (lens * jnp.uint64(_LEN_MIX[variant])))
 
 
 def _pow_table(p: jax.Array, n: int) -> jax.Array:
@@ -283,22 +333,26 @@ def _pow_table(p: jax.Array, n: int) -> jax.Array:
     return vals[:n]
 
 
-def hash_keys(batch: ColumnarBatch, key_cols: Sequence[int]) -> jax.Array:
+def hash_keys(batch: ColumnarBatch, key_cols: Sequence[int],
+              variant: int = 0) -> jax.Array:
     """64-bit combined hash of the key columns per row. Used for grouping and
     join candidate generation; exactness comes from the verification pass
-    (`keys_equal`), not from this hash."""
+    (`keys_equal`), not from this hash. ``variant=1`` computes an independent
+    second hash of the same raw bytes (grouping sorts by the pair as a
+    128-bit key)."""
+    salt = jnp.uint64(_INT_SALT[variant])
     h = jnp.zeros(batch.capacity, jnp.uint64)
     for i in key_cols:
         col = batch.columns[i]
         if col.offsets is not None:
-            ch = _string_hash(col)
+            ch = _string_hash(col, variant)
         elif col.dtype in T.FRACTIONAL_TYPES:
             # hash the canonical value words so NaN==NaN, -0.0==0.0
-            ch = _splitmix64(_float_hash_key(col.data))
+            ch = _splitmix64(_float_hash_key(col.data) ^ salt)
         else:
-            ch = _splitmix64(_int_sortable(col.data))
+            ch = _splitmix64(_int_sortable(col.data) ^ salt)
         ch = jnp.where(col.validity, ch, jnp.uint64(0xDEADBEEFCAFEBABE))
-        h = _splitmix64(h * jnp.uint64(31) + ch)
+        h = _splitmix64(h * jnp.uint64(_COMBINE_MULT[variant]) + ch)
     return h
 
 
@@ -390,22 +444,55 @@ class GroupInfo(NamedTuple):
 def group_rows(batch: ColumnarBatch, key_cols: Sequence[int]) -> GroupInfo:
     """Cluster live rows by key equality.
 
-    TPU-first replacement for cudf hash-groupby: sort by (hash, prefixes) then
-    split segments wherever the *exact* keys differ between neighbors — so
-    hash collisions create adjacent-but-separate groups, never merged ones.
+    TPU-first replacement for cudf hash-groupby: sort by hash then split
+    segments wherever the *exact* keys differ between neighbors — so hash
+    collisions create adjacent-but-separate groups, never merged ones.
+
+    Sort-key budget: TPU XLA sort compile time grows superlinearly with the
+    operand count (measured ~23s/64s/128s for 2/4/6 u64 operands at 2^19 on
+    v5e), so clustering NEVER sorts by per-key prefix operands.
+
+    Exactness bar: non-string keys get exact neighbor verification
+    (keys_equal), so a 64-bit hash collision only ever SPLITS a group.
+    String keys group on an independent 128-bit hash pair with NO byte
+    verification — two distinct keys colliding on both words (p ~ 2^-86
+    over 2^21 rows) WOULD merge; this is the same treat-as-exact bar as
+    _string_eq_at and the documented engine-wide string-equality contract.
     """
     cap = batch.capacity
     active = batch.active_mask()
+    if any(batch.columns[i].offsets is not None for i in key_cols):
+        # string keys: group on an independent 128-bit hash pair and never
+        # touch the byte data — neighbor equality on bytes would re-gather
+        # 16-byte prefixes per row, and the hash pair is already the
+        # engine-exactness bar used by _string_eq_at
+        h1 = hash_keys(batch, key_cols)
+        h2 = hash_keys(batch, key_cols, variant=1)
+        return group_rows_prehashed(h1, h2, active)
     h = hash_keys(batch, key_cols)
     keys: List[jax.Array] = [h]
-    for i in key_cols:
-        col = batch.columns[i]
-        if col.offsets is not None:
-            keys.extend(string_prefix_keys(col))
-    keys.append(jnp.where(active, jnp.uint64(0), jnp.uint64(1)))
-    perm = jnp.lexsort(tuple(keys)).astype(jnp.int32)
+    keys.append(jnp.where(active, jnp.uint32(0), jnp.uint32(1)))
+    perm = lexsort_chain(keys).astype(jnp.int32)
     prev = jnp.concatenate([perm[:1], perm[:-1]])
     neq = ~keys_equal(batch, perm, key_cols, batch, prev, key_cols)
+    return _group_from_boundaries(perm, neq, active, cap)
+
+
+def group_rows_prehashed(h1: jax.Array, h2: jax.Array,
+                         active: jax.Array) -> GroupInfo:
+    """Cluster rows whose 128-bit (h1, h2) hash pair matches. Used for
+    string group keys and for merge passes that carry the pair as columns
+    (hash-once aggregation: bytes are hashed exactly once per query)."""
+    cap = h1.shape[0]
+    keys = [h2, h1, jnp.where(active, jnp.uint32(0), jnp.uint32(1))]
+    perm = lexsort_chain(keys).astype(jnp.int32)
+    prev = jnp.concatenate([perm[:1], perm[:-1]])
+    neq = (h1[perm] != h1[prev]) | (h2[perm] != h2[prev])
+    return _group_from_boundaries(perm, neq, active, cap)
+
+
+def _group_from_boundaries(perm: jax.Array, neq: jax.Array,
+                           active: jax.Array, cap: int) -> GroupInfo:
     idx = jnp.arange(cap, dtype=jnp.int32)
     perm_active = active[perm]
     boundary = perm_active & ((idx == 0) | neq)
@@ -419,6 +506,65 @@ def group_rows(batch: ColumnarBatch, key_cols: Sequence[int]) -> GroupInfo:
     return GroupInfo(perm, seg, num_groups, group_starts)
 
 
+def segment_ends(group_starts: jax.Array, num_groups: jax.Array,
+                 cap: int) -> jax.Array:
+    """Per-segment last-row index (permuted order) for SORTED segment ids.
+
+    Derived from GroupInfo.group_starts: segment s ends where s+1 starts;
+    the last real segment absorbs the trailing padding rows (they carry
+    identity values), so it ends at cap-1."""
+    nxt = jnp.concatenate([group_starts[1:],
+                           jnp.full((1,), cap, group_starts.dtype)])
+    sidx = jnp.arange(cap, dtype=jnp.int32)
+    ends = jnp.where(sidx >= num_groups - 1, cap - 1, nxt - 1)
+    return jnp.clip(ends, 0, cap - 1)
+
+
+def _sorted_segment_reducers(seg: jax.Array, starts: jax.Array,
+                             ends: jax.Array):
+    """(sum, min, max) reducers over SORTED segment ids. Runs at HBM
+    bandwidth where TPU scatters (jax.ops.segment_*) serialize.
+
+    integer sum/count: one native cumsum + boundary gathers (seg total =
+    cs[end] - cs[start] + v[start]) — exact (int adds commute with the
+    subtraction, wraparound included).
+    float sum: scatter segment_sum — the cumsum trick is NOT float-safe:
+    small groups downstream of a large-magnitude group lose their values to
+    prefix absorption (cs accumulates 1e17, later 0.456 adds vanish into
+    its ulp), a cross-group contamination plain per-segment summation never
+    has. The scatter costs ~90ms at 2^20 but is exact per segment.
+    min/max: segmented inclusive associative scan carrying (started, acc)
+    with reset at boundaries — one scan each, used sparingly (sum/count
+    dominate real workloads) because a scan's unrolled HLO is much bigger
+    than a cumsum's."""
+    n = seg.shape[0]
+    boundary = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), seg[1:] != seg[:-1]])
+    starts_c = jnp.clip(starts, 0, n - 1)
+    ends_c = jnp.clip(ends, 0, n - 1)
+
+    def seg_sum(v: jax.Array) -> jax.Array:
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            return jax.ops.segment_sum(v, seg, num_segments=n,
+                                       indices_are_sorted=True)
+        cs = jnp.cumsum(v)
+        return cs[ends_c] - cs[starts_c] + v[starts_c]
+
+    def make(op_fn):
+        def reduce(v: jax.Array) -> jax.Array:
+            def combine(a, b):
+                af, av = a
+                bf, bv = b
+                return af | bf, jnp.where(bf, bv, op_fn(av, bv))
+
+            _, scanned = jax.lax.associative_scan(combine, (boundary, v))
+            return scanned[ends_c]
+
+        return reduce
+
+    return (seg_sum, make(jnp.minimum), make(jnp.maximum))
+
+
 def segment_agg(
     values: jax.Array,
     validity: jax.Array,
@@ -426,28 +572,46 @@ def segment_agg(
     seg: jax.Array,
     num_segments: int,
     op: str,
+    ends: Optional[jax.Array] = None,
+    starts: Optional[jax.Array] = None,
 ):
     """One segmented aggregation. ``contributing`` masks rows that count.
 
     Returns (agg_values, agg_validity). op in sum/count/min/max/first/last/
-    count_all/sum_sq (sum of squares, for variance)."""
+    count_all/sum_sq (sum of squares, for variance).
+
+    ``starts``/``ends`` (per-segment first/last row index; GroupInfo
+    group_starts and ``segment_ends``) assert the ids are SORTED and switch
+    the reducers from scatter-based ``jax.ops.segment_*`` to cumsum/scan +
+    boundary gathers. TPU scatters serialize (~90ms per op at 2^20 on v5e)
+    while cumsums run at bandwidth — the grouped-aggregation hot path
+    always passes them."""
     live = contributing & validity
+    if ends is not None:
+        assert starts is not None
+        seg_sum, seg_min, seg_max = _sorted_segment_reducers(
+            seg, starts, ends)
+        def any_valid_of(flags):
+            return seg_sum(flags.astype(jnp.int32)) > 0
+    else:
+        def any_valid_of(flags):
+            return jax.ops.segment_max(flags.astype(jnp.int32), seg,
+                                       num_segments=num_segments) > 0
+        def seg_sum(v):
+            return jax.ops.segment_sum(v, seg, num_segments=num_segments)
+
+        def seg_min(v):
+            return jax.ops.segment_min(v, seg, num_segments=num_segments)
+
+        def seg_max(v):
+            return jax.ops.segment_max(v, seg, num_segments=num_segments)
     if op == "count_all":
-        data = jax.ops.segment_sum(
-            contributing.astype(jnp.int64), seg, num_segments=num_segments
-        )
+        data = seg_sum(contributing.astype(jnp.int64))
         return data, jnp.ones_like(data, jnp.bool_)
     if op == "count":
-        data = jax.ops.segment_sum(
-            live.astype(jnp.int64), seg, num_segments=num_segments
-        )
+        data = seg_sum(live.astype(jnp.int64))
         return data, jnp.ones_like(data, jnp.bool_)
-    any_valid = (
-        jax.ops.segment_max(
-            live.astype(jnp.int32), seg, num_segments=num_segments
-        )
-        > 0
-    )
+    any_valid = any_valid_of(live)
     if op in ("sum", "sum_sq"):
         v = values.astype(
             jnp.float64 if jnp.issubdtype(values.dtype, jnp.floating) else jnp.int64
@@ -455,7 +619,7 @@ def segment_agg(
         if op == "sum_sq":
             v = v * v
         v = jnp.where(live, v, jnp.zeros_like(v))
-        return jax.ops.segment_sum(v, seg, num_segments=num_segments), any_valid
+        return seg_sum(v), any_valid
     if op in ("min", "max"):
         if jnp.issubdtype(values.dtype, jnp.floating):
             # NaN-aware on VALUES (Spark: NaN greater than everything): clean
@@ -464,15 +628,9 @@ def segment_agg(
             live_clean = live & ~is_nan
             ident = jnp.float64(-np.inf if op == "max" else np.inf)
             v = jnp.where(live_clean, d, ident)
-            red = (jax.ops.segment_max if op == "max" else jax.ops.segment_min)(
-                v, seg, num_segments=num_segments
-            )
-            nan_any = jax.ops.segment_max(
-                (live & is_nan).astype(jnp.int32), seg,
-                num_segments=num_segments) > 0
-            clean_any = jax.ops.segment_max(
-                live_clean.astype(jnp.int32), seg,
-                num_segments=num_segments) > 0
+            red = (seg_max if op == "max" else seg_min)(v)
+            nan_any = any_valid_of(live & is_nan)
+            clean_any = any_valid_of(live_clean)
             if op == "max":
                 dec = jnp.where(nan_any, jnp.float64(np.nan), red)
             else:
@@ -485,18 +643,14 @@ def segment_agg(
             v = values
         ident = ii.min if op == "max" else ii.max
         v = jnp.where(live, v, jnp.full_like(v, ident))
-        red = (jax.ops.segment_max if op == "max" else jax.ops.segment_min)(
-            v, seg, num_segments=num_segments
-        )
+        red = (seg_max if op == "max" else seg_min)(v)
         if values.dtype == jnp.bool_:
             red = red.astype(jnp.bool_)
         return red, any_valid
     if op in ("first", "last"):
         idx = jnp.arange(values.shape[0], dtype=jnp.int32)
         pick = jnp.where(live, idx, values.shape[0] if op == "first" else -1)
-        sel = (jax.ops.segment_min if op == "first" else jax.ops.segment_max)(
-            pick, seg, num_segments=num_segments
-        )
+        sel = (seg_min if op == "first" else seg_max)(pick)
         sel_c = jnp.clip(sel, 0, values.shape[0] - 1)
         return values[sel_c], any_valid
     raise NotImplementedError(op)

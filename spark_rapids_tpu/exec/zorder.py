@@ -28,7 +28,7 @@ def _normalize_u32(col, capacity: int) -> jax.Array:
     # floats carry [value, nan_flag, null_key], strings [lo, hi, null_key] —
     # a single key would drop the value for floats / half the prefix for
     # strings
-    order = jnp.lexsort(tuple(keys))
+    order = K.lexsort_chain(keys)
     ranks = jnp.zeros(capacity, jnp.uint32)
     ranks = ranks.at[order].set(jnp.arange(capacity, dtype=jnp.uint32))
     shift = 32 - max((capacity - 1).bit_length(), 1)

@@ -45,8 +45,11 @@ SVal = StringVal
 
 
 def row_ids(offsets: jax.Array, nbytes: int) -> jax.Array:
-    pos = jnp.arange(nbytes, dtype=jnp.int32)
-    return jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32) - 1
+    # single shared implementation (scatter-count + cumsum; see the kernels
+    # docstring for why not searchsorted on TPU)
+    from spark_rapids_tpu.exec.kernels import _string_row_ids
+
+    return _string_row_ids(offsets, nbytes)
 
 
 def make_offsets(out_len: jax.Array) -> jax.Array:

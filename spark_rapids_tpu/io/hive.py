@@ -42,7 +42,7 @@ def discover_partitions(table_root: str) -> List[str]:
     """All data files under the table root (sorted for determinism)."""
     files = []
     for dirpath, _, names in os.walk(table_root):
-        for n in sorted(names):
+        for n in names:
             if not n.startswith((".", "_")):
                 files.append(os.path.join(dirpath, n))
     return sorted(files)

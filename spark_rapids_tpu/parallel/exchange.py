@@ -96,11 +96,13 @@ def _local_partial_agg(batch: ColumnarBatch, n_keys: int,
         K.gather_column(batch.columns[i], head_rows, out_valid)
         for i in range(n_keys)
     ]
+    seg_ends = K.segment_ends(gi.group_starts, gi.num_groups, cap)
     for col_i, op in ops:
         assert op in _SEG_OPS, op
         src = batch.columns[col_i]
         data, avalid = K.segment_agg(src.data[gi.perm], src.validity[gi.perm],
-                                     contributing, gi.segment_ids, cap, op)
+                                     contributing, gi.segment_ids, cap, op,
+                                     ends=seg_ends, starts=gi.group_starts)
         out_cols.append(DeviceColumn(
             T.LONG if op in ("count", "count_all") else src.dtype,
             jnp.where(out_valid & avalid, data, jnp.zeros_like(data)),

@@ -281,8 +281,13 @@ class Overrides:
             exchange: TpuExec = ShuffleExchangeExec(SinglePartitioner(),
                                                     partial)
         else:
+            partial._prepare()
+            # string keys carry a precomputed hash column (#gh1) in the
+            # buffer schema: partition on it instead of re-hashing bytes
+            part_cols = ([n_keys] if partial._hash_carry
+                         else list(range(n_keys)))
             exchange = ShuffleExchangeExec(
-                HashPartitioner(list(range(n_keys)), self.shuffle_partitions),
+                HashPartitioner(part_cols, self.shuffle_partitions),
                 partial)
             exchange = self._maybe_aqe_read(exchange)
         return HashAggregateExec.final_from_partial(partial, exchange)

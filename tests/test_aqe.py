@@ -90,7 +90,12 @@ def test_aqe_coalesced_agg_matches_non_aqe():
     walk(node)
     assert readers, "AQE reader not inserted for hash-partitioned aggregate"
     got = _sorted_rows(df.collect())
-    assert got == base
+    # float sums are order-dependent (Spark semantics too): AQE coalescing
+    # changes the merge layout, so compare with float tolerance
+    assert [r["k"] for r in got] == [r["k"] for r in base]
+    assert [r["n"] for r in got] == [r["n"] for r in base]
+    for g, b in zip(got, base):
+        assert g["sv"] == pytest.approx(b["sv"], rel=1e-12)
     specs = readers[0].specs()
     assert specs == [CoalescedPartitionSpec(0, 4)]
 

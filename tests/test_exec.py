@@ -462,3 +462,29 @@ def test_parquet_scan_pruning(tmp_path):
     # pruning keeps only the last row group; filter itself happens later
     assert node.metrics["numPrunedRowGroups"].value == 9
     assert [r["a"] for r in got] == list(range(900, 1000))
+
+
+def test_grouped_float_sum_mixed_magnitudes():
+    # regression: cumsum-based segmented sum absorbed small groups' values
+    # into a large-magnitude group's running prefix (cross-group
+    # contamination); float sums must be exact per segment
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+    from spark_rapids_tpu.exec import BatchSourceExec, HashAggregateExec
+    from spark_rapids_tpu.exprs.expr import Sum, col
+    from spark_rapids_tpu import types as T
+
+    t = pa.table({
+        "k": pa.array([0, 1, 1, 1], pa.int64()),
+        "v": pa.array([1e17, 0.123, 0.456, 0.789], pa.float64()),
+    })
+    src = BatchSourceExec([[batch_from_arrow(t, 16)]],
+                          T.Schema.from_arrow(t.schema))
+    node = HashAggregateExec([col("k")], [Sum(col("v")).alias("s")], src)
+    rows = sorted(
+        (r for b in node.execute_all()
+         for r in batch_to_arrow(b, node.output_schema).to_pylist()),
+        key=lambda r: r["k"])
+    assert rows[0]["s"] == 1e17
+    assert rows[1]["s"] == pytest.approx(1.368, rel=1e-12)
