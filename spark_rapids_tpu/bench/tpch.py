@@ -39,6 +39,13 @@ def _date_i(y, m, d) -> int:
     return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
 
 
+def _notnull(t: pa.Table) -> pa.Table:
+    """TPC-H columns are NOT NULL; declare it so the engine can skip
+    null-tracking work (e.g. per-aggregate validity rows in the dense path)."""
+    schema = pa.schema([f.with_nullable(False) for f in t.schema])
+    return t.cast(schema)
+
+
 _EPOCH_1992 = _date_i(1992, 1, 1)
 _DAYS_7Y = _date_i(1998, 12, 31) - _EPOCH_1992
 
@@ -58,7 +65,7 @@ def gen_lineitem(sf: float, seed: int = 0) -> pa.Table:
     rf = rng.integers(0, 3, n)
     returnflag = np.array(["A", "N", "R"])[rf]
     linestatus = np.where(shipdate > _date_i(1995, 6, 17), "O", "F")
-    return pa.table({
+    return _notnull(pa.table({
         "l_orderkey": pa.array(orderkey, pa.int64()),
         "l_quantity": pa.array(qty, pa.float64()),
         "l_extendedprice": pa.array(price, pa.float64()),
@@ -70,21 +77,21 @@ def gen_lineitem(sf: float, seed: int = 0) -> pa.Table:
             pa.date32()),
         "l_suppkey": pa.array(rng.integers(1, max(int(10_000 * sf), 10) + 1, n),
                               pa.int64()),
-    })
+    }))
 
 
 def gen_orders(sf: float, seed: int = 1) -> pa.Table:
     n = int(1_500_000 * sf)
     rng = np.random.default_rng(seed)
     orderdate = _EPOCH_1992 + rng.integers(0, _DAYS_7Y - 150, n)
-    return pa.table({
+    return _notnull(pa.table({
         "o_orderkey": pa.array(np.arange(1, 4 * n + 1, 4), pa.int64()),
         "o_custkey": pa.array(rng.integers(1, max(int(150_000 * sf), 10) + 1, n),
                               pa.int64()),
         "o_orderdate": pa.array(orderdate.astype(np.int32), pa.int32()).cast(
             pa.date32()),
         "o_shippriority": pa.array(np.zeros(n, np.int32), pa.int32()),
-    })
+    }))
 
 
 def gen_customer(sf: float, seed: int = 2) -> pa.Table:
@@ -92,44 +99,48 @@ def gen_customer(sf: float, seed: int = 2) -> pa.Table:
     rng = np.random.default_rng(seed)
     segs = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY",
                      "HOUSEHOLD"])
-    return pa.table({
+    return _notnull(pa.table({
         "c_custkey": pa.array(np.arange(1, n + 1), pa.int64()),
         "c_mktsegment": pa.array(segs[rng.integers(0, 5, n)], pa.string()),
         "c_nationkey": pa.array(rng.integers(0, NATIONS, n), pa.int64()),
-    })
+    }))
 
 
 def gen_supplier(sf: float, seed: int = 3) -> pa.Table:
     n = max(int(10_000 * sf), 10)
     rng = np.random.default_rng(seed)
-    return pa.table({
+    return _notnull(pa.table({
         "s_suppkey": pa.array(np.arange(1, n + 1), pa.int64()),
         "s_nationkey": pa.array(rng.integers(0, NATIONS, n), pa.int64()),
-    })
+    }))
 
 
 def gen_nation(seed: int = 4) -> pa.Table:
     rng = np.random.default_rng(seed)
     names = [f"NATION_{i:02d}" for i in range(NATIONS)]
-    return pa.table({
+    return _notnull(pa.table({
         "n_nationkey": pa.array(np.arange(NATIONS), pa.int64()),
         "n_name": pa.array(names, pa.string()),
         "n_regionkey": pa.array(rng.integers(0, REGIONS, NATIONS), pa.int64()),
-    })
+    }))
 
 
 def gen_region() -> pa.Table:
     names = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
-    return pa.table({
+    return _notnull(pa.table({
         "r_regionkey": pa.array(np.arange(REGIONS), pa.int64()),
         "r_name": pa.array(names, pa.string()),
-    })
+    }))
 
 
 def _source(table: pa.Table, batch_rows: int = 1 << 20) -> BatchSourceExec:
-    schema = T.Schema.from_arrow(table.schema)
+    from spark_rapids_tpu.columnar.batch import dictionary_encode_table
+
+    schema = T.Schema.from_arrow(table.schema)  # logical schema (pre-encode)
+    table = dictionary_encode_table(table)
+    cache: dict = {}
     batches = [
-        batch_from_arrow(table.slice(i, batch_rows))
+        batch_from_arrow(table.slice(i, batch_rows), dict_cache=cache)
         for i in range(0, max(table.num_rows, 1), batch_rows)
     ]
     return BatchSourceExec([batches], schema)

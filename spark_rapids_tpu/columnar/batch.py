@@ -132,10 +132,145 @@ def _arrow_fixed_to_numpy(arr: pa.Array, dt: T.DataType):
     return values, valid
 
 
+def _sort_remap_dictionary(enc: pa.DictionaryArray) -> pa.DictionaryArray:
+    """Sort a DictionaryArray's dictionary bytewise and remap its codes.
+
+    Device kernels require code order == byte-lexicographic order; this is
+    the single implementation both the table-level encoder and the direct
+    ingest path use (a no-op when already sorted)."""
+    import pyarrow.compute as pc
+
+    dvals = enc.dictionary
+    order = pc.sort_indices(dvals)  # bytewise (UTF-8) ascending
+    rank = np.empty(len(dvals), np.int32)
+    rank[np.asarray(order)] = np.arange(len(dvals), dtype=np.int32)
+    codes = np.asarray(enc.indices.fill_null(0)).astype(np.int32)
+    new_codes = pa.array(rank[codes], pa.int32(),
+                         mask=~np.asarray(enc.is_valid()))
+    return pa.DictionaryArray.from_arrays(new_codes, dvals.take(order))
+
+
+def _dict_bytes_encodable(dvals, n_rows: int) -> bool:
+    """Worst-case decode (n_rows * longest entry) must fit int32 offsets."""
+    if len(dvals) == 0:
+        return False
+    lens = np.diff(np.frombuffer(dvals.buffers()[1], np.int32,
+                                 count=len(dvals) + 1,
+                                 offset=dvals.offset * 4))
+    dmax = int(lens.max()) if len(lens) else 0
+    return max(n_rows, 1024) * max(dmax, 1) < (1 << 31)
+
+
+def dictionary_encode_table(table: pa.Table, columns: Optional[Sequence[str]] = None,
+                            max_size: int = 1 << 16) -> pa.Table:
+    """Dictionary-encode eligible string/binary columns with a SORTED dict.
+
+    TPU-first ingest step: encoding happens once on the host; every device
+    batch sliced from the returned table shares one dictionary, so codes are
+    comparable across batches and code order == byte-lexicographic order
+    (the engine sorts/groups strings on int32 codes). Columns whose distinct
+    count exceeds ``max_size`` (or half the rows) stay plain.
+    """
+    out = table
+    for i, name in enumerate(table.column_names):
+        if columns is not None and name not in columns:
+            continue
+        col = table.column(i).combine_chunks()
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        if pa.types.is_dictionary(col.type):
+            if not (pa.types.is_string(col.type.value_type)
+                    or pa.types.is_binary(col.type.value_type)):
+                continue  # non-string dictionaries decode at batch build
+            enc = col  # re-sort a user-provided dictionary below
+        elif pa.types.is_string(col.type) or pa.types.is_binary(col.type):
+            enc = col.dictionary_encode()
+            if isinstance(enc, pa.ChunkedArray):
+                enc = enc.combine_chunks()
+        else:
+            continue
+        dvals = enc.dictionary.cast(
+            pa.string() if pa.types.is_string(enc.type.value_type)
+            else pa.binary())
+        if len(dvals) == 0:
+            continue  # all-null column: keep plain (no dictionary to sort)
+        if not _dict_bytes_encodable(dvals, len(col)):
+            continue
+        if not pa.types.is_dictionary(col.type) and (
+                len(dvals) > max_size or len(dvals) > max(16, len(col) // 2)):
+            continue
+        out = out.set_column(i, name, _sort_remap_dictionary(enc))
+    return out
+
+
+def _dict_col_from_arrow(arr: pa.DictionaryArray, dt: T.DataType, cap: int,
+                         n: int, dict_cache: Optional[dict]) -> DeviceColumn:
+    """Device dict column from an arrow DictionaryArray with a sorted dict.
+
+    ``dict_cache`` (optional, caller-held) maps the arrow dictionary's buffer
+    address to an uploaded device dictionary so batches sliced from one table
+    share one device dictionary (object identity is what concat/merge check).
+    """
+    dvals = arr.dictionary
+    dvals = dvals.cast(pa.string()) if dt == T.STRING else dvals.cast(pa.binary())
+    if len(dvals) == 0:
+        # all-null dictionary column: no dictionary to sort — plain layout
+        n_ = len(arr)
+        return make_string_column(np.zeros(0, np.uint8),
+                                  np.zeros(n_ + 1, np.int32),
+                                  np.zeros(n_, np.bool_), cap, 8, dt)
+    import pyarrow.compute as pc
+
+    order = np.asarray(pc.sort_indices(dvals))
+    if not np.array_equal(order, np.arange(len(dvals))):
+        # keep the original array identity when already sorted: the device
+        # dictionary cache below is keyed by the dict buffer address, and
+        # batches sliced from one table must share one device dictionary
+        arr = _sort_remap_dictionary(
+            pa.DictionaryArray.from_arrays(arr.indices, dvals))
+        dvals = arr.dictionary
+    key = dvals.buffers()[2].address if dvals.buffers()[2] is not None else 0
+    dict_col = dict_cache.get(key) if dict_cache is not None else None
+    if dict_col is None:
+        dsize = len(dvals)
+        raw_off = np.frombuffer(dvals.buffers()[1], np.int32,
+                                count=dsize + 1, offset=dvals.offset * 4)
+        offsets = (raw_off - raw_off[0]).astype(np.int32)
+        lens = np.diff(offsets)
+        dmax = int(lens.max()) if len(lens) else 0
+        dcap = bucket_capacity(max(dsize, 1), 16)
+        nbytes = int(offsets[-1])
+        buf = dvals.buffers()[2]
+        data = (np.frombuffer(buf, np.uint8, count=nbytes,
+                              offset=int(raw_off[0])).copy()
+                if buf is not None and nbytes else np.zeros(0, np.uint8))
+        plain = make_string_column(data, offsets, None, dcap,
+                                   bucket_capacity(max(nbytes, 8), 8), dt)
+        dict_col = (plain, dsize, dmax)
+        if dict_cache is not None:
+            dict_cache[key] = dict_col
+    plain, dsize, dmax = dict_col
+    valid = (None if arr.null_count == 0
+             else np.asarray(arr.is_valid(), dtype=np.bool_))
+    codes = np.zeros(cap, np.int32)
+    codes[:n] = np.asarray(arr.indices.fill_null(0)).astype(np.int32)
+    validity = np.zeros(cap, np.bool_)
+    validity[:n] = True if valid is None else valid
+    codes[~validity] = 0
+    return DeviceColumn(dt, jnp.asarray(codes), jnp.asarray(validity),
+                        None, plain, dsize, dmax)
+
+
 def batch_from_arrow(
-    table, min_bucket: int = 1024, capacity: Optional[int] = None
+    table, min_bucket: int = 1024, capacity: Optional[int] = None,
+    dict_cache: Optional[dict] = None,
 ) -> ColumnarBatch:
-    """Host Arrow table/record-batch -> padded device batch."""
+    """Host Arrow table/record-batch -> padded device batch.
+
+    Dictionary-typed columns (see ``dictionary_encode_table``) become
+    dict-encoded device columns; pass one ``dict_cache`` across calls so
+    slices of the same table share one device dictionary.
+    """
     if isinstance(table, pa.RecordBatch):
         table = pa.table(table)
     n = table.num_rows
@@ -144,6 +279,21 @@ def batch_from_arrow(
     for name in table.column_names:
         arr = table.column(name).combine_chunks()
         dt = T.from_arrow_type(arr.type)
+        if isinstance(arr.type, pa.DictionaryType):
+            vt = arr.type.value_type
+            is_str = pa.types.is_string(vt) or pa.types.is_binary(vt)
+            ok = is_str and (
+                len(arr.dictionary) == 0  # all-null: plain fallback inside
+                or _dict_bytes_encodable(
+                    arr.dictionary.cast(
+                        pa.string() if pa.types.is_string(vt)
+                        else pa.binary()), cap))
+            if ok:
+                cols.append(_dict_col_from_arrow(arr, dt, cap, n, dict_cache))
+                continue
+            # non-string dictionary values (or entries so long the decoded
+            # worst case would overflow int32 offsets): plain layout
+            arr = arr.cast(vt)
         if dt.fixed_width:
             values, valid = _arrow_fixed_to_numpy(arr, dt)
             cols.append(make_fixed_column(dt, values, valid, cap))
@@ -199,11 +349,30 @@ def batch_from_arrow(
 def batch_to_arrow(batch: ColumnarBatch, schema: T.Schema) -> pa.Table:
     """Device batch -> host Arrow table (slices away padding)."""
     n = batch.row_count()
+    # pull every device buffer in ONE batched transfer: per-array readbacks
+    # serialize at ~95ms each on the tunnel platform (utils/sync.py)
+    host = jax.device_get(batch.columns)
     arrays = []
-    for col, field in zip(batch.columns, schema):
+    for col, field in zip(host, schema):
         dt = field.dtype
         valid_np = np.asarray(col.validity)[:n]
         mask = None if valid_np.all() else ~valid_np
+        if col.is_dict:
+            codes = np.asarray(col.data)[:n].astype(np.int32)
+            d = col.dictionary
+            doff = np.asarray(d.offsets)[: col.dict_size + 1].astype(np.int32)
+            dbytes = np.asarray(d.data)[: int(doff[-1]) if col.dict_size else 0]
+            dvals = pa.Array.from_buffers(
+                pa.string() if dt == T.STRING else pa.binary(),
+                col.dict_size,
+                [None, pa.py_buffer(doff.tobytes()),
+                 pa.py_buffer(dbytes.tobytes())],
+            )
+            codes_arr = pa.array(codes, pa.int32(), mask=mask)
+            arr = pa.DictionaryArray.from_arrays(codes_arr, dvals).cast(
+                pa.string() if dt == T.STRING else pa.binary())
+            arrays.append(arr)
+            continue
         if dt.fixed_width:
             values = np.asarray(col.data)[:n]
             if isinstance(dt, T.DecimalType):

@@ -39,26 +39,51 @@ class ColVal(NamedTuple):
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class DeviceColumn:
-    """One column of a TPU-resident batch."""
+    """One column of a TPU-resident batch.
+
+    Dictionary encoding (TPU-first string design): a string/binary column may
+    instead store int32 *codes* in ``data`` plus a ``dictionary`` column
+    holding the distinct values (a plain string DeviceColumn, sorted
+    lexicographically at ingest so code order == byte order). Group-by, sort
+    and equality then run entirely on int32 codes — no byte-space kernels —
+    and the dense-id aggregation path maps codes straight onto the MXU.
+    Operators that need raw bytes decode via ``kernels.decode_dictionary``
+    (codes crossing engines/dicts must be decoded first; see ensure_plain).
+    ``dict_size``/``dict_max_len`` are static so jit can specialize.
+    """
 
     dtype: T.DataType
     data: jax.Array
     validity: jax.Array
-    offsets: Optional[jax.Array] = None  # only for string/binary
+    offsets: Optional[jax.Array] = None  # only for plain string/binary
+    dictionary: Optional["DeviceColumn"] = None  # only for dict-encoded
+    dict_size: int = 0  # static: live entries in dictionary
+    dict_max_len: int = 0  # static: longest dictionary entry in bytes
 
     def tree_flatten(self):
-        if self.offsets is None:
-            return (self.data, self.validity), (self.dtype, False)
-        return (self.data, self.validity, self.offsets), (self.dtype, True)
+        aux = (self.dtype, self.offsets is not None,
+               self.dictionary is not None, self.dict_size, self.dict_max_len)
+        children = [self.data, self.validity]
+        if self.offsets is not None:
+            children.append(self.offsets)
+        if self.dictionary is not None:
+            children.append(self.dictionary)
+        return tuple(children), aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_offsets = aux
-        if has_offsets:
-            data, validity, offsets = children
-            return cls(dtype, data, validity, offsets)
-        data, validity = children
-        return cls(dtype, data, validity, None)
+        dtype, has_offsets, has_dict, dict_size, dict_max_len = aux
+        it = iter(children)
+        data = next(it)
+        validity = next(it)
+        offsets = next(it) if has_offsets else None
+        dictionary = next(it) if has_dict else None
+        return cls(dtype, data, validity, offsets, dictionary, dict_size,
+                   dict_max_len)
+
+    @property
+    def is_dict(self) -> bool:
+        return self.dictionary is not None
 
     @property
     def capacity(self) -> int:
@@ -76,6 +101,8 @@ class DeviceColumn:
         n += self.validity.size  # bool = 1 byte on device accounting
         if self.offsets is not None:
             n += self.offsets.size * 4
+        if self.dictionary is not None:
+            n += self.dictionary.nbytes()
         return n
 
     def as_colval(self) -> ColVal:

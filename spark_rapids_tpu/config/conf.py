@@ -156,6 +156,15 @@ METRICS_LEVEL = conf(
     doc="Operator metrics verbosity: ESSENTIAL, MODERATE, DEBUG "
         "(reference: GpuExec.scala:41 metrics levels).")
 
+METRICS_SYNC = conf(
+    "spark.rapids.tpu.sql.metrics.sync", default=False,
+    doc="Fence device execution at every operator batch boundary so opTime "
+        "metrics measure real execution instead of async dispatch. Adds one "
+        "tiny device->host readback per batch per operator; enable for "
+        "profiling, not throughput runs. (The real-TPU platform's "
+        "block_until_ready returns at dispatch; only a dependent host "
+        "readback drains compute — utils/sync.py.)")
+
 ANSI_ENABLED = conf(
     "spark.rapids.tpu.sql.ansi.enabled", default=False,
     doc="ANSI SQL mode: overflow and invalid casts raise instead of "

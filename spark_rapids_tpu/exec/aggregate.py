@@ -100,8 +100,19 @@ class HashAggregateExec(UnaryExec):
     def __init__(self, group_exprs: Sequence[E.Expression],
                  agg_exprs: Sequence[E.Expression], child: TpuExec,
                  mode: str = "complete"):
-        super().__init__(child)
         assert mode in ("complete", "partial", "final")
+        # Filter fusion: a FilterExec feeding an aggregation becomes the
+        # aggregation's contributing mask — no compaction, no gather of the
+        # payload columns, no row movement at all. (The reference reaches a
+        # similar shape by fusing filter iterators into the agg input;
+        # on TPU skipping the gather is the single biggest win.)
+        self.pre_filter: Optional[E.Expression] = None
+        from spark_rapids_tpu.exec.project import FilterExec
+
+        if mode in ("complete", "partial") and isinstance(child, FilterExec):
+            self.pre_filter = child.condition
+            child = child.child
+        super().__init__(child)
         self.mode = mode
         self.group_exprs = list(group_exprs)
         self.agg_exprs = list(agg_exprs)
@@ -141,6 +152,8 @@ class HashAggregateExec(UnaryExec):
                 self._specs.append(_lower_agg(func, name, idx))
         self._pre_bound = tuple(pre_exprs)
         self._n_keys = n_keys
+        self._filter_bound = (E.resolve(self.pre_filter, in_schema)
+                              if self.pre_filter is not None else None)
         # hash-once aggregation: string group keys are hashed exactly once
         # (in the first pass); the 128-bit pair rides along as two LONG
         # buffer columns so merge passes regroup on ints, never re-hashing
@@ -201,10 +214,22 @@ class HashAggregateExec(UnaryExec):
     def node_description(self) -> str:
         keys = ", ".join(map(repr, self.group_exprs))
         aggs = ", ".join(map(repr, self.agg_exprs))
-        return f"TpuHashAggregate(mode={self.mode}) keys=[{keys}] aggs=[{aggs}]"
+        filt = (f" filter=[{self.pre_filter!r}]"
+                if self.pre_filter is not None else "")
+        return (f"TpuHashAggregate(mode={self.mode}) keys=[{keys}] "
+                f"aggs=[{aggs}]{filt}")
+
+    def _buffers_have_carry(self, buffers: ColumnarBatch) -> bool:
+        """Whether a buffer batch carries the #gh1/#gh2 hash columns.
+
+        Inferred from the column count (keys + [2 hash words] + buffers):
+        complete-mode first passes never carry; partial-mode ones always do
+        when a key is a plain string (_buffer_schema)."""
+        n_bufs = sum(len(s.ops) for s in self._specs)
+        return len(buffers.columns) == self._n_keys + 2 + n_bufs
 
     # -- device passes (traced) -------------------------------------------
-    def _grouping(self, pre: ColumnarBatch):
+    def _grouping(self, pre: ColumnarBatch, active):
         cap = pre.capacity
         if self._n_keys == 0:
             perm = jnp.arange(cap, dtype=jnp.int32)
@@ -212,13 +237,26 @@ class HashAggregateExec(UnaryExec):
             num_groups = jnp.int32(1)  # global agg: always one output row
             group_starts = jnp.zeros(cap, jnp.int32)
             return K.GroupInfo(perm, seg, num_groups, group_starts)
-        return K.group_rows(pre, list(range(self._n_keys)))
+        return K.group_rows(pre, list(range(self._n_keys)), active)
 
     def _first_pass(self, batch: ColumnarBatch) -> ColumnarBatch:
-        """pre-project + group + per-buffer update aggregations."""
-        pre_cols = []
+        """pre-project + (fused filter) + group + per-buffer aggregations."""
         ctx = EV.EvalContext(batch)
+        active = batch.active_mask()
+        if self._filter_bound is not None:
+            pv = EV.eval_expr(self._filter_bound, ctx)
+            active = active & pv.data & pv.validity
+        dense = self._dense_strides(batch)
+        if dense is not None:
+            return self._first_pass_dense(batch, ctx, active, dense)
+        pre_cols = []
         for e in self._pre_bound:
+            inner, _ = _strip_alias(e)
+            if isinstance(inner, E.ColumnRef):
+                # take the column as-is: keeps dictionary encoding (group-by
+                # and gathers run on int32 codes, never raw bytes)
+                pre_cols.append(batch.columns[inner.index])
+                continue
             v = EV.eval_expr(e, ctx)
             if isinstance(v, EV.StringVal):
                 pre_cols.append(DeviceColumn(T.STRING, v.data, v.validity, v.offsets))
@@ -231,35 +269,229 @@ class HashAggregateExec(UnaryExec):
                 T.BOOLEAN, jnp.zeros(batch.capacity, jnp.bool_),
                 jnp.zeros(batch.capacity, jnp.bool_)))
         pre = ColumnarBatch(pre_cols, batch.num_rows)
-        if self._hash_carry:
-            key_cols = list(range(self._n_keys))
+        key_cols = list(range(self._n_keys))
+        # hash carry is a property of the MODE, never of a batch's encoding:
+        # per-batch layout decisions would concat misaligned buffers when
+        # one batch dict-encoded a key and another kept it plain. Partial
+        # mode carries (static shuffle schema); complete mode never does —
+        # its merge pass regroups the (small) partials from the key bytes.
+        use_carry = self._hash_carry and self.mode != "complete"
+        if use_carry:
             h1 = K.hash_keys(pre, key_cols)
             h2 = K.hash_keys(pre, key_cols, variant=1)
-            gi = K.group_rows_prehashed(h1, h2, pre.active_mask())
+            gi = K.group_rows_prehashed(h1, h2, active)
             return self._aggregate_grouped(pre, gi,
                                            [s.ops for s in self._specs],
-                                           hashes=(h1, h2))
-        gi = self._grouping(pre)
-        return self._aggregate_grouped(pre, gi, [s.ops for s in self._specs])
+                                           hashes=(h1, h2), row_mask=active)
+        gi = self._grouping(pre, active)
+        return self._aggregate_grouped(pre, gi, [s.ops for s in self._specs],
+                                       row_mask=active)
+
+    # -- dense-id aggregation path -----------------------------------------
+    DENSE_MAX_IDS = 64  # masked-reduce fusion regime (kernels.dense_segment_sums)
+
+    def _dense_strides(self, batch: ColumnarBatch):
+        """Static dense-key layout, or None if ineligible.
+
+        Eligible when every group key is a ColumnRef onto a dict-encoded or
+        boolean column (static cardinality) and the combined id domain is
+        small: aggregation then runs as ONE f64 matmul against a one-hot id
+        matrix on the MXU (kernels.dense_segment_sums) with no sort, no
+        permutation gather and no scatter. A global aggregate (no keys) is
+        the G=1 case. Int sums use three 21-bit limb rows so results are
+        exact (and wrap like int64) even though the matmul runs in f64."""
+        if self.mode != "complete":
+            return None
+        strides = []
+        G = 1
+        for e in self._group_bound:
+            inner, _ = _strip_alias(e)
+            if not isinstance(inner, E.ColumnRef):
+                return None
+            c = batch.columns[inner.index]
+            if c.is_dict and c.dict_size > 0:
+                card = c.dict_size + 1  # + null slot
+            elif c.dtype == T.BOOLEAN:
+                card = 3
+            else:
+                return None
+            strides.append((inner.index, card))
+            G *= card
+        if G > self.DENSE_MAX_IDS:
+            return None
+        for s in self._specs:
+            for op in s.ops:
+                if op not in ("sum", "count", "count_all", "min", "max",
+                              "first", "last"):
+                    return None
+            if s.input_index is not None:
+                dt = self._pre_bound[s.input_index].dtype
+                if dt in (T.STRING, T.BINARY) or isinstance(dt, T.ArrayType):
+                    return None
+        return strides, G
+
+    def _first_pass_dense(self, batch: ColumnarBatch, ctx, active,
+                          dense) -> ColumnarBatch:
+        strides, G = dense
+        cap = batch.capacity
+        Gc = bucket_capacity(G, 16)
+        ids = jnp.zeros(cap, jnp.int32)
+        for ci, card in strides:
+            c = batch.columns[ci]
+            code = jnp.clip(c.data.astype(jnp.int32), 0, card - 2)
+            code = jnp.where(c.validity, code, card - 1)  # null key slot
+            ids = ids * card + code
+        f64 = jnp.float64
+        mm_rows: List[jax.Array] = [active.astype(f64)]  # group-exists count
+        in_vals = {}
+        for s in self._specs:
+            ii = s.input_index
+            if ii is not None and ii not in in_vals:
+                in_vals[ii] = EV.eval_expr(self._pre_bound[ii], ctx)
+
+        LIMB = 21
+        MASK = (1 << LIMB) - 1
+        plans = []  # per buffer: how to assemble from matmul rows / scatters
+        row_cache = {}  # (kind, input_index) -> row offset; dedups shared
+                        # inputs (Sum(x) + Average(x) share all their rows)
+
+        def nullable(ii):
+            return self._pre_bound[ii].nullable
+
+        for s in self._specs:
+            v = in_vals.get(s.input_index)
+            ii = s.input_index
+            for op, bt in zip(s.ops, s.buffer_types):
+                if op == "count_all" or (op == "count" and not nullable(ii)):
+                    plans.append(("count", 0, bt))  # row 0 = active count
+                    continue
+                if op == "count":
+                    key = ("count", ii)
+                    if key not in row_cache:
+                        row_cache[key] = len(mm_rows)
+                        mm_rows.append((active & v.validity).astype(f64))
+                    plans.append(("count", row_cache[key], bt))
+                    continue
+                if op == "sum":
+                    live = active & v.validity
+                    if jnp.issubdtype(v.data.dtype, jnp.floating):
+                        key = ("fsum", ii)
+                        if key not in row_cache:
+                            row_cache[key] = len(mm_rows)
+                            # canonical values: NaNs -> 0 so they cannot
+                            # poison the matmul; NaN presence rides its own
+                            # count row. Non-nullable inputs reuse row 0 as
+                            # their validity count.
+                            d, is_nan = K._float_canonical(v.data)
+                            mm_rows.append(jnp.where(live, d, 0.0))
+                            mm_rows.append((live & is_nan).astype(f64))
+                            if nullable(ii):
+                                mm_rows.append(live.astype(f64))
+                        r = row_cache[key]
+                        vrow = r + 2 if nullable(ii) else 0
+                        plans.append(("fsum", r, r + 1, vrow, bt))
+                        continue
+                    key = ("isum", ii)
+                    if key not in row_cache:
+                        row_cache[key] = len(mm_rows)
+                        x = v.data.astype(jnp.int64)
+                        x = jnp.where(live, x, 0)
+                        mm_rows.append((x & MASK).astype(f64))
+                        mm_rows.append(((x >> LIMB) & MASK).astype(f64))
+                        mm_rows.append((x >> (2 * LIMB)).astype(f64))
+                        if nullable(ii):
+                            mm_rows.append(live.astype(f64))
+                    r = row_cache[key]
+                    vrow = r + 3 if nullable(ii) else 0
+                    plans.append(("isum", r, vrow, bt))
+                    continue
+                # min/max/first/last: scatter path over the tiny id domain
+                plans.append(("seg", op, v, bt))
+        sums = K.dense_segment_sums(jnp.stack(mm_rows), ids, Gc)
+        # materialize the (R, Gc) sums once; without a barrier XLA fusion may
+        # re-run the whole reduction inside each consumer column
+        sums = jax.lax.optimization_barrier(sums)
+        exists = sums[0] > 0.5
+        g = jnp.arange(Gc, dtype=jnp.int32)
+        in_domain = g < G
+        exists = exists & in_domain
+
+        # keys: decode group id -> per-key code, most-significant first
+        key_cols: List[DeviceColumn] = []
+        rem = g
+        codes_rev = []
+        for ci, card in reversed(strides):
+            codes_rev.append((rem % card, ci, card))
+            rem = rem // card
+        for code, ci, card in reversed(codes_rev):
+            c = batch.columns[ci]
+            kvalid = exists & (code < card - 1)
+            if c.is_dict:
+                key_cols.append(DeviceColumn(
+                    c.dtype, jnp.where(kvalid, code, 0).astype(jnp.int32),
+                    kvalid, None, c.dictionary, c.dict_size, c.dict_max_len))
+            else:
+                key_cols.append(DeviceColumn(
+                    T.BOOLEAN, (code == 1) & kvalid, kvalid))
+
+        ids_live = jnp.where(active, ids, Gc)  # masked rows -> overflow slot
+        buf_cols: List[DeviceColumn] = []
+        for plan in plans:
+            if plan[0] == "count":
+                _, r, bt = plan
+                data = jnp.where(exists, sums[r].astype(jnp.int64), 0)
+                # counts are never null (a rowless global agg counts 0)
+                buf_cols.append(DeviceColumn(bt, data, jnp.ones(Gc, jnp.bool_)))
+            elif plan[0] == "fsum":
+                _, r, nan_r, vrow, bt = plan
+                nan_any = sums[nan_r] > 0.5
+                data = jnp.where(nan_any, jnp.float64(jnp.nan), sums[r])
+                valid = (sums[vrow] > 0.5) & exists
+                data = jnp.where(valid, data, 0.0).astype(T.numpy_dtype(bt))
+                buf_cols.append(DeviceColumn(bt, data, valid))
+            elif plan[0] == "isum":
+                _, r, vrow, bt = plan
+                lo = sums[r].astype(jnp.int64)
+                mid = sums[r + 1].astype(jnp.int64)
+                hi = sums[r + 2].astype(jnp.int64)
+                data = (hi << (2 * LIMB)) + (mid << LIMB) + lo  # wraps mod 2^64
+                valid = (sums[vrow] > 0.5) & exists
+                data = jnp.where(valid, data, 0).astype(T.numpy_dtype(bt))
+                buf_cols.append(DeviceColumn(bt, data, valid))
+            else:
+                _, op, v, bt = plan
+                data, avalid = K.segment_agg(
+                    v.data, v.validity, active, ids_live, Gc, op)
+                valid = avalid & exists
+                data = jnp.where(valid, data.astype(T.numpy_dtype(bt)),
+                                 jnp.zeros((), T.numpy_dtype(bt)))
+                buf_cols.append(DeviceColumn(bt, data, valid))
+
+        if self._n_keys == 0:
+            # global aggregate: exactly one output row, even over empty input
+            return ColumnarBatch(key_cols + buf_cols, jnp.int32(1))
+        table = ColumnarBatch(key_cols + buf_cols, jnp.int32(Gc))
+        idx, n = K.filter_indices(exists, jnp.ones(Gc, jnp.bool_))
+        return K.gather_batch(table, idx, n)
 
     def _merge_pass(self, buffers: ColumnarBatch) -> ColumnarBatch:
         """re-group partial buffers and combine with merge ops."""
         merge_ops = [[_MERGE_OP[op] for op in s.ops] for s in self._specs]
-        if self._hash_carry:
+        if self._buffers_have_carry(buffers):
             h1 = buffers.columns[self._n_keys].data.astype(jnp.uint64)
             h2 = buffers.columns[self._n_keys + 1].data.astype(jnp.uint64)
             gi = K.group_rows_prehashed(h1, h2, buffers.active_mask())
             return self._aggregate_grouped(buffers, gi, merge_ops,
                                            buffers_input=True,
                                            hashes=(h1, h2))
-        gi = self._grouping(buffers)
+        gi = self._grouping(buffers, buffers.active_mask())
         return self._aggregate_grouped(buffers, gi, merge_ops, buffers_input=True)
 
     def _aggregate_grouped(self, pre: ColumnarBatch, gi: K.GroupInfo,
                            ops_per_spec, buffers_input: bool = False,
-                           hashes=None) -> ColumnarBatch:
+                           hashes=None, row_mask=None) -> ColumnarBatch:
         cap = pre.capacity
-        active = pre.active_mask()
+        active = pre.active_mask() if row_mask is None else row_mask
         contributing = active[gi.perm]
         # sorted-segment layout: scan-based reducers instead of scatters
         seg_ends = K.segment_ends(gi.group_starts, gi.num_groups, cap)
@@ -293,6 +525,21 @@ class HashAggregateExec(UnaryExec):
                 else:
                     vals = src.data[gi.perm]
                     valid = src.validity[gi.perm]
+                if (src is not None and src.is_dict
+                        and op in ("min", "max", "first", "last")):
+                    # dict strings: min/max/first/last reduce CODES (sorted
+                    # dict -> code order is byte order, so this is exact),
+                    # output keeps the dictionary. count/sum buffers are
+                    # numeric and must NOT inherit the dictionary.
+                    data, avalid = K.segment_agg(
+                        vals, valid, contributing, gi.segment_ids, cap, op,
+                        ends=seg_ends, starts=gi.group_starts)
+                    v_out = avalid & out_row_valid
+                    out_cols.append(DeviceColumn(
+                        bt, jnp.where(v_out, data.astype(jnp.int32), 0),
+                        v_out, None, src.dictionary, src.dict_size,
+                        src.dict_max_len))
+                    continue
                 if src is not None and src.offsets is not None:
                     # min/max/first/last over strings: reduce row indices, gather
                     data, avalid = self._string_agg(src, gi, contributing, op, cap)
@@ -348,7 +595,8 @@ class HashAggregateExec(UnaryExec):
         """buffers -> final values (Average division etc.)."""
         cap = buffers.capacity
         out_cols: List[DeviceColumn] = list(buffers.columns[: self._n_keys])
-        bi = self._n_keys + (2 if self._hash_carry else 0)  # skip #gh1/#gh2
+        bi = self._n_keys + (2 if self._buffers_have_carry(buffers)
+                             else 0)  # skip #gh1/#gh2
         for s in self._specs:
             bufs = buffers.columns[bi: bi + len(s.ops)]
             bi += len(s.ops)
@@ -378,7 +626,9 @@ class HashAggregateExec(UnaryExec):
                 out_cols.append(DeviceColumn(rt, jnp.where(valid, data, 0), valid))
             else:
                 b = bufs[0]
-                if b.offsets is not None:
+                if b.is_dict:
+                    out_cols.append(b)  # dict string min/max/first/last
+                elif b.offsets is not None:
                     out_cols.append(DeviceColumn(rt, b.data, b.validity, b.offsets))
                 else:
                     out_cols.append(
@@ -441,12 +691,32 @@ class HashAggregateExec(UnaryExec):
 _concat_fn = jax.jit(K.concat_device, static_argnums=(1, 2))
 
 
+def _decode_col_jit(b: ColumnarBatch, ci: int) -> ColumnarBatch:
+    if not b.columns[ci].is_dict:
+        return b
+    cols = list(b.columns)
+    cols[ci] = _decode_col_fn(b.columns[ci])
+    return ColumnarBatch(cols, b.num_rows)
+
+
+_decode_col_fn = jax.jit(K.decode_dictionary)
+
+
 def concat_jit(batches: Sequence[ColumnarBatch],
                out_capacity: Optional[int] = None) -> ColumnarBatch:
     """Device concat with capacity bucketing (jit cached per shape combo).
 
     ``out_capacity`` may be smaller than the capacity sum when the caller
     knows the live row total (coalesce compaction)."""
+    # dict columns: codes are only comparable when every batch shares ONE
+    # device dictionary (object identity, guaranteed for batches sliced from
+    # one ingest); otherwise decode to plain bytes before concatenating
+    for ci, c in enumerate(batches[0].columns):
+        if c.is_dict or any(b.columns[ci].is_dict for b in batches):
+            shared = all(
+                b.columns[ci].dictionary is c.dictionary for b in batches)
+            if not shared:
+                batches = [_decode_col_jit(b, ci) for b in batches]
     out_cap = out_capacity or bucket_capacity(sum(b.capacity for b in batches))
     byte_caps = []
     for ci, c in enumerate(batches[0].columns):

@@ -21,6 +21,20 @@ ESSENTIAL = 0
 MODERATE = 1
 DEBUG = 2
 
+# When True, every operator fences (forces execution + 1-element readback of)
+# each batch it produces before yielding, so opTime metrics measure real
+# execution rather than async dispatch. Because a child operator fences its
+# own output first, each operator's opTime covers only the compute IT added.
+# Costs one tiny device->host readback per batch per operator; leave off for
+# throughput runs. Toggled by spark.rapids.tpu.metrics.sync (config/conf.py)
+# via set_sync_metrics().
+SYNC_METRICS = False
+
+
+def set_sync_metrics(enabled: bool) -> None:
+    global SYNC_METRICS
+    SYNC_METRICS = bool(enabled)
+
 
 class Metric:
     """Accumulating metric, summed across partitions (GpuMetric analog)."""
@@ -67,6 +81,7 @@ class TpuExec:
         self.metrics: Dict[str, Metric] = {}
         self._register_metric("numOutputRows", ESSENTIAL)
         self._register_metric("numOutputBatches", MODERATE)
+        self._register_metric("opTime", ESSENTIAL)
         # row counts are traced device scalars; summing them eagerly would
         # force a host sync per batch per operator and kill async dispatch
         # pipelining — they are resolved lazily in collect_metrics
@@ -84,7 +99,19 @@ class TpuExec:
 
     # -- execution ---------------------------------------------------------
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
-        for batch in self.do_execute(partition):
+        it = self.do_execute(partition)
+        op_time = self.metrics["opTime"]
+        while True:
+            t0 = time.perf_counter_ns()
+            try:
+                batch = next(it)
+            except StopIteration:
+                op_time.add(time.perf_counter_ns() - t0)
+                return
+            if SYNC_METRICS:
+                from spark_rapids_tpu.utils.sync import fence
+                fence(batch)
+            op_time.add(time.perf_counter_ns() - t0)
             self.metrics["numOutputBatches"].add(1)
             self._pending_rows.append(batch.num_rows)
             if len(self._pending_rows) >= 64:

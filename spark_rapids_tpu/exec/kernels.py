@@ -69,7 +69,8 @@ def gather_column(
     if col.offsets is None:
         data = col.data[safe_idx]
         data = jnp.where(row_valid & validity, data, jnp.zeros_like(data))
-        return DeviceColumn(col.dtype, data, validity)
+        return DeviceColumn(col.dtype, data, validity, None, col.dictionary,
+                            col.dict_size, col.dict_max_len)
     lens = col.offsets[1:] - col.offsets[:-1]
     out_lens = jnp.where(row_valid, lens[safe_idx], 0)
     out_offsets = jnp.concatenate(
@@ -84,6 +85,34 @@ def gather_column(
     in_range = jnp.arange(out_bytes, dtype=jnp.int32) < out_offsets[-1]
     data = jnp.where(in_range, col.data[src], jnp.zeros((), col.data.dtype))
     return DeviceColumn(col.dtype, data, validity, out_offsets)
+
+
+def decode_dictionary(col: DeviceColumn) -> DeviceColumn:
+    """Dict-encoded column -> plain string/binary column (traced).
+
+    One byte-space gather of the dictionary by code; the output byte capacity
+    is the static worst case capacity * dict_max_len."""
+    assert col.is_dict
+    worst = col.capacity * max(col.dict_max_len, 1)
+    assert worst < (1 << 31), (
+        "decoded worst case overflows int32 offsets; ingest must not "
+        "dict-encode such columns (_dict_bytes_encodable)")
+    out_bytes = bucket_capacity(max(worst, 8), 8)
+    # null rows gather with row_valid=False -> length 0, validity False
+    return gather_column(col.dictionary, col.data, col.validity, out_bytes)
+
+
+def ensure_plain_column(col: DeviceColumn) -> DeviceColumn:
+    return decode_dictionary(col) if col.is_dict else col
+
+
+def ensure_plain_batch(batch: ColumnarBatch) -> ColumnarBatch:
+    """Decode any dict-encoded columns (for operators/serializers that work
+    on raw bytes, and for joins where the two sides' dictionaries differ)."""
+    if not any(c.is_dict for c in batch.columns):
+        return batch
+    return ColumnarBatch([ensure_plain_column(c) for c in batch.columns],
+                         batch.num_rows)
 
 
 def gather_batch(
@@ -187,7 +216,11 @@ def sortable_keys(
     if nulls_first is None:
         nulls_first = ascending
     dt = col.dtype
-    if dt in (T.STRING, T.BINARY):
+    if col.is_dict:
+        # sorted dictionary: int32 code order IS byte-lexicographic order
+        k = col.data.astype(jnp.int32)
+        data_keys = [(-k) if not ascending else k]
+    elif dt in (T.STRING, T.BINARY):
         pk = string_prefix_keys(col)  # [hi_word, lo_word]; emit lo-first
         data_keys = [pk[1], pk[0]]
         if not ascending:
@@ -344,7 +377,13 @@ def hash_keys(batch: ColumnarBatch, key_cols: Sequence[int],
     h = jnp.zeros(batch.capacity, jnp.uint64)
     for i in key_cols:
         col = batch.columns[i]
-        if col.offsets is not None:
+        if col.is_dict:
+            # hash the dictionary entries (tiny byte pass), gather by code:
+            # identical VALUE hash as the plain string path, so partitioning,
+            # bloom filters and join candidates agree across encodings
+            dh = _string_hash(col.dictionary, variant)
+            ch = dh[jnp.clip(col.data, 0, col.dictionary.capacity - 1)]
+        elif col.offsets is not None:
             ch = _string_hash(col, variant)
         elif col.dtype in T.FRACTIONAL_TYPES:
             # hash the canonical value words so NaN==NaN, -0.0==0.0
@@ -369,7 +408,11 @@ def keys_equal(
         ca, cb = a.columns[ai], b.columns[bi]
         va = ca.validity[a_idx]
         vb = cb.validity[b_idx]
-        if ca.offsets is not None:
+        if ca.is_dict and cb.is_dict and ca.dictionary is cb.dictionary:
+            # shared dictionary: codes compare exactly
+            ceq = ca.data[a_idx] == cb.data[b_idx]
+        elif (ca.offsets is not None or ca.is_dict
+              or cb.offsets is not None or cb.is_dict):
             ceq = _string_eq_at(ca, a_idx, cb, b_idx)
         elif ca.dtype in T.FRACTIONAL_TYPES:
             da, na = _float_canonical(ca.data)
@@ -384,6 +427,25 @@ def keys_equal(
     return eq
 
 
+def _string_sig_at(c: DeviceColumn, idx: jax.Array):
+    """(hash, length, prefix_hi, prefix_lo) of string rows at ``idx``.
+
+    Dict-aware: for dict-encoded columns the signatures are computed over the
+    tiny dictionary and gathered by code, giving the identical values the
+    plain layout produces — so mixed-encoding comparisons are consistent."""
+    if c.is_dict:
+        codes = jnp.clip(c.data, 0, c.dictionary.capacity - 1)[idx]
+        d = c.dictionary
+        h = _string_hash(d)[codes]
+        lens = (d.offsets[1:] - d.offsets[:-1])[codes]
+        pk = string_prefix_keys(d)
+        return h, lens, pk[0][codes], pk[1][codes]
+    h = _string_hash(c)[idx]
+    lens = (c.offsets[1:] - c.offsets[:-1])[idx]
+    pk = string_prefix_keys(c)
+    return h, lens, pk[0][idx], pk[1][idx]
+
+
 def _string_eq_at(
     ca: DeviceColumn, a_idx: jax.Array, cb: DeviceColumn, b_idx: jax.Array
 ) -> jax.Array:
@@ -392,16 +454,9 @@ def _string_eq_at(
     Combines the 64-bit polynomial hash with both 16-byte prefixes; a false
     positive requires simultaneous 64-bit hash collision AND identical
     prefix/length — treated as exact for engine purposes."""
-    ha = _string_hash(ca)[a_idx]
-    hb = _string_hash(cb)[b_idx]
-    la = (ca.offsets[1:] - ca.offsets[:-1])[a_idx]
-    lb = (cb.offsets[1:] - cb.offsets[:-1])[b_idx]
-    pa = string_prefix_keys(ca)
-    pb = string_prefix_keys(cb)
-    eq = (ha == hb) & (la == lb)
-    for x, y in zip(pa, pb):
-        eq = eq & (x[a_idx] == y[b_idx])
-    return eq
+    ha, la, pa0, pa1 = _string_sig_at(ca, a_idx)
+    hb, lb, pb0, pb1 = _string_sig_at(cb, b_idx)
+    return (ha == hb) & (la == lb) & (pa0 == pb0) & (pa1 == pb1)
 
 
 # ---------------------------------------------------------------------------
@@ -441,7 +496,8 @@ class GroupInfo(NamedTuple):
     group_starts: jax.Array  # (cap,) int32 — permuted index of each group head
 
 
-def group_rows(batch: ColumnarBatch, key_cols: Sequence[int]) -> GroupInfo:
+def group_rows(batch: ColumnarBatch, key_cols: Sequence[int],
+               active: Optional[jax.Array] = None) -> GroupInfo:
     """Cluster live rows by key equality.
 
     TPU-first replacement for cudf hash-groupby: sort by hash then split
@@ -460,15 +516,31 @@ def group_rows(batch: ColumnarBatch, key_cols: Sequence[int]) -> GroupInfo:
     _string_eq_at and the documented engine-wide string-equality contract.
     """
     cap = batch.capacity
-    active = batch.active_mask()
+    if active is None:
+        active = batch.active_mask()
     if any(batch.columns[i].offsets is not None for i in key_cols):
-        # string keys: group on an independent 128-bit hash pair and never
-        # touch the byte data — neighbor equality on bytes would re-gather
-        # 16-byte prefixes per row, and the hash pair is already the
-        # engine-exactness bar used by _string_eq_at
+        # plain string keys: cluster on an independent 128-bit hash pair,
+        # then verify neighbors with a cheap exact check (length + 16-byte
+        # prefix, the _string_eq_at bar) so a double hash collision between
+        # distinct keys can only SPLIT a group, never merge one
         h1 = hash_keys(batch, key_cols)
         h2 = hash_keys(batch, key_cols, variant=1)
-        return group_rows_prehashed(h1, h2, active)
+        keys = [h2, h1, jnp.where(active, jnp.uint32(0), jnp.uint32(1))]
+        perm = lexsort_chain(keys).astype(jnp.int32)
+        prev = jnp.concatenate([perm[:1], perm[:-1]])
+        neq = (h1[perm] != h1[prev]) | (h2[perm] != h2[prev])
+        for i in key_cols:
+            c = batch.columns[i]
+            if c.offsets is None:
+                neq = neq | (c.data[perm] != c.data[prev])
+                neq = neq | (c.validity[perm] != c.validity[prev])
+                continue
+            lens = c.offsets[1:] - c.offsets[:-1]
+            neq = neq | (lens[perm] != lens[prev])
+            for w in string_prefix_keys(c):
+                neq = neq | (w[perm] != w[prev])
+            neq = neq | (c.validity[perm] != c.validity[prev])
+        return _group_from_boundaries(perm, neq, active, cap)
     h = hash_keys(batch, key_cols)
     keys: List[jax.Array] = [h]
     keys.append(jnp.where(active, jnp.uint32(0), jnp.uint32(1)))
@@ -532,14 +604,12 @@ def _sorted_segment_reducers(seg: jax.Array, starts: jax.Array,
     small groups downstream of a large-magnitude group lose their values to
     prefix absorption (cs accumulates 1e17, later 0.456 adds vanish into
     its ulp), a cross-group contamination plain per-segment summation never
-    has. The scatter costs ~90ms at 2^20 but is exact per segment.
-    min/max: segmented inclusive associative scan carrying (started, acc)
-    with reset at boundaries — one scan each, used sparingly (sum/count
-    dominate real workloads) because a scan's unrolled HLO is much bigger
-    than a cumsum's."""
+    has. The scatter is exact per segment.
+    min/max: scatter-based jax.ops.segment_min/max. (An associative_scan
+    formulation was measured at ~8s for 2^21 rows on the real chip — the
+    unrolled log-depth scan HLO is pathological there — while the scatter
+    runs in the same ~150-300ms band as every other memory pass.)"""
     n = seg.shape[0]
-    boundary = jnp.concatenate(
-        [jnp.ones(1, jnp.bool_), seg[1:] != seg[:-1]])
     starts_c = jnp.clip(starts, 0, n - 1)
     ends_c = jnp.clip(ends, 0, n - 1)
 
@@ -550,19 +620,15 @@ def _sorted_segment_reducers(seg: jax.Array, starts: jax.Array,
         cs = jnp.cumsum(v)
         return cs[ends_c] - cs[starts_c] + v[starts_c]
 
-    def make(op_fn):
-        def reduce(v: jax.Array) -> jax.Array:
-            def combine(a, b):
-                af, av = a
-                bf, bv = b
-                return af | bf, jnp.where(bf, bv, op_fn(av, bv))
+    def seg_min(v: jax.Array) -> jax.Array:
+        return jax.ops.segment_min(v, seg, num_segments=n,
+                                   indices_are_sorted=True)
 
-            _, scanned = jax.lax.associative_scan(combine, (boundary, v))
-            return scanned[ends_c]
+    def seg_max(v: jax.Array) -> jax.Array:
+        return jax.ops.segment_max(v, seg, num_segments=n,
+                                   indices_are_sorted=True)
 
-        return reduce
-
-    return (seg_sum, make(jnp.minimum), make(jnp.maximum))
+    return (seg_sum, seg_min, seg_max)
 
 
 def segment_agg(
@@ -657,6 +723,40 @@ def segment_agg(
 
 
 # ---------------------------------------------------------------------------
+# Dense-id aggregation (MXU path for small group-key domains)
+# ---------------------------------------------------------------------------
+
+
+def dense_segment_sums(rows: jax.Array, ids: jax.Array, num_ids: int
+                       ) -> jax.Array:
+    """Sum each of R value rows per dense id: (R, n) f64 -> (R, num_ids) f64.
+
+    Exact f64 sums (max rel err ~1e-14 vs numpy oracle). Masking (nulls,
+    filters) is the caller's job: masked rows must carry 0 in ``rows`` (for
+    sums) and their id may be anything in [0, num_ids).
+    """
+    n = ids.shape[0]
+    nrows = rows.shape[0]
+    ids = jnp.clip(ids, 0, num_ids - 1)
+
+    assert num_ids <= 64, (
+        "dense_segment_sums is for small id domains; larger group-key "
+        "domains take the sort-based aggregation path")
+    del nrows, n
+    # per-group masked full reductions: XLA fuses all num_ids x nrows
+    # reductions into one streaming pass over the rows (measured ~8ms
+    # marginal for (11, 4M) -> (11, 16) in f64 — faster than ANY dot
+    # formulation here: f64 dots lower to a multi-pass bf16 decomposition
+    # with dozens of materialized (rows, n) intermediates, and f32 dots
+    # cannot accumulate exactly enough)
+    outs = []
+    for g in range(num_ids):
+        m = ids == g
+        outs.append(jnp.sum(jnp.where(m[None, :], rows, 0.0), axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
 # Device concatenation (GpuCoalesceBatches concat, on device)
 # ---------------------------------------------------------------------------
 
@@ -692,7 +792,12 @@ def concat_device(
                 pos = jnp.where(live, st + j, out_capacity)  # OOB drops
                 data = data.at[pos].set(c.data, mode="drop")
                 validity = validity.at[pos].set(c.validity, mode="drop")
-            out_cols.append(DeviceColumn(dtype, data, validity))
+            # dict codes concat only when every input shares one dictionary
+            # (the concat_jit host wrapper decodes mismatched dicts first)
+            first = batches[0].columns[ci]
+            out_cols.append(DeviceColumn(dtype, data, validity, None,
+                                         first.dictionary, first.dict_size,
+                                         first.dict_max_len))
             continue
         out_bytes = out_byte_capacities[ci]
         lens_out = jnp.zeros(out_capacity, jnp.int32)

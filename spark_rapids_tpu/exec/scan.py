@@ -166,7 +166,14 @@ class FileScanBase(LeafExec):
             yield from self.upload_batched(iter([whole]))
 
     def upload_batched(self, tables) -> Iterator[ColumnarBatch]:
-        """Re-chunk host tables to target_batch_rows and upload each once."""
+        """Re-chunk host tables to target_batch_rows and upload each once.
+
+        String columns are dictionary-encoded per uploaded batch (sorted
+        dict) so device group/sort/equality run on int32 codes. Batches do
+        NOT share dictionaries across uploads (each file chunk has its own);
+        cross-batch consumers (concat/merge) decode on mismatch."""
+        from spark_rapids_tpu.columnar.batch import dictionary_encode_table
+
         pending: List[pa.Table] = []
         pending_rows = 0
         for t in tables:
@@ -177,13 +184,15 @@ class FileScanBase(LeafExec):
                 head = whole.slice(0, self.target_batch_rows)
                 rest = whole.slice(self.target_batch_rows)
                 with self.timer("uploadTimeNs"):
-                    yield batch_from_arrow(head, self.min_bucket)
+                    yield batch_from_arrow(dictionary_encode_table(head),
+                                           self.min_bucket)
                 pending = [rest] if rest.num_rows else []
                 pending_rows = rest.num_rows
         if pending_rows > 0:
             with self.timer("uploadTimeNs"):
-                yield batch_from_arrow(pa.concat_tables(pending),
-                                       self.min_bucket)
+                yield batch_from_arrow(
+                    dictionary_encode_table(pa.concat_tables(pending)),
+                    self.min_bucket)
 
 
 

@@ -47,6 +47,14 @@ class EvalContext:
 
     def column(self, i: int) -> Val:
         c = self.batch.columns[i]
+        if c.is_dict:
+            # expressions work on raw bytes: decode dict-encoded columns on
+            # read (group-by/sort/gather paths consume codes directly and
+            # never come through here)
+            from spark_rapids_tpu.exec.kernels import decode_dictionary
+
+            p = decode_dictionary(c)
+            return StringVal(p.data, p.offsets, p.validity)
         if c.offsets is not None:
             return StringVal(c.data, c.offsets, c.validity)
         return ColVal(c.data, c.validity)

@@ -44,8 +44,22 @@ def shard_batch(batch: ColumnarBatch, mesh: Mesh, axis: str = "dp"
     cols: List[DeviceColumn] = []
     for c in batch.columns:
         assert c.offsets is None, (
-            "string columns ride the host shuffle path in round 1"
+            "plain string columns ride the host shuffle path; dict-encode "
+            "them for ICI exchange (codes shard, dictionary replicates)"
         )
+        if c.is_dict:
+            repl = NamedSharding(mesh, P())
+            d = c.dictionary
+            dict_col = DeviceColumn(
+                d.dtype, jax.device_put(d.data, repl),
+                jax.device_put(d.validity, repl),
+                jax.device_put(d.offsets, repl))
+            cols.append(DeviceColumn(
+                c.dtype,
+                jax.device_put(c.data, row_sharding),
+                jax.device_put(c.validity, row_sharding),
+                None, dict_col, c.dict_size, c.dict_max_len))
+            continue
         cols.append(DeviceColumn(
             c.dtype,
             jax.device_put(c.data, row_sharding),

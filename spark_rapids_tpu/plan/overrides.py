@@ -204,6 +204,9 @@ class Overrides:
 
     # -- convert -----------------------------------------------------------
     def apply(self, plan: L.LogicalPlan) -> TpuExec:
+        from spark_rapids_tpu.exec import base as _base
+
+        _base.set_sync_metrics(self.conf[C.METRICS_SYNC])
         self._apply_path_rules(plan)
         meta = self.wrap_and_tag(plan)
         from spark_rapids_tpu.plan import cbo as _cbo
@@ -236,10 +239,13 @@ class Overrides:
                 from spark_rapids_tpu.plan.cpu import CpuInMemoryScanExec
 
                 return CpuInMemoryScanExec(node.table)
-            from spark_rapids_tpu.columnar.batch import batch_from_arrow
+            from spark_rapids_tpu.columnar.batch import (
+                batch_from_arrow, dictionary_encode_table)
 
-            t = node.table
-            batches = [batch_from_arrow(t.slice(i, node.batch_rows))
+            t = dictionary_encode_table(node.table)
+            cache: dict = {}
+            batches = [batch_from_arrow(t.slice(i, node.batch_rows),
+                                        dict_cache=cache)
                        for i in range(0, max(t.num_rows, 1), node.batch_rows)]
             return BatchSourceExec([batches], node.schema)
         if isinstance(node, L.Project):

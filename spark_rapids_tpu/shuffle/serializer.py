@@ -269,6 +269,9 @@ def serialize_batch_device(batch, schema: T.Schema) -> Optional[bytes]:
     if not available() or any(isinstance(f.dtype, T.ArrayType)
                               for f in schema):
         return None
+    from spark_rapids_tpu.exec.kernels import ensure_plain_batch
+
+    batch = ensure_plain_batch(batch)  # wire format carries raw bytes
     n = batch.row_count()
     data, validity, offsets, tcodes = [], [], [], []
     for col, field in zip(batch.columns, schema):

@@ -349,6 +349,10 @@ class Schema:
 
 
 def from_arrow_type(t: pa.DataType) -> DataType:
+    if pa.types.is_dictionary(t):
+        # dictionary-encoded columns keep their logical value type; the
+        # encoding is a device-layout detail (DeviceColumn.dictionary)
+        return from_arrow_type(t.value_type)
     if pa.types.is_boolean(t):
         return BOOLEAN
     if pa.types.is_int8(t):

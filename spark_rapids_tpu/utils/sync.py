@@ -1,0 +1,37 @@
+"""Device execution fence.
+
+``jax.Array.block_until_ready`` on the real-TPU platform in this image
+(axon) returns once *dispatch* completes, not execution: after it returns,
+the first host transfer of a result still pays the full compute time. Every
+honest wall-clock measurement (bench.py, op-time metrics) must therefore
+end with a device->host readback of a value that depends on the computation.
+
+``fence`` reads back ONE element per array — a few bytes of transfer, fully
+ordered behind the producing computation, so the readback cannot complete
+until the array's producer has executed. This is the engine's analog of the
+reference's stream synchronize (Cuda.deviceSynchronize / stream sync points
+that GpuMetric op-time semantics rely on, reference GpuExec.scala:41-178).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def fence(*values: Any) -> None:
+    """Force execution of every jax array in the given pytrees.
+
+    Dispatches a 1-element slice of each array, then pulls ALL slices in a
+    single ``jax.device_get`` — one round trip total. (Per-array readbacks
+    serialize at ~95ms each on this platform: a 30-array fence would cost
+    ~3s; batched it costs one RTT.)
+    """
+    tiny = []
+    for leaf in jax.tree_util.tree_leaves(values):
+        if isinstance(leaf, jax.Array) and leaf.size:
+            tiny.append(jnp.ravel(leaf)[:1])
+    if tiny:
+        jax.device_get(tiny)
