@@ -68,20 +68,48 @@ class DynamicPruningFilter:
         self._lock = threading.Lock()
 
     def _collect(self) -> None:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
         from spark_rapids_tpu.columnar.batch import batch_to_arrow
 
-        distinct = set()
+        # Arrow set semantics per batch (no Python scalar loop) and an
+        # incremental cap check, so an oversized build side bails out early
+        # instead of materializing every value first.
+        chunks = []
+        upper = 0  # sum of per-chunk distinct counts >= true distinct count
         schema = self.build.output_schema
+
+        def merge():
+            m = pc.unique(pa.concat_arrays(
+                [c.cast(chunks[0].type) for c in chunks]))
+            chunks[:] = [m]
+            return len(m)
+
         for p in range(self.build.num_partitions()):
             for b in self.build.execute(p):
                 t = batch_to_arrow(b, schema)
-                col = t.column(self.key_index)
-                distinct.update(v for v in col.to_pylist() if v is not None)
-                if len(distinct) > self.max_values:
-                    self._overflow = True
-                    return
+                u = pc.unique(t.column(self.key_index).combine_chunks())
+                u = u.drop_null()
+                chunks.append(u)
+                upper += len(u)
+                if upper > self.max_values:
+                    upper = merge()  # compact; true count so far
+                    if upper > self.max_values:
+                        self._overflow = True
+                        return
+        if not chunks:
+            self._values = []
+            return
+        merge()
+        vals = chunks[0].to_pylist()
+        if any(isinstance(v, float) and v != v for v in vals):
+            # NaN keys sort inconsistently (every comparison False), which
+            # would corrupt the bisect in may_match — disable pruning
+            self._overflow = True
+            return
         try:
-            self._values = sorted(distinct)
+            self._values = sorted(vals)
         except TypeError:  # mixed/unorderable — disable
             self._overflow = True
 

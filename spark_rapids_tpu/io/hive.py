@@ -41,7 +41,13 @@ def parse_partition_values(path: str, table_root: str) -> Dict[str, str]:
 def discover_partitions(table_root: str) -> List[str]:
     """All data files under the table root (sorted for determinism)."""
     files = []
-    for dirpath, _, names in os.walk(table_root):
+    for dirpath, dirs, names in os.walk(table_root):
+        # skip hidden/temp trees entirely (_temporary, .hive-staging,
+        # _delta_log) — but keep '_'-prefixed PARTITION dirs ('=' in name),
+        # e.g. _year=2024, like Spark's shouldFilterOutPathName
+        dirs[:] = [d for d in dirs
+                   if not (d.startswith(".")
+                           or (d.startswith("_") and "=" not in d))]
         for n in names:
             if not n.startswith((".", "_")):
                 files.append(os.path.join(dirpath, n))

@@ -37,6 +37,9 @@ def replace_paths(paths: Sequence[str],
     rules = parse_rules(conf[PATHS_TO_REPLACE])
     if not rules:
         return list(paths)
+    # longest src first so a more specific prefix cannot be shadowed by a
+    # shorter one listed earlier
+    rules = sorted(rules, key=lambda r: len(r[0]), reverse=True)
     out = []
     for p in paths:
         for src, dst in rules:
