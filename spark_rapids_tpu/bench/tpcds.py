@@ -118,6 +118,8 @@ def gen_customer_demographics() -> pa.Table:
                                       pa.string()),
         "cd_education_status": pa.array(edu[rng.integers(0, 7, n)],
                                         pa.string()),
+        "cd_dep_count": pa.array(rng.integers(0, 7, n).astype(np.int32),
+                                 pa.int32()),
     })
 
 

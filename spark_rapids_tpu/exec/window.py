@@ -73,8 +73,8 @@ class WindowExec(UnaryExec):
             if spec is None:
                 spec = func.spec
             else:
-                assert (spec.partition_by == func.spec.partition_by
-                        and spec.order_by == func.spec.order_by), (
+                assert (repr(spec.partition_by) == repr(func.spec.partition_by)
+                        and repr(spec.order_by) == repr(func.spec.order_by)), (
                     "one WindowExec handles one (partition, order) group; "
                     "the plan layer splits groups")
             self._wins.append((func, name))

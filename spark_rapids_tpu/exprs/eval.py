@@ -877,7 +877,8 @@ def project_batch(
     # padding rows keep validity False
     active = batch.active_mask()
     cols = [
-        DeviceColumn(c.dtype, c.data, c.validity & active, c.offsets) for c in cols
+        DeviceColumn(c.dtype, c.data, c.validity & active, c.offsets,
+                     c.dictionary, c.dict_size, c.dict_max_len) for c in cols
     ]
     return ColumnarBatch(cols, batch.num_rows)
 

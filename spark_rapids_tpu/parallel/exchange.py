@@ -132,7 +132,8 @@ def distributed_agg_step(mesh: Mesh, batch: ColumnarBatch, n_keys: int,
 
     def step(col_datas, col_valids, num_rows):
         local_cols = [
-            DeviceColumn(c.dtype, d, v)
+            DeviceColumn(c.dtype, d, v, None, c.dictionary, c.dict_size,
+                         c.dict_max_len)
             for c, d, v in zip(batch.columns, col_datas, col_valids)
         ]
         local = ColumnarBatch(local_cols, num_rows[0])
@@ -161,7 +162,8 @@ def distributed_agg_step(mesh: Mesh, batch: ColumnarBatch, n_keys: int,
         ex_cols, ex_valids, ex_n = all_to_all_by_key(
             datas, vals, part.num_rows, kh, axis, n_dev)
         ex_batch = ColumnarBatch(
-            [DeviceColumn(c.dtype, d, v)
+            [DeviceColumn(c.dtype, d, v, None, c.dictionary, c.dict_size,
+                          c.dict_max_len)
              for c, d, v in zip(part.columns, ex_cols, ex_valids)],
             ex_n)
         merged = _local_partial_agg(ex_batch, n_keys, merge_ops)

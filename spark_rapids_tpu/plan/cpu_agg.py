@@ -125,6 +125,10 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                     out.append(vals[sel].max() if sel.any() else None)
                 elif isinstance(bound, E.Average):
                     out.append(float(vals[sel].mean()) if sel.any() else None)
+                elif isinstance(bound, E.CountDistinct):
+                    out.append(int(len(set(
+                        v.item() if hasattr(v, "item") else v
+                        for v in vals[sel]))))
                 elif isinstance(bound, (E.First, E.Last)):
                     idxs = np.nonzero(sel)[0]
                     out.append(vals[idxs[0 if isinstance(bound, E.First)
@@ -242,3 +246,282 @@ class CpuJoinExec(CpuExec, BinaryExec):
         b = E.resolve(k, schema)
         assert isinstance(b, E.ColumnRef)
         return b.index
+
+
+class CpuWindowExec(CpuExec, UnaryExec):
+    """CPU window fallback (pandas): ranking, lead/lag, and aggregate
+    functions over full/running frames — the subset the device WindowExec
+    also handles, used as the differential oracle and the fallback path."""
+
+    def __init__(self, window_exprs: Sequence[E.Expression], child: TpuExec):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)
+
+    @property
+    def output_schema(self) -> T.Schema:
+        from spark_rapids_tpu.exec.aggregate import _strip_alias
+        from spark_rapids_tpu.exprs import window as W
+
+        cs = self.child.output_schema
+        fields = list(cs)
+        for e in self.window_exprs:
+            func, name = _strip_alias(e)
+            f = func.function
+            if isinstance(f, (W.Lead, W.Lag)):
+                dt, nullable = E.resolve(f.child, cs).dtype, True
+            elif isinstance(f, E.AggregateExpression) and f.children:
+                b = type(f)(E.resolve(f.children[0], cs))
+                dt, nullable = b.dtype, b.nullable
+            else:
+                dt, nullable = f.dtype, f.nullable
+            fields.append(T.Field(name, dt, nullable))
+        return T.Schema(fields)
+
+    def node_description(self):
+        return f"CpuWindow {self.window_exprs}"
+
+    def execute_host(self, partition: int) -> Iterator[pa.Table]:
+        import pandas as pd
+
+        from spark_rapids_tpu.exec.aggregate import _strip_alias
+        from spark_rapids_tpu.exprs import window as W
+
+        tables = list(self._child_host(self.child, partition))
+        if not tables:
+            return
+        t = pa.concat_tables(tables)
+        if t.num_rows == 0:
+            yield self.output_schema.to_arrow().empty_table()
+            return
+        cs = self.child.output_schema
+        first = self.window_exprs[0]
+        inner = first.child if isinstance(first, E.Alias) else first
+        spec: W.WindowSpec = inner.spec
+
+        # evaluate partition/order keys into temp columns
+        df = t.to_pandas()
+        pkeys, okeys, asc, napos = [], [], [], []
+        for i, p in enumerate(spec.partition_by):
+            vals, valid = cpu_eval(E.resolve(p, cs), t, cs)
+            df[f"#p{i}"] = pd.array(vals).where(valid, None) if not valid.all() \
+                else vals
+            pkeys.append(f"#p{i}")
+        for i, o in enumerate(spec.order_by):
+            vals, valid = cpu_eval(E.resolve(o.child, cs), t, cs)
+            df[f"#o{i}"] = pd.array(vals).where(valid, None) if not valid.all() \
+                else vals
+            okeys.append(f"#o{i}")
+            asc.append(o.ascending)
+            nf = o.nulls_first if o.nulls_first is not None else o.ascending
+            napos.append("first" if nf else "last")
+        if pkeys or okeys:
+            # pandas sort_values supports one na_position; Spark default
+            # (nulls first asc / last desc) matches per-key when uniform
+            df = df.sort_values(pkeys + okeys,
+                                ascending=[True] * len(pkeys) + asc,
+                                kind="stable",
+                                na_position=napos[0] if napos else "last")
+        grouper = df.groupby(pkeys, dropna=False, sort=False) if pkeys else None
+
+        out_cols = {}
+        for e in self.window_exprs:
+            func, name = _strip_alias(e)
+            f = func.function
+            frame = func.spec.resolved_frame()
+            if isinstance(f, W.RowNumber):
+                res = (grouper.cumcount() + 1 if grouper is not None
+                       else pd.Series(np.arange(1, len(df) + 1), df.index))
+            elif isinstance(f, W.Rank):
+                res = _rank(df, grouper, okeys, "min")
+            elif isinstance(f, W.DenseRank):
+                res = _rank(df, grouper, okeys, "dense")
+            elif isinstance(f, W.NTile):
+                res = _ntile(df, grouper, f.n)
+            elif isinstance(f, (W.Lead, W.Lag)):
+                vals, valid = cpu_eval(E.resolve(f.child, cs), t, cs)
+                data = np.asarray(vals, dtype=object)
+                data[~valid] = None
+                base = pd.Series(data[df.index.to_numpy()], df.index)
+                k = f.offset if isinstance(f, W.Lag) else -f.offset
+                if grouper is not None:
+                    res = pd.concat(
+                        [base.loc[g.index].shift(k) for _, g in grouper])
+                else:
+                    res = base.shift(k)
+                if f.default is not None:
+                    dv, _ = cpu_eval(E.resolve(f.default, cs), t, cs)
+                    res = res.fillna(np.atleast_1d(dv)[0])
+            elif isinstance(f, E.AggregateExpression):
+                res = _cpu_window_agg(df, grouper, f, frame, cs, t)
+            else:
+                raise NotImplementedError(f"cpu window {type(f).__name__}")
+            if hasattr(res, "reindex"):
+                res = res.reindex(df.index)
+            out_cols[name] = np.asarray(res)
+
+        base_t = pa.Table.from_pandas(
+            df[[c for c in df.columns if not c.startswith("#")]],
+            preserve_index=False)
+        # rebuild with the child arrow types (pandas may widen)
+        arrays = []
+        for fld, col in zip(cs, base_t.columns):
+            arrays.append(col.cast(fld.dtype.arrow_type()))
+        out_schema = self.output_schema
+        for (name, vals), fld in zip(out_cols.items(),
+                                     list(out_schema)[len(list(cs)):]):
+            mask = pd.isna(vals)
+            arr = pa.array(
+                np.where(mask, 0, vals).astype(
+                    T.numpy_dtype(fld.dtype), copy=False)
+                if fld.dtype.fixed_width else vals,
+                type=fld.dtype.arrow_type(),
+                mask=mask if mask.any() else None)
+            arrays.append(arr)
+        yield pa.table(arrays, schema=out_schema.to_arrow())
+
+
+def _rank(df, grouper, okeys, method):
+    import pandas as pd
+
+    if not okeys:
+        return pd.Series(1, df.index)
+    key = df[okeys].apply(tuple, axis=1)
+    if grouper is None:
+        return key.rank(method=method).astype(int)
+    # rank of the order tuple within each partition, respecting sort order:
+    # rows are already partition-sorted, so rank = position of first equal
+    out = []
+    for _, g in grouper:
+        gk = g[okeys].apply(tuple, axis=1)
+        first_pos = {}
+        seen = 0
+        ranks = []
+        dense = 0
+        prev = object()
+        for v in gk:
+            seen += 1
+            if v != prev:
+                dense += 1
+                first_pos[v] = seen
+                prev = v
+            ranks.append(first_pos[v] if method == "min" else dense)
+        out.append(pd.Series(ranks, g.index))
+    return pd.concat(out)
+
+
+def _ntile(df, grouper, n):
+    import pandas as pd
+
+    def tile(m):
+        base, rem = divmod(m, n)
+        out = []
+        for b in range(n):
+            size = base + (1 if b < rem else 0)
+            out.extend([b + 1] * size)
+        return out[:m]
+
+    if grouper is None:
+        return pd.Series(tile(len(df)), df.index)
+    return pd.concat([pd.Series(tile(len(g)), g.index) for _, g in grouper])
+
+
+def _cpu_window_agg(df, grouper, f, frame, cs, t):
+    import pandas as pd
+
+    from spark_rapids_tpu.exprs import window as W
+    from spark_rapids_tpu.plan.cpu import cpu_eval as _ce
+
+    if f.children:
+        # vals is in ORIGINAL row order; df is partition-sorted and its
+        # index holds the original positions — align positionally, then the
+        # .loc[g.index] below picks each partition's rows
+        vals, valid = _ce(E.resolve(f.children[0], cs), t, cs)
+        data = np.asarray(vals)
+        if data.dtype.kind in "iub":
+            data = data.astype(np.float64)
+        s = pd.Series(data, index=pd.RangeIndex(len(data)))
+        s[~valid] = np.nan
+        s = pd.Series(s.to_numpy()[df.index.to_numpy()], df.index)
+    else:
+        s = pd.Series(1.0, df.index)
+
+    groups = [df] if grouper is None else [g for _, g in grouper]
+    pieces = []
+    kind = type(f).__name__
+    for g in groups:
+        gs = s.loc[g.index]
+        if frame.is_unbounded_both or (frame.kind == "range"
+                                       and not frame.is_running):
+            if frame.is_unbounded_both:
+                pieces.append(_full_agg(gs, kind, g))
+                continue
+        if frame.is_running or (frame.kind == "range" and frame.is_running):
+            pieces.append(_running_agg(gs, kind, g))
+            continue
+        if frame.kind == "rows":
+            lo = frame.start
+            hi = frame.end
+            pieces.append(_rows_agg(gs, kind, lo, hi, g))
+            continue
+        raise NotImplementedError(f"cpu window frame {frame!r}")
+    return pd.concat(pieces)
+
+
+def _full_agg(gs, kind, g):
+    import pandas as pd
+
+    if kind == "Sum":
+        v = gs.sum(min_count=1)
+    elif kind == "Count":
+        v = gs.notna().sum()
+    elif kind == "Average":
+        v = gs.mean()
+    elif kind == "Min":
+        v = gs.min()
+    elif kind == "Max":
+        v = gs.max()
+    else:
+        raise NotImplementedError(kind)
+    return pd.Series(v, g.index)
+
+
+def _running_agg(gs, kind, g):
+    if kind == "Sum":
+        return gs.expanding().sum().where(gs.expanding().count() > 0)
+    if kind == "Count":
+        return gs.expanding().count()
+    if kind == "Average":
+        return gs.expanding().mean()
+    if kind == "Min":
+        return gs.expanding().min()
+    if kind == "Max":
+        return gs.expanding().max()
+    raise NotImplementedError(kind)
+
+
+def _rows_agg(gs, kind, lo, hi, g):
+    import pandas as pd
+
+    n = len(gs)
+    vals = gs.to_numpy()
+    out = []
+    for i in range(n):
+        a = 0 if lo is None else max(0, i + lo)
+        b = n - 1 if hi is None else min(n - 1, i + hi)
+        window = vals[a:b + 1] if b >= a else vals[:0]
+        window = window[~pd.isna(window)]
+        if kind == "Count":
+            out.append(len(window))
+        elif len(window) == 0:
+            out.append(np.nan)
+        elif kind == "Sum":
+            out.append(window.sum())
+        elif kind == "Average":
+            out.append(window.mean())
+        elif kind == "Min":
+            out.append(window.min())
+        elif kind == "Max":
+            out.append(window.max())
+        else:
+            raise NotImplementedError(kind)
+    return pd.Series(out, g.index)

@@ -128,6 +128,42 @@ class Aggregate(LogicalPlan):
 
 
 @dataclasses.dataclass
+class Window(LogicalPlan):
+    """Append window columns to the child (Spark WindowExec shape: all
+    expressions share one (partition, order) spec per node)."""
+
+    window_exprs: List[E.Expression]  # Alias(WindowExpression) ...
+    child: LogicalPlan
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def schema(self) -> T.Schema:
+        from spark_rapids_tpu.exec.aggregate import _strip_alias
+        from spark_rapids_tpu.exprs import window as W
+
+        cs = self.child.schema
+        fields = list(cs)
+        for e in self.window_exprs:
+            func, name = _strip_alias(e)
+            f = func.function
+            if isinstance(f, (W.Lead, W.Lag)):
+                dt = E.resolve(f.child, cs).dtype
+                nullable = True
+            elif isinstance(f, E.AggregateExpression) and f.children:
+                b = type(f)(E.resolve(f.children[0], cs))
+                dt, nullable = b.dtype, b.nullable
+            else:
+                dt, nullable = f.dtype, f.nullable
+            fields.append(T.Field(name, dt, nullable))
+        return T.Schema(fields)
+
+    def describe(self):
+        return f"Window{self.window_exprs}"
+
+
+@dataclasses.dataclass
 class Sort(LogicalPlan):
     orders: List[SortOrder]
     child: LogicalPlan

@@ -135,6 +135,29 @@ class PlanMeta:
         return not self.reasons
 
 
+def _with_children(plan: L.LogicalPlan, kids) -> L.LogicalPlan:
+    """Rebuild a logical node with replacement children."""
+    if isinstance(plan, L.Project):
+        return L.Project(plan.exprs, kids[0])
+    if isinstance(plan, L.Filter):
+        return L.Filter(plan.condition, kids[0])
+    if isinstance(plan, L.Aggregate):
+        return L.Aggregate(plan.group_exprs, plan.agg_exprs, kids[0])
+    if isinstance(plan, L.Window):
+        return L.Window(plan.window_exprs, kids[0])
+    if isinstance(plan, L.Sort):
+        return L.Sort(plan.orders, kids[0], plan.is_global, plan.limit)
+    if isinstance(plan, L.Join):
+        return L.Join(kids[0], kids[1], plan.left_keys, plan.right_keys,
+                      plan.join_type, plan.condition)
+    if isinstance(plan, L.Limit):
+        return L.Limit(plan.n, kids[0], plan.offset)
+    if isinstance(plan, L.Union):
+        return L.Union(kids)
+    assert not kids, f"unknown parent node {type(plan).__name__}"
+    return plan
+
+
 class Overrides:
     """The rewrite rule (GpuOverrides analog)."""
 
@@ -192,6 +215,20 @@ class Overrides:
             for o in node.orders:
                 for r in check_expr(o.child, child_schema):
                     meta.will_not_work(r)
+        elif isinstance(node, L.Window):
+            from spark_rapids_tpu.exprs import window as W
+
+            for e in node.window_exprs:
+                inner = e.child if isinstance(e, E.Alias) else e
+                if not isinstance(inner, W.WindowExpression):
+                    meta.will_not_work(f"not a window expression: {e!r}")
+                    continue
+                for p in inner.spec.partition_by:
+                    for r in check_expr(p, child_schema):
+                        meta.will_not_work(r)
+                for o in inner.spec.order_by:
+                    for r in check_expr(o.child, child_schema):
+                        meta.will_not_work(r)
         elif isinstance(node, L.Join):
             for e, s in ([(k, node.left.schema) for k in node.left_keys]
                          + [(k, node.right.schema) for k in node.right_keys]):
@@ -203,10 +240,82 @@ class Overrides:
                     meta.will_not_work(r)
 
     # -- convert -----------------------------------------------------------
+    def _rewrite_distinct(self, plan: L.LogicalPlan) -> L.LogicalPlan:
+        """Spark-style distinct-aggregate rewrite for the device engine.
+
+        Aggregate(keys, [.., CountDistinct(x), ..]) becomes: the regular
+        aggregate (distinct aggs dropped) joined with, per distinct agg, a
+        Count over the (keys, x)-distinct sub-aggregate. The global case
+        joins on a constant key. Nullable group keys stay unrewritten (the
+        join would drop null-key groups) and fall back to the CPU aggregate,
+        which implements count-distinct natively.
+        (Reference: Spark's RewriteDistinctAggregates, which the plugin
+        relies on upstream.)
+        """
+        kids = [self._rewrite_distinct(c) for c in plan.children]
+        if kids != list(plan.children):
+            plan = _with_children(plan, kids)
+        if not isinstance(plan, L.Aggregate):
+            return plan
+        dist = [(i, e) for i, e in enumerate(plan.agg_exprs)
+                if isinstance(e.child if isinstance(e, E.Alias) else e,
+                              E.CountDistinct)]
+        if not dist:
+            return plan
+        from spark_rapids_tpu.exec.aggregate import _strip_alias
+
+        child_schema = plan.child.schema
+        key_names = []
+        for e in plan.group_exprs:
+            b = E.resolve(e, child_schema)
+            inner, name = _strip_alias(b)
+            if not isinstance(inner, E.ColumnRef) or inner.nullable:
+                return plan  # CPU fallback handles it natively
+            key_names.append(name)
+
+        def named(e):
+            return _strip_alias(e)[1]
+
+        regular = [e for i, e in enumerate(plan.agg_exprs)
+                   if i not in {i0 for i0, _ in dist}]
+        if key_names:
+            reg_plan: L.LogicalPlan = L.Aggregate(
+                list(plan.group_exprs), regular, plan.child)
+            join_keys = key_names
+        else:
+            # global aggregate: join the one-row results on a constant key
+            reg_plan = L.Project(
+                [E.col(f.name) for f in
+                 L.Aggregate([], regular, plan.child).schema]
+                + [E.Alias(E.Literal(1, T.INT), "#one")],
+                L.Aggregate([], regular, plan.child))
+            join_keys = ["#one"]
+        for n, (_, e) in enumerate(dist):
+            func, name = _strip_alias(e)
+            x_alias = f"#dx{n}"
+            distinct_sub = L.Aggregate(
+                list(plan.group_exprs) + [E.Alias(func.children[0], x_alias)],
+                [], plan.child)
+            cnt = L.Aggregate(
+                [E.col(k) for k in key_names],
+                [E.Alias(E.Count(E.col(x_alias)), name)], distinct_sub)
+            if not key_names:
+                cnt = L.Project(
+                    [E.col(name), E.Alias(E.Literal(1, T.INT), "#one")], cnt)
+            reg_plan = L.Join(reg_plan, cnt,
+                              [E.col(k) for k in join_keys],
+                              [E.col(k) for k in join_keys])
+        # restore the original column order
+        out = [E.col(named(e)) for e in plan.group_exprs] + \
+              [E.col(named(e)) for e in plan.agg_exprs]
+        return L.Project(out, reg_plan)
+
     def apply(self, plan: L.LogicalPlan) -> TpuExec:
         from spark_rapids_tpu.exec import base as _base
 
         _base.set_sync_metrics(self.conf[C.METRICS_SYNC])
+        if C.SQL_ENABLED.get(self.conf):
+            plan = self._rewrite_distinct(plan)
         self._apply_path_rules(plan)
         meta = self.wrap_and_tag(plan)
         from spark_rapids_tpu.plan import cbo as _cbo
@@ -256,6 +365,8 @@ class Overrides:
                     else CpuFilterExec(node.condition, kids[0]))
         if isinstance(node, L.Aggregate):
             return self._convert_aggregate(node, kids[0], on_dev)
+        if isinstance(node, L.Window):
+            return self._convert_window(node, kids[0], on_dev)
         if isinstance(node, L.Sort):
             return self._convert_sort(node, kids[0], on_dev)
         if isinstance(node, L.Join):
@@ -308,10 +419,54 @@ class Overrides:
 
         return AQEShuffleReadExec(exchange, self.conf)
 
+    def _convert_window(self, node: L.Window, child: TpuExec,
+                        on_dev: bool) -> TpuExec:
+        if not on_dev:
+            from spark_rapids_tpu.plan.cpu_agg import CpuWindowExec
+
+            return CpuWindowExec(node.window_exprs, child)
+        from spark_rapids_tpu.exec.misc import CoalesceBatchesExec
+        from spark_rapids_tpu.exec.window import WindowExec
+        from spark_rapids_tpu.exprs import window as W
+
+        first = node.window_exprs[0]
+        inner = first.child if isinstance(first, E.Alias) else first
+        spec: W.WindowSpec = inner.spec
+        if self._planned_parts(child) > 1:
+            # co-partition rows by the window partition keys (hash exchange
+            # when they are plain columns; otherwise everything to one
+            # partition, Spark's single-partition window warning case)
+            key_idx = []
+            cs = child.output_schema
+            for p in spec.partition_by:
+                b = E.resolve(p, cs)
+                if isinstance(b, E.ColumnRef):
+                    key_idx.append(b.index)
+                else:
+                    key_idx = []
+                    break
+            if key_idx:
+                exchange: TpuExec = ShuffleExchangeExec(
+                    HashPartitioner(key_idx, self.shuffle_partitions), child)
+                exchange = self._maybe_aqe_read(exchange)
+            else:
+                exchange = ShuffleExchangeExec(SinglePartitioner(), child)
+            child = exchange
+        # window computation is per batch: require one batch per partition
+        # (the batch-spanning specializations are the running-window exec's
+        # job; reference GpuWindowExecMeta.scala:262-299)
+        child = CoalesceBatchesExec(child, require_single=True)
+        return WindowExec(node.window_exprs, child)
+
     def _convert_sort(self, node: L.Sort, child: TpuExec,
                       on_dev: bool) -> TpuExec:
         if not on_dev:
-            return CpuSortExec(node.orders, child)
+            srt = CpuSortExec(node.orders, child)
+            if node.limit is not None:
+                from spark_rapids_tpu.plan.cpu import CpuLimitExec
+
+                return CpuLimitExec(node.limit, srt, 0)
+            return srt
         if node.limit is not None:
             from spark_rapids_tpu.exec.misc import take_ordered_and_project
 

@@ -290,7 +290,11 @@ def test_math_fns():
     t = pa.table({"a": pa.array([4.0, -1.0, 0.0], type=pa.float64())})
     (sq, lg) = pylist(t, [E.Sqrt(col("a")), E.Log(col("a"))])
     assert sq[0] == 2.0 and math.isnan(sq[1]) and sq[2] == 0.0
-    assert lg == [math.log(4.0), None, None]  # Spark log(<=0) -> null
+    # Spark log(<=0) -> null; transcendentals may differ in the last ulp on
+    # the real-TPU backend (f64 is emulated) — approximate_float discipline,
+    # like the reference's integration-test mark (SURVEY.md section 4)
+    assert lg[1] is None and lg[2] is None
+    assert abs(lg[0] - math.log(4.0)) < 1e-14
 
 
 def test_round_half_up():
