@@ -84,7 +84,8 @@ def q1(d: D) -> DataFrame:
                   right_on="sr_store_sk")
          .filter(GreaterThan(col("ctr_total_return"),
                              Multiply(col("avg_ret"), lit(1.2))))
-         .join(d["store"].filter(EqualTo(col("s_state"), lit("TN"))),
+         .join(d["store"].filter(In(col("s_state"),
+                                    [lit(s) for s in ("TN", "GA", "OH")])),
                left_on=col("sr_store_sk"), right_on=col("s_store_sk"))
          .join(d["customer"], left_on="sr_customer_sk",
                right_on="c_customer_sk"))
@@ -133,7 +134,7 @@ def q2(d: D) -> DataFrame:
 def q3(d: D) -> DataFrame:
     ss = d["store_sales"]
     dt = d["date_dim"].filter(EqualTo(col("d_moy"), lit(11)))
-    it = d["item"].filter(EqualTo(col("i_manufact_id"), lit(128)))
+    it = d["item"].filter(_between(col("i_manufact_id"), 100, 150))
     j = (ss.join(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
          .join(it, left_on="ss_item_sk", right_on="i_item_sk"))
     return (j.group_by("d_year", "i_brand", "i_brand_id")
@@ -286,22 +287,26 @@ def q8(d: D) -> DataFrame:
     preferred-customer zips, as a semi join)."""
     zips = _distinct(d["customer_address"].filter(
         In(Substring(col("ca_zip"), 1, 2),
-           [lit(z) for z in ("24", "35", "40", "54", "60", "77", "89")])),
-        "ca_zip")
+           [lit(z) for z in ("24", "35", "40", "54", "60", "77", "89")]))
+        .select(Substring(col("ca_zip"), 1, 2).alias("zip_pref")),
+        "zip_pref")
     pref = _distinct(
         d["customer"].filter(EqualTo(col("c_preferred_cust_flag"), lit("Y")))
         .join(d["customer_address"], left_on="c_current_addr_sk",
-              right_on="ca_address_sk"),
-        "ca_zip")
-    both = zips.join(pref, left_on="ca_zip", right_on="ca_zip",
+              right_on="ca_address_sk")
+        .select(Substring(col("ca_zip"), 1, 2).alias("pref_zip")),
+        "pref_zip")
+    both = zips.join(pref, left_on="zip_pref", right_on="pref_zip",
                      how="left_semi")
     dt = d["date_dim"].filter(And(EqualTo(col("d_qoy"), lit(2)),
                                   EqualTo(col("d_year"), lit(1999))))
+    st = d["store"].with_column("s_zip_pref", Substring(col("s_zip"), 1, 2))
     j = (d["store_sales"]
          .join(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
-         .join(d["store"], left_on="ss_store_sk", right_on="s_store_sk")
-         .join(both, left_on=col("s_zip"), right_on=col("ca_zip"),
-               how="left_semi"))
+         .join(st, left_on="ss_store_sk", right_on="s_store_sk")
+         # official q8: stores match on the 2-char zip prefix
+         .join(both, left_on=col("s_zip_pref"),
+               right_on=col("zip_pref"), how="left_semi"))
     return (j.group_by("s_store_name")
             .agg(Sum(col("ss_net_profit")).alias("net_profit"))
             .sort("s_store_name", limit=100))
@@ -2035,22 +2040,32 @@ def q71(d: D) -> DataFrame:
 
 @q("q72")
 def q72(d: D) -> DataFrame:
-    """Catalog orders where inventory was short before ship date."""
+    """Catalog orders where inventory was short in the sold week.
+
+    Official q72 linkage: the inventory snapshot date is tied to the sold
+    date through d_week_seq equality (d1.d_week_seq = d2.d_week_seq), so
+    each sale only sees that week's snapshots — without it the
+    inventory join is a semi-cartesian (round-2 hang)."""
+    d1 = (d["date_dim"].filter(EqualTo(col("d_year"), lit(1999)))
+          .select(col("d_date_sk").alias("sold_d"),
+                  col("d_week_seq").alias("sold_week")))
+    d2 = (d["date_dim"]
+          .select(col("d_date_sk").alias("inv_d"),
+                  col("d_week_seq").alias("inv_week")))
+    inv = d["inventory"].join(d2, left_on="inv_date_sk", right_on="inv_d")
     j = (d["catalog_sales"]
-         .join(d["inventory"], left_on=col("cs_item_sk"),
-               right_on=col("inv_item_sk"))
+         .join(d1, left_on="cs_sold_date_sk", right_on="sold_d")
+         .join(inv,
+               left_on=[col("cs_item_sk"), col("sold_week")],
+               right_on=[col("inv_item_sk"), col("inv_week")],
+               condition=LessThan(col("inv_quantity_on_hand"),
+                                  col("cs_quantity")))
          .join(d["warehouse"], left_on=col("inv_warehouse_sk"),
                right_on=col("w_warehouse_sk"))
          .join(d["item"], left_on="cs_item_sk", right_on="i_item_sk")
          .join(d["household_demographics"].filter(
              EqualTo(col("hd_buy_potential"), lit(">10000"))),
-             left_on="cs_bill_hdemo_sk", right_on="hd_demo_sk")
-         .join(d["date_dim"].filter(EqualTo(col("d_year"), lit(1999)))
-               .select(col("d_date_sk").alias("sold_d"),
-                       col("d_week_seq").alias("sold_week")),
-               left_on=col("cs_sold_date_sk"), right_on=col("sold_d"),
-               condition=LessThan(col("inv_quantity_on_hand"),
-                                  col("cs_quantity"))))
+             left_on="cs_bill_hdemo_sk", right_on="hd_demo_sk"))
     g = (j.group_by("i_item_desc", "w_warehouse_name", "sold_week")
          .agg(Count().alias("no_promo")))
     return g.sort(desc("no_promo"), asc("i_item_desc"),
@@ -2606,13 +2621,14 @@ def q90(d: D) -> DataFrame:
 def q91(d: D) -> DataFrame:
     """Call-center returns by manager for one month/demographics."""
     cd = d["customer_demographics"].filter(Or(
-        And(EqualTo(col("cd_marital_status"), lit("M")),
-            EqualTo(col("cd_education_status"), lit("Unknown"))),
-        And(EqualTo(col("cd_marital_status"), lit("W")),
-            EqualTo(col("cd_education_status"), lit("Advanced Degree")))))
+        Or(And(EqualTo(col("cd_marital_status"), lit("M")),
+               EqualTo(col("cd_education_status"), lit("Unknown"))),
+           And(EqualTo(col("cd_marital_status"), lit("W")),
+               EqualTo(col("cd_education_status"), lit("Advanced Degree")))),
+        And(EqualTo(col("cd_marital_status"), lit("S")),
+            EqualTo(col("cd_education_status"), lit("College")))))
     j = (d["catalog_returns"]
-         .join(d["date_dim"].filter(And(EqualTo(col("d_year"), lit(1998)),
-                                        EqualTo(col("d_moy"), lit(11)))),
+         .join(d["date_dim"].filter(EqualTo(col("d_year"), lit(1998))),
                left_on="cr_returned_date_sk", right_on="d_date_sk")
          .join(d["call_center"], left_on="cr_call_center_sk",
                right_on="cc_call_center_sk")
@@ -2620,10 +2636,11 @@ def q91(d: D) -> DataFrame:
                right_on="c_customer_sk")
          .join(cd, left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
          .join(d["household_demographics"].filter(
-             Like(col("hd_buy_potential"), "0-500%")),
+             Or(Like(col("hd_buy_potential"), "0-500%"),
+                Like(col("hd_buy_potential"), "Unknown%"))),
              left_on="c_current_hdemo_sk", right_on="hd_demo_sk")
-         .join(d["customer_address"].filter(EqualTo(col("ca_gmt_offset"),
-                                                    lit(-7.0))),
+         .join(d["customer_address"].filter(In(col("ca_gmt_offset"),
+                                               [lit(-7.0), lit(-6.0)])),
                left_on="c_current_addr_sk", right_on="ca_address_sk"))
     return (j.group_by("cc_name", "cc_manager", "cd_marital_status",
                        "cd_education_status")

@@ -101,12 +101,34 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
             with np.errstate(divide="ignore", invalid="ignore"):
                 out = a.astype(np.float64) / np.where(zero, 1.0, bf)
             return out, m & ~zero
+        if isinstance(expr, E.IntegralDivide):
+            zero = b == 0
+            safe = np.where(zero, 1, b).astype(np.int64)
+            a64 = a.astype(np.int64)
+            q = a64 // safe
+            r = a64 - q * safe
+            fix = (r != 0) & ((a64 < 0) != (safe < 0))
+            q = np.where(fix, q + 1, q)
+            return np.where(zero, 0, q), m & ~zero
+        if isinstance(expr, E.Pmod):
+            zero = (b == 0) | (np.isnan(b) if b.dtype.kind == "f" else False)
+            safe = np.where(zero, 1, b)
+            rem = np.fmod(a, safe)
+            rem = np.fmod(rem + safe, safe)
+            return np.where(zero, np.zeros_like(rem), rem), m & ~zero
         if isinstance(expr, E.Remainder):
             zero = (b == 0) | (np.isnan(b) if b.dtype.kind == "f" else False)
             safe = np.where(zero, 1, b)
             out = np.fmod(a, safe)
             return out, m & ~zero
         raise NotImplementedError(f"cpu {type(expr).__name__}")
+    if isinstance(expr, E.EqualNullSafe):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        if expr.left.dtype in (T.STRING, T.BINARY):
+            eq = _obj_eq(a, b)
+        else:
+            eq = (a == b) | (_isnan(a) & _isnan(b))
+        return (eq & ma & mb) | (~ma & ~mb), ones
     if isinstance(expr, E.BinaryComparison):
         (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
         m = ma & mb
@@ -215,6 +237,207 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
             start = max(start, 0)
             return x[start: max(start, 0) + ln] if pos >= 0 else x[start: start + ln]
         return np.array([sub(x) for x in s], dtype=object), m
+    # --- unary math (device: exprs/eval.py:463-516) ---
+    if isinstance(expr, E.UnaryMinus):
+        d, m = ev(expr.child)
+        return -d, m
+    if isinstance(expr, E.Abs):
+        d, m = ev(expr.child)
+        return np.abs(d), m
+    if isinstance(expr, E.IsNaN):
+        d, m = ev(expr.child)
+        return _isnan(d) & m, ones
+    if isinstance(expr, E.Sqrt):
+        d, m = ev(expr.child)
+        with np.errstate(invalid="ignore"):
+            return np.sqrt(d.astype(np.float64)), m
+    if isinstance(expr, E.Exp):
+        d, m = ev(expr.child)
+        with np.errstate(over="ignore"):
+            return np.exp(d.astype(np.float64)), m
+    if isinstance(expr, E.Log):
+        d, m = ev(expr.child)
+        d = d.astype(np.float64)
+        ok = d > 0
+        return np.log(np.where(ok, d, 1.0)), m & ok
+    if isinstance(expr, E.Pow):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        return np.power(a.astype(np.float64), b.astype(np.float64)), ma & mb
+    if isinstance(expr, E.Floor):  # covers Ceil subclass
+        d, m = ev(expr.child)
+        if expr.child.dtype in T.INTEGRAL_TYPES:
+            return d.astype(np.int64), m
+        f = np.ceil if isinstance(expr, E.Ceil) else np.floor
+        # Java long-cast semantics on the result (NaN -> 0, saturate)
+        return _cpu_cast(f(d.astype(np.float64)), m, T.DOUBLE, T.LONG)
+    if isinstance(expr, E.Round):
+        d, m = ev(expr.child)
+        dt = expr.child.dtype
+        if dt in T.INTEGRAL_TYPES and expr.scale >= 0:
+            return d, m
+        # Spark ROUND_HALF_UP (away from zero), mirroring the device kernel
+        mul = 10.0 ** expr.scale
+        x = d.astype(np.float64) * mul
+        rounded = np.sign(x) * np.floor(np.abs(x) + 0.5) / mul
+        if dt in T.FRACTIONAL_TYPES:
+            rounded = rounded.astype(T.numpy_dtype(dt))
+        return rounded, m
+    if isinstance(expr, E.CaseWhen):
+        if expr.else_value is not None:
+            data, mask = ev(expr.else_value)
+            data, mask = data.copy(), mask.copy()
+        else:
+            if expr.dtype == T.STRING:
+                data = np.array([""] * n, dtype=object)
+            else:
+                data = np.zeros(n)
+            mask = np.zeros(n, np.bool_)
+        for p_ex, v_ex in reversed(expr.branches):
+            p, mp = ev(p_ex)
+            v, mv = ev(v_ex)
+            take = p.astype(np.bool_) & mp
+            data = np.where(take, v, data)
+            mask = np.where(take, mv, mask)
+        return data, mask
+    # --- datetime arithmetic (device: exprs/eval.py:531-545) ---
+    if isinstance(expr, (E.DateAdd, E.DateSub)):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        sign = 1 if isinstance(expr, E.DateAdd) else -1
+        return a.astype(np.int32) + sign * b.astype(np.int32), ma & mb
+    if isinstance(expr, E.DateDiff):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        return a.astype(np.int32) - b.astype(np.int32), ma & mb
+    # --- strings (device: exprs/strings.py kernels) ---
+    if isinstance(expr, E.Concat):
+        vals = [ev(c) for c in expr.children]
+        out = np.array(["".join(parts) for parts in
+                        zip(*(v for v, _ in vals))], dtype=object)
+        m = ones
+        for _, mv in vals:
+            m = m & mv
+        return out, m
+    if isinstance(expr, E.ConcatWs):
+        vals = [ev(c) for c in expr.children]
+        out = []
+        for i in range(n):
+            parts = [v[i] for v, mv in vals if mv[i]]
+            out.append(expr.sep.join(parts))
+        return np.array(out, dtype=object), ones
+    if isinstance(expr, E.StringTrim):  # covers Left/Right subclasses
+        s, m = ev(expr.children[0])
+        chars = expr.trim_str if expr.trim_str is not None else " "
+        if expr.side == "both":
+            out = [x.strip(chars) for x in s]
+        elif expr.side == "left":
+            out = [x.lstrip(chars) for x in s]
+        else:
+            out = [x.rstrip(chars) for x in s]
+        return np.array(out, dtype=object), m
+    if isinstance(expr, E.StringReplace):
+        s, m = ev(expr.children[0])
+        if expr.search == "":
+            return s, m
+        return np.array([x.replace(expr.search, expr.replacement)
+                         for x in s], dtype=object), m
+    if isinstance(expr, E.Like):
+        import re
+        s, m = ev(expr.children[0])
+        rx, esc, i = [], expr.escape, 0
+        pat = expr.pattern
+        while i < len(pat):
+            ch = pat[i]
+            if ch == esc and i + 1 < len(pat):
+                rx.append(re.escape(pat[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                rx.append(".*")
+            elif ch == "_":
+                rx.append(".")
+            else:
+                rx.append(re.escape(ch))
+            i += 1
+        prog = re.compile("".join(rx), re.DOTALL)
+        return np.array([prog.fullmatch(x) is not None for x in s]), m
+    if isinstance(expr, E.RLike):
+        import re
+        prog = re.compile(expr.pattern)
+        s, m = ev(expr.children[0])
+        return np.array([prog.search(x) is not None for x in s]), m
+    if isinstance(expr, E.StringInstr):
+        s, m = ev(expr.children[0])
+        sub = expr.substr.encode("utf-8")
+        if not sub:
+            return np.full(n, 1, np.int32), m
+        return np.array([x.encode("utf-8").find(sub) + 1 for x in s],
+                        np.int32), m
+    if isinstance(expr, E.StringLocate):
+        s, m = ev(expr.children[0])
+        if expr.start < 1:
+            return np.zeros(n, np.int32), m
+        sub = expr.substr.encode("utf-8")
+        if not sub:
+            return np.full(n, max(expr.start, 1), np.int32), m
+        return np.array(
+            [x.encode("utf-8").find(sub, expr.start - 1) + 1 for x in s],
+            np.int32), m
+    if isinstance(expr, E.StringLPad):  # covers StringRPad
+        s, m = ev(expr.children[0])
+        L = max(expr.length, 0)
+        pad = expr.pad
+
+        def dopad(x):
+            if len(x) >= L:
+                return x[:L]
+            fill = (pad * L)[: L - len(x)] if pad else ""
+            return fill + x if expr.side_left else x + fill
+        return np.array([dopad(x) for x in s], dtype=object), m
+    if isinstance(expr, E.StringRepeat):
+        s, m = ev(expr.children[0])
+        t = max(expr.times, 0)
+        return np.array([x * t for x in s], dtype=object), m
+    if isinstance(expr, E.StringReverse):
+        s, m = ev(expr.children[0])
+        return np.array([x[::-1] for x in s], dtype=object), m
+    if isinstance(expr, E.StringTranslate):
+        s, m = ev(expr.children[0])
+        table = {}
+        for i, ch in enumerate(expr.matching):
+            if ord(ch) in table:
+                continue
+            table[ord(ch)] = expr.replace[i] if i < len(expr.replace) else None
+        return np.array([x.translate(table) for x in s], dtype=object), m
+    if isinstance(expr, E.InitCap):
+        s, m = ev(expr.children[0])
+
+        def icap(x):
+            out = []
+            prev = " "
+            for ch in x:
+                out.append(ch.upper() if prev == " " else ch.lower())
+                prev = ch
+            return "".join(out)
+        return np.array([icap(x) for x in s], dtype=object), m
+    if isinstance(expr, E.SubstringIndex):
+        s, m = ev(expr.children[0])
+        d, c = expr.delim, expr.count
+        if c == 0 or d == "":
+            return np.array([""] * n, dtype=object), m
+
+        def sidx(x):
+            parts = x.split(d)
+            if c > 0:
+                return d.join(parts[:c]) if len(parts) > c else x
+            return d.join(parts[c:]) if len(parts) > -c else x
+        return np.array([sidx(x) for x in s], dtype=object), m
+    if isinstance(expr, E.Ascii):
+        s, m = ev(expr.children[0])
+        return np.array([x.encode("utf-8")[0] if x else 0 for x in s],
+                        np.int32), m
+    if isinstance(expr, E.Chr):
+        d, m = ev(expr.children[0])
+        out = [chr(int(v) % 256) if v >= 0 else "" for v in d]
+        return np.array(out, dtype=object), m
     raise NotImplementedError(f"cpu eval {type(expr).__name__}")
 
 

@@ -35,6 +35,14 @@ class DataFrame:
         exprs = [E.col(e) if isinstance(e, str) else e for e in exprs]
         return self._with(L.Project(list(exprs), self.plan))
 
+    def with_column(self, name: str, expr: E.Expression) -> "DataFrame":
+        """Append (or replace) a named column, keeping all others
+        (Spark ``withColumn``)."""
+        exprs = [E.col(f.name) for f in self.plan.schema.fields
+                 if f.name != name]
+        exprs.append(E.Alias(expr, name))
+        return self._with(L.Project(exprs, self.plan))
+
     def filter(self, condition: E.Expression) -> "DataFrame":
         return self._with(L.Filter(condition, self.plan))
 
@@ -103,6 +111,34 @@ class DataFrame:
         meta = Overrides(self.conf, self.shuffle_partitions).wrap_and_tag(
             self.plan)
         return explain(meta, "ALL")
+
+    def device_plan_stats(self) -> dict:
+        """Count device vs CPU-fallback nodes in the physical plan — the
+        standalone analog of the reference's validate_execs_in_gpu_plan /
+        assert_gpu_fallback_collect (integration_tests asserts.py:479-617)."""
+        from spark_rapids_tpu.plan.cpu import CpuExec
+
+        node = self.physical_plan()
+        counts = {"total": 0, "device": 0}
+        cpu_nodes = []
+
+        def walk(n):
+            counts["total"] += 1
+            if isinstance(n, CpuExec):
+                cpu_nodes.append(type(n).__name__)
+            else:
+                counts["device"] += 1
+            for c in n.children:
+                walk(c)
+
+        walk(node)
+        return {
+            "total": counts["total"],
+            "device": counts["device"],
+            "device_fraction": round(
+                counts["device"] / max(counts["total"], 1), 3),
+            "cpu_nodes": sorted(set(cpu_nodes)),
+        }
 
     def to_arrow(self) -> pa.Table:
         from spark_rapids_tpu.columnar.batch import batch_to_arrow

@@ -1,0 +1,194 @@
+"""CPU-oracle parity: every scalar device expression must also evaluate on the
+CPU fallback engine with identical results.
+
+The reference enforces this structurally — unsupported ops simply stay on
+Spark's own CPU operators, so the CPU side is always complete
+(GpuOverrides.scala tag/convert).  Standalone, our CPU engine is hand-written
+(plan/cpu.py), so any device expression missing there is both a broken oracle
+AND a broken fallback path.  Round-2 verdict found six TPC-DS queries failing
+exactly this way (Abs, Like).
+"""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs.eval import (bind_projection, compile_projection,
+                                         output_schema)
+from spark_rapids_tpu.exprs.expr import col, lit
+from spark_rapids_tpu.plan.cpu import cpu_eval
+
+TABLE = pa.table({
+    "i": pa.array([1, -7, None, 2**31 - 1, 0, 13], type=pa.int32()),
+    "j": pa.array([3, 0, 5, None, -2, 7], type=pa.int64()),
+    "f": pa.array([1.5, -2.25, None, float("nan"), 0.0, 1e18],
+                  type=pa.float64()),
+    "g": pa.array([2.0, -0.5, 3.25, None, float("nan"), -1e-3],
+                  type=pa.float64()),
+    "s": pa.array(["hello world", "", None, "Spark SQL", "aXbXc", "  pad  "]),
+    "p": pa.array(["b", "", "x", "SQL", "X", "pad"]),
+    "d": pa.array([0, 365, None, 19000, -1, 7], type=pa.date32()),
+    "e": pa.array([10, -365, 100, None, 1, 0], type=pa.int32()),
+    "b": pa.array([True, False, None, True, False, True]),
+})
+
+SCHEMA = T.Schema.from_arrow(TABLE.schema)
+
+
+def device_run(exprs):
+    bound = bind_projection(exprs, SCHEMA)
+    fn = compile_projection(exprs, SCHEMA)
+    out = fn(batch_from_arrow(TABLE))
+    return batch_to_arrow(out, output_schema(bound))
+
+
+def cpu_run(exprs):
+    import datetime
+
+    bound = bind_projection(exprs, SCHEMA)
+    cols = []
+    for ex in bound:
+        vals, mask = cpu_eval(ex, TABLE, SCHEMA)
+        out = []
+        for i in range(len(vals)):
+            if not mask[i]:
+                out.append(None)
+            elif ex.dtype == T.DATE:
+                out.append(datetime.date(1970, 1, 1)
+                           + datetime.timedelta(days=int(vals[i])))
+            else:
+                out.append(vals[i])
+        cols.append(out)
+    return cols
+
+
+def norm(v):
+    if v is None:
+        return None
+    if isinstance(v, (float, np.floating)):
+        if math.isnan(v):
+            return "NaN"
+        return round(float(v), 9)
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.bool_, bool)):
+        return bool(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return v
+
+
+CASES = {
+    "abs": [E.Abs(col("i")), E.Abs(col("f"))],
+    "unary_minus": [E.UnaryMinus(col("i")), E.UnaryMinus(col("f"))],
+    "sqrt": [E.Sqrt(col("f"))],
+    "exp": [E.Exp(col("g"))],
+    "log": [E.Log(col("f"))],
+    "pow": [E.Pow(col("f"), col("g"))],
+    "floor_ceil": [E.Floor(col("f")), E.Ceil(col("f")),
+                   E.Floor(col("i")), E.Ceil(col("j"))],
+    "round": [E.Round(col("f"), 1), E.Round(col("f"), 0), E.Round(col("i"))],
+    "is_nan": [E.IsNaN(col("f")), E.IsNaN(col("i"))],
+    "integral_divide": [E.IntegralDivide(col("i"), col("j"))],
+    "pmod": [E.Pmod(col("i"), col("j")), E.Pmod(col("f"), col("g"))],
+    "equal_null_safe": [E.EqualNullSafe(col("i"), col("j")),
+                        E.EqualNullSafe(col("s"), col("p"))],
+    "case_when": [E.CaseWhen([(col("i") > lit(0), col("i"))],
+                             E.UnaryMinus(col("i"))),
+                  E.CaseWhen([(col("b"), lit("yes"))], lit("no")),
+                  E.CaseWhen([(col("i") > lit(5), col("j"))])],
+    "date_add_sub": [E.DateAdd(col("d"), col("e")),
+                     E.DateSub(col("d"), col("e"))],
+    "date_diff": [E.DateDiff(col("e"), col("d"))],
+    "concat": [E.Concat(col("s"), lit("-"), col("p"))],
+    "concat_ws": [E.ConcatWs(col("s"), col("p"), sep=",")],
+    "trim": [E.StringTrim(col("s")), E.StringTrim(col("s"), "d ")],
+    "replace": [E.StringReplace(col("s"), "X", "--"),
+                E.StringReplace(col("s"), "", "z")],
+    "like": [E.Like(col("s"), "%world"), E.Like(col("s"), "a_b%"),
+             E.Like(col("s"), "100\\%")],
+    "rlike": [E.RLike(col("s"), "l+o"), E.RLike(col("s"), "^[aA]")],
+    "instr": [E.StringInstr(col("s"), "X"), E.StringInstr(col("s"), "")],
+    "locate": [E.StringLocate(col("s"), "l", 3),
+               E.StringLocate(col("s"), "l", 0)],
+    "pad": [E.StringLPad(col("s"), 13, "*"), E.StringRPad(col("s"), 3, "*"),
+            E.StringLPad(col("s"), 4, "")],
+    "repeat": [E.StringRepeat(col("p"), 3), E.StringRepeat(col("p"), -1)],
+    "reverse": [E.StringReverse(col("s"))],
+    "translate": [E.StringTranslate(col("s"), "lX ", "L_")],
+    "initcap": [E.InitCap(col("s"))],
+    "substring_index": [E.SubstringIndex(col("s"), "X", 2),
+                        E.SubstringIndex(col("s"), "X", -1),
+                        E.SubstringIndex(col("s"), "X", 0)],
+    "ascii_chr": [E.Ascii(col("s")), E.Chr(col("e"))],
+    "substring": [E.Substring(col("s"), 2, 3), E.Substring(col("s"), -3, 2)],
+    "upper_lower_len": [E.Upper(col("s")), E.Lower(col("s")),
+                        E.Length(col("s"))],
+    "search": [E.StartsWith(col("s"), lit("hel")),
+               E.EndsWith(col("s"), lit("d")),
+               E.Contains(col("s"), lit("X"))],
+    "arith": [col("i") + col("j"), col("i") - col("j"), col("i") * col("j"),
+              E.Divide(col("i"), col("j")), E.Remainder(col("i"), col("j"))],
+    "compare": [col("f") < col("g"), col("f") >= col("g"),
+                E.EqualTo(col("i"), col("j"))],
+    "logic": [E.And(col("b"), col("i") > lit(0)),
+              E.Or(col("b"), col("i") > lit(0)), E.Not(col("b"))],
+    "null_checks": [E.IsNull(col("i")), E.IsNotNull(col("f")),
+                    E.Coalesce(col("i"), col("e"), lit(0))],
+    "conditional": [E.If(col("b"), col("i"), col("e")),
+                    E.In(col("i"), [lit(1), lit(13), lit(None, T.INT)])],
+    "datetime_parts": [E.Year(col("d")), E.Month(col("d")),
+                       E.DayOfMonth(col("d")), E.Quarter(col("d")),
+                       E.DayOfWeek(col("d")), E.DayOfYear(col("d"))],
+    "cast": [E.Cast(col("f"), T.INT), E.Cast(col("i"), T.DOUBLE),
+             E.Cast(col("i"), T.LONG)],
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_cpu_device_parity(name):
+    exprs = [E.Alias(e, f"c{i}") for i, e in enumerate(CASES[name])]
+    dev = device_run(exprs)
+    cpu = cpu_run(exprs)
+    for ci in range(dev.num_columns):
+        dvals = [norm(v) for v in dev.column(ci).to_pylist()]
+        cvals = [norm(v) for v in cpu[ci]]
+        assert dvals == cvals, (
+            f"{name} col {ci}: device={dvals} cpu={cvals}")
+
+
+def test_no_device_expr_without_cpu_oracle():
+    """Every scalar expression the planner tags device-supported must be
+    implemented in plan/cpu.py (source-level guard against new gaps)."""
+    import re
+
+    from spark_rapids_tpu.plan import cpu as cpu_mod
+    from spark_rapids_tpu.plan import overrides
+
+    src = open(cpu_mod.__file__).read()
+    missing = []
+    for cls in overrides._DEVICE_EXPRS:
+        name = cls.__name__
+        if issubclass(cls, E.AggregateExpression):
+            continue  # aggregates live in plan/cpu_agg.py
+        if name in ("Alias", "ColumnRef", "UnresolvedColumn", "Literal"):
+            continue
+        base_handled = {
+            "Add": "BinaryArithmetic", "Subtract": "BinaryArithmetic",
+            "Multiply": "BinaryArithmetic", "Divide": "BinaryArithmetic",
+            "Remainder": "BinaryArithmetic",
+            "EqualTo": "BinaryComparison", "LessThan": "BinaryComparison",
+            "GreaterThan": "BinaryComparison",
+            "LessThanOrEqual": "BinaryComparison",
+            "GreaterThanOrEqual": "BinaryComparison",
+            "Ceil": "Floor", "StringRPad": "StringLPad",
+            "StringTrimLeft": "StringTrim", "StringTrimRight": "StringTrim",
+        }.get(name, name)
+        if not re.search(r"\bE\." + base_handled + r"\b", src):
+            missing.append(name)
+    assert not missing, f"device exprs without CPU oracle: {missing}"

@@ -121,7 +121,12 @@ def main():
             cpu_err = f"{type(e).__name__}: {e}"
             entry["cpu_trace"] = traceback.format_exc(limit=8)
         try:
-            dev_rows = fn(dfs_for(dev_conf)).collect()
+            dev_df = fn(dfs_for(dev_conf))
+            stats = dev_df.device_plan_stats()
+            entry["device_fraction"] = stats["device_fraction"]
+            if stats["cpu_nodes"]:
+                entry["cpu_nodes"] = stats["cpu_nodes"]
+            dev_rows = dev_df.collect()
         except Exception as e:
             dev_err = f"{type(e).__name__}: {e}"
             entry["dev_trace"] = traceback.format_exc(limit=8)
@@ -163,13 +168,18 @@ def main():
         f.write("| status | count |\n|---|---|\n")
         for k in sorted(counts):
             f.write(f"| {k} | {counts[k]} |\n")
-        f.write("\n| query | status | rows | seconds | note |\n|---|---|---|---|---|\n")
+        f.write("\n| query | status | rows | seconds | device% | note |\n"
+                "|---|---|---|---|---|---|\n")
         for name in names:
             e = results.get(name, {})
             note = (e.get("dev_err") or e.get("cpu_err")
                     or e.get("diff") or "")
+            if e.get("cpu_nodes"):
+                note = f"cpu: {','.join(e['cpu_nodes'])} {note}"
+            frac = e.get("device_fraction")
             f.write(f"| {name} | {e.get('status')} | {e.get('rows', '')} | "
-                    f"{e.get('seconds', '')} | {str(note)[:90]} |\n")
+                    f"{e.get('seconds', '')} | "
+                    f"{'' if frac is None else frac} | {str(note)[:90]} |\n")
     print("summary:", counts, flush=True)
 
 
