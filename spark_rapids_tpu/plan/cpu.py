@@ -67,7 +67,7 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         return _col_np(table, expr.index)
     if isinstance(expr, E.Literal):
         if expr.value is None:
-            return np.zeros(n), np.zeros(n, np.bool_)
+            return _null_fill(expr.dtype, n), np.zeros(n, np.bool_)
         v = expr.value
         if expr.dtype == T.DATE:
             import datetime
@@ -265,7 +265,15 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         return np.power(a.astype(np.float64), b.astype(np.float64)), ma & mb
     if isinstance(expr, E.Floor):  # covers Ceil subclass
         d, m = ev(expr.child)
-        if expr.child.dtype in T.INTEGRAL_TYPES:
+        ct = expr.child.dtype
+        if isinstance(ct, T.DecimalType):
+            # floor/ceil of the logical value, kept at the same scale
+            # (values are scaled int64)
+            p = np.int64(10 ** ct.scale)
+            if isinstance(expr, E.Ceil):
+                return -((-d) // p) * p, m
+            return (d // p) * p, m
+        if ct in T.INTEGRAL_TYPES:
             return d.astype(np.int64), m
         f = np.ceil if isinstance(expr, E.Ceil) else np.floor
         # Java long-cast semantics on the result (NaN -> 0, saturate)
@@ -287,10 +295,7 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
             data, mask = ev(expr.else_value)
             data, mask = data.copy(), mask.copy()
         else:
-            if expr.dtype == T.STRING:
-                data = np.array([""] * n, dtype=object)
-            else:
-                data = np.zeros(n)
+            data = _null_fill(expr.dtype, n)
             mask = np.zeros(n, np.bool_)
         for p_ex, v_ex in reversed(expr.branches):
             p, mp = ev(p_ex)
@@ -439,6 +444,23 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         out = [chr(int(v) % 256) if v >= 0 else "" for v in d]
         return np.array(out, dtype=object), m
     raise NotImplementedError(f"cpu eval {type(expr).__name__}")
+
+
+def _null_fill(dtype: T.DataType, n: int) -> np.ndarray:
+    """dtype-matched placeholder values for all-null columns (the device's
+    _broadcast_literal analog); float64 zeros would silently corrupt int64
+    values > 2^53 when np.where-merged."""
+    if dtype in (T.STRING, T.BINARY):
+        return np.array([""] * n, dtype=object)
+    if dtype == T.BOOLEAN:
+        return np.zeros(n, np.bool_)
+    if dtype in T.INTEGRAL_TYPES or isinstance(dtype, T.DecimalType):
+        return np.zeros(n, np.int64)
+    if dtype == T.DATE:
+        return np.zeros(n, np.int32)
+    if dtype == T.TIMESTAMP:
+        return np.zeros(n, np.int64)
+    return np.zeros(n)
 
 
 def _isnan(a):

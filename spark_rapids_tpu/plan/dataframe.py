@@ -36,11 +36,18 @@ class DataFrame:
         return self._with(L.Project(list(exprs), self.plan))
 
     def with_column(self, name: str, expr: E.Expression) -> "DataFrame":
-        """Append (or replace) a named column, keeping all others
+        """Append (or replace in place) a named column, keeping all others
         (Spark ``withColumn``)."""
-        exprs = [E.col(f.name) for f in self.plan.schema.fields
-                 if f.name != name]
-        exprs.append(E.Alias(expr, name))
+        exprs = []
+        replaced = False
+        for f in self.plan.schema.fields:
+            if f.name == name:
+                exprs.append(E.Alias(expr, name))
+                replaced = True
+            else:
+                exprs.append(E.col(f.name))
+        if not replaced:
+            exprs.append(E.Alias(expr, name))
         return self._with(L.Project(exprs, self.plan))
 
     def filter(self, condition: E.Expression) -> "DataFrame":
@@ -103,6 +110,13 @@ class DataFrame:
     def physical_plan(self):
         from spark_rapids_tpu.plan.overrides import Overrides
 
+        # single-use handoff: device_plan_stats() leaves its (never-executed)
+        # plan here so a following collect() doesn't re-run Overrides; an
+        # executed plan is never cached (shuffle state is cleaned up on use)
+        cached = getattr(self, "_pplan", None)
+        if cached is not None:
+            self._pplan = None
+            return cached
         return Overrides(self.conf, self.shuffle_partitions).apply(self.plan)
 
     def explain(self) -> str:
@@ -132,6 +146,7 @@ class DataFrame:
                 walk(c)
 
         walk(node)
+        self._pplan = node  # hand off to a following collect()
         return {
             "total": counts["total"],
             "device": counts["device"],

@@ -91,6 +91,11 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
                                   E.GreaterThan, E.GreaterThanOrEqual)):
                 if bound.left.dtype in (T.STRING, T.BINARY):
                     reasons.append("string ordering comparison not on device")
+            # device kernels raise for decimal floor/ceil/round — tag to CPU
+            # instead of crashing at execute time
+            if isinstance(bound, (E.Floor, E.Round)) and isinstance(
+                    bound.children[0].dtype, T.DecimalType):
+                reasons.append("decimal floor/ceil/round not on device")
             # probe regex compilability (reference: RegexParser transpiler
             # bail-outs -> willNotWorkOnGpu); patterns outside the DFA
             # subset fall back to CPU

@@ -35,6 +35,8 @@ TABLE = pa.table({
     "d": pa.array([0, 365, None, 19000, -1, 7], type=pa.date32()),
     "e": pa.array([10, -365, 100, None, 1, 0], type=pa.int32()),
     "b": pa.array([True, False, None, True, False, True]),
+    "big": pa.array([2**62 + 1, -(2**60) - 7, None, 1, 0, 10**18 + 1],
+                    type=pa.int64()),
 })
 
 SCHEMA = T.Schema.from_arrow(TABLE.schema)
@@ -101,7 +103,12 @@ CASES = {
     "case_when": [E.CaseWhen([(col("i") > lit(0), col("i"))],
                              E.UnaryMinus(col("i"))),
                   E.CaseWhen([(col("b"), lit("yes"))], lit("no")),
-                  E.CaseWhen([(col("i") > lit(5), col("j"))])],
+                  E.CaseWhen([(col("i") > lit(5), col("j"))]),
+                  # no-ELSE with int64 values > 2^53: float64 seeding
+                  # would corrupt them (round-3 review finding)
+                  E.CaseWhen([(col("b"), col("big"))])],
+    "concat_null_lit": [E.Concat(col("s"), lit(None, T.STRING)),
+                        E.If(col("b"), col("big"), lit(None, T.LONG))],
     "date_add_sub": [E.DateAdd(col("d"), col("e")),
                      E.DateSub(col("d"), col("e"))],
     "date_diff": [E.DateDiff(col("e"), col("d"))],
