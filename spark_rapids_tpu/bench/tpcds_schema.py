@@ -674,12 +674,31 @@ def gen_time_dim() -> pa.Table:
     })
 
 
+_MONEY_TOKENS = ("price", "cost", "amt", "tax", "paid", "profit", "fee",
+                 "credit", "charge", "cash", "coupon", "commission")
+
+
+def _decimalize(table: pa.Table) -> pa.Table:
+    """Retype money columns float64 -> decimal(7,2), the official TPC-DS
+    typing (tpcds.sql: ss_sales_price decimal(7,2) etc.).  Generated values
+    are pre-rounded to 2dp so the cast is exact; this is what makes money
+    aggregation bit-identical across engines (float sums are
+    summation-order-dependent — round-2 q44)."""
+    for i, name in enumerate(table.column_names):
+        f = table.field(i)
+        if f.type == pa.float64() and any(tok in name
+                                          for tok in _MONEY_TOKENS):
+            col = table.column(i).cast(pa.decimal128(7, 2))
+            table = table.set_column(i, pa.field(name, col.type), col)
+    return table
+
+
 def tables_for(sf: float, seed: int = 0) -> Dict[str, pa.Table]:
     """All 24 TPC-DS tables, seeded and internally consistent."""
     ss = gen_store_sales(sf, seed + 3)
     cs = gen_catalog_sales(sf, seed + 5)
     ws = gen_web_sales(sf, seed + 7)
-    return {
+    out = {
         "date_dim": gen_date_dim(seed),
         "time_dim": gen_time_dim(),
         "item": gen_item(sf, seed + 1),
@@ -705,3 +724,4 @@ def tables_for(sf: float, seed: int = 0) -> Dict[str, pa.Table]:
         "web_returns": gen_web_returns(sf, ws, seed + 8),
         "inventory": gen_inventory(sf, seed + 9),
     }
+    return {k: _decimalize(v) for k, v in out.items()}

@@ -381,6 +381,21 @@ def _finish_agg(f, out_t, s, c, active) -> DeviceColumn:
         return DeviceColumn(T.LONG, jnp.where(active, c, 0), active)
     if isinstance(f, E.Average):
         nz = c > 0
+        if isinstance(out_t, T.DecimalType):
+            # exact HALF_UP at scale(in)+4 over the int64 window sums
+            # (same rule as HashAggregateExec decimal avg)
+            in_t = f.children[0].dtype
+            shift = 10 ** (out_t.scale - in_t.scale)
+            num = s.astype(jnp.int64) * jnp.int64(shift)
+            den = jnp.maximum(c, 1)
+            q = num // den
+            r = num - q * den
+            neg = num < 0
+            q_t = jnp.where(neg & (r != 0), q + 1, q)
+            r_t = jnp.abs(num - q_t * den)
+            data = q_t + jnp.where(2 * r_t >= den,
+                                   jnp.where(neg, -1, 1), 0)
+            return _win_out(out_t, data, nz, active)
         data = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64)
         return _win_out(out_t, data, nz, active)
     # Sum

@@ -321,12 +321,14 @@ def _cast_to_decimal(data, valid, src: T.DataType, dst: T.DecimalType, ansi):
     elif src in T.INTEGRAL_TYPES:
         scaled = data.astype(jnp.int64) * jnp.int64(10**dst.scale)
     else:
-        # float -> decimal: round half-up at target scale
+        # float -> decimal: round HALF_UP (away from zero) at target scale,
+        # Spark Decimal(double).changePrecision — not banker's rounding
         shifted = data.astype(jnp.float64) * (10.0**dst.scale)
+        half_up = jnp.sign(shifted) * jnp.floor(jnp.abs(shifted) + 0.5)
         scaled = jnp.where(
             jnp.isnan(shifted) | jnp.isinf(shifted),
             jnp.int64(0),
-            jnp.round(shifted).astype(jnp.int64),
+            half_up.astype(jnp.int64),
         )
         overflow_f = jnp.isnan(shifted) | (jnp.abs(shifted) >= 2.0**63)
         valid = valid & ~overflow_f
@@ -657,25 +659,45 @@ def _eval_string_fns(expr: E.Expression, ctx: EvalContext):
     return None
 
 
+def _dec_parts(v: ColVal, dt: T.DataType):
+    """(scaled int64 data, scale) view of a decimal or integral operand —
+    Spark implicitly treats an integral as decimal(d, 0) in mixed decimal
+    arithmetic (DecimalPrecision integralToDecimal)."""
+    if isinstance(dt, T.DecimalType):
+        return v.data.astype(jnp.int64), dt.scale
+    return v.data.astype(jnp.int64), 0
+
+
+def _dec_to_f64(v: ColVal, dt: T.DecimalType) -> ColVal:
+    return ColVal(v.data.astype(jnp.float64) / (10.0 ** dt.scale), v.validity)
+
+
 def _eval_arith(expr: E.BinaryArithmetic, ctx: EvalContext) -> ColVal:
     out_t = expr.dtype
+    lt, rt = expr.left.dtype, expr.right.dtype
     l = eval_expr(expr.left, ctx)
     r = eval_expr(expr.right, ctx)
     valid = l.validity & r.validity
 
     if isinstance(out_t, T.DecimalType):
-        lt, rt = expr.left.dtype, expr.right.dtype
-        assert isinstance(lt, T.DecimalType) and isinstance(rt, T.DecimalType)
+        a, sa = _dec_parts(l, lt)
+        b, sb = _dec_parts(r, rt)
         if isinstance(expr, (E.Add, E.Subtract)):
             s = out_t.scale
-            a = l.data.astype(jnp.int64) * jnp.int64(10 ** (s - lt.scale))
-            b = r.data.astype(jnp.int64) * jnp.int64(10 ** (s - rt.scale))
+            a = a * jnp.int64(10 ** (s - sa))
+            b = b * jnp.int64(10 ** (s - sb))
             data = a + b if isinstance(expr, E.Add) else a - b
             return ColVal(data, valid)
         if isinstance(expr, E.Multiply):
-            data = l.data.astype(jnp.int64) * r.data.astype(jnp.int64)
-            return ColVal(data, valid)
+            # out scale == sa + sb: raw product of scaled values
+            return ColVal(a * b, valid)
         raise NotImplementedError(f"decimal {expr.symbol}")
+
+    # decimal ⊗ float -> double (Spark casts the decimal side)
+    if isinstance(lt, T.DecimalType):
+        l, lt = _dec_to_f64(l, lt), T.DOUBLE
+    if isinstance(rt, T.DecimalType):
+        r, rt = _dec_to_f64(r, rt), T.DOUBLE
 
     np_dtype = T.numpy_dtype(out_t)
     a = l.data.astype(np_dtype)
@@ -690,7 +712,7 @@ def _eval_arith(expr: E.BinaryArithmetic, ctx: EvalContext) -> ColVal:
     if isinstance(expr, E.Divide):
         a64 = l.data.astype(jnp.float64)
         b64 = r.data.astype(jnp.float64)
-        if expr.left.dtype in T.FRACTIONAL_TYPES or expr.right.dtype in T.FRACTIONAL_TYPES:
+        if lt in T.FRACTIONAL_TYPES or rt in T.FRACTIONAL_TYPES:
             # float/float division follows IEEE (x/0 = inf), Spark keeps that
             return ColVal((a64 / b64).astype(np_dtype), valid)
         zero = r.data == 0
@@ -728,7 +750,69 @@ def _eval_compare(expr: E.BinaryComparison, ctx: EvalContext) -> ColVal:
             return ColVal((eq & both) | neither, _all_valid(cap))
         raise NotImplementedError("string ordering comparison on device")
 
-    ct = _numeric_common(expr.left.dtype, expr.right.dtype)
+    lt, rt = expr.left.dtype, expr.right.dtype
+    if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+        if lt in T.FRACTIONAL_TYPES or rt in T.FRACTIONAL_TYPES:
+            # decimal vs float: compare as double
+            a = (_dec_to_f64(l, lt).data if isinstance(lt, T.DecimalType)
+                 else l.data.astype(jnp.float64))
+            b = (_dec_to_f64(r, rt).data if isinstance(rt, T.DecimalType)
+                 else r.data.astype(jnp.float64))
+        else:
+            # decimal vs decimal/integral: exact compare without rescaling
+            # UP (10^diff multiply overflows int64 for large operands) —
+            # compare (floor(a/10^d), remainder) against the coarser side
+            da, sa = _dec_parts(l, lt)
+            db, sb = _dec_parts(r, rt)
+            if sa == sb:
+                lt_m = da < db
+                eq_m = da == db
+            elif sa > sb:
+                d = jnp.int64(10 ** (sa - sb))
+                q = da // d  # floors toward -inf; rem in [0, d)
+                rm = da - q * d
+                lt_m = q < db
+                eq_m = (q == db) & (rm == 0)
+            else:
+                d = jnp.int64(10 ** (sb - sa))
+                q = db // d
+                rm = db - q * d
+                lt_m = (da < q) | ((da == q) & (rm > 0))
+                eq_m = (da == q) & (rm == 0)
+            valid = l.validity & r.validity
+            if isinstance(expr, E.EqualTo):
+                return ColVal(eq_m, valid)
+            if isinstance(expr, E.EqualNullSafe):
+                both = l.validity & r.validity
+                neither = ~l.validity & ~r.validity
+                return ColVal((eq_m & both) | neither, _all_valid(cap))
+            if isinstance(expr, E.LessThan):
+                return ColVal(lt_m, valid)
+            if isinstance(expr, E.GreaterThan):
+                return ColVal(~lt_m & ~eq_m, valid)
+            if isinstance(expr, E.LessThanOrEqual):
+                return ColVal(lt_m | eq_m, valid)
+            if isinstance(expr, E.GreaterThanOrEqual):
+                return ColVal(~lt_m, valid)
+            raise NotImplementedError(expr.symbol)
+        valid = l.validity & r.validity
+        if isinstance(expr, E.EqualTo):
+            return ColVal(a == b, valid)
+        if isinstance(expr, E.EqualNullSafe):
+            both = l.validity & r.validity
+            neither = ~l.validity & ~r.validity
+            return ColVal(((a == b) & both) | neither, _all_valid(cap))
+        if isinstance(expr, E.LessThan):
+            return ColVal(_nan_aware_lt(a, b), valid)
+        if isinstance(expr, E.GreaterThan):
+            return ColVal(_nan_aware_lt(b, a), valid)
+        if isinstance(expr, E.LessThanOrEqual):
+            return ColVal(~_nan_aware_lt(b, a), valid)
+        if isinstance(expr, E.GreaterThanOrEqual):
+            return ColVal(~_nan_aware_lt(a, b), valid)
+        raise NotImplementedError(expr.symbol)
+
+    ct = _numeric_common(lt, rt)
 
     def _coerce(data, src_t):
         if ct is None:

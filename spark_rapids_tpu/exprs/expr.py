@@ -248,14 +248,32 @@ def _numeric_widen(a: T.DataType, b: T.DataType) -> T.DataType:
     return order[max(order.index(a), order.index(b))]
 
 
+def _decimal_operands(lt: T.DataType, rt: T.DataType):
+    """Spark DecimalPrecision: an integral operand of a decimal op is an
+    implicit decimal(d, 0); float operands win (both -> double, caller
+    falls through to _numeric_widen). Returns (lt', rt') or None."""
+    ld, rd = isinstance(lt, T.DecimalType), isinstance(rt, T.DecimalType)
+    if not (ld or rd):
+        return None
+    digits = {T.BYTE: 3, T.SHORT: 5, T.INT: 10, T.LONG: 20}
+    if ld and rd:
+        return lt, rt
+    dec, other = (lt, rt) if ld else (rt, lt)
+    if other in digits:
+        od = T.DecimalType(digits[other], 0)
+        return (lt, od) if ld else (od, rt)
+    return None  # float side: double wins
+
+
 class BinaryArithmetic(_Binary):
     symbol = "?"
 
     @property
     def dtype(self):
         lt, rt = self.left.dtype, self.right.dtype
-        if isinstance(lt, T.DecimalType) and isinstance(rt, T.DecimalType):
-            return self._decimal_result(lt, rt)
+        pair = _decimal_operands(lt, rt)
+        if pair is not None:
+            return self._decimal_result(*pair)
         return _numeric_widen(lt, rt)
 
     def _decimal_result(self, lt: T.DecimalType, rt: T.DecimalType) -> T.DataType:
@@ -295,8 +313,9 @@ class Divide(BinaryArithmetic):
 
     @property
     def dtype(self):
-        lt, rt = self.left.dtype, self.right.dtype
-        if isinstance(lt, T.DecimalType) and isinstance(rt, T.DecimalType):
+        pair = _decimal_operands(self.left.dtype, self.right.dtype)
+        if pair is not None:
+            lt, rt = pair
             # Spark: s = max(6, s1 + p2 + 1); p = p1 - s1 + s2 + s
             s = max(6, lt.scale + rt.precision + 1)
             p = lt.precision - lt.scale + rt.scale + s
@@ -323,6 +342,12 @@ class IntegralDivide(BinaryArithmetic):
 class Remainder(BinaryArithmetic):
     symbol = "%"
 
+    def _decimal_result(self, lt, rt):
+        # Spark: s = max(s1,s2); p = min(p1-s1, p2-s2) + s
+        s = max(lt.scale, rt.scale)
+        p = min(lt.precision - lt.scale, rt.precision - rt.scale) + s
+        return T.DecimalType(min(max(p, 1), 38), min(s, 38))
+
     @property
     def nullable(self):
         return True
@@ -330,6 +355,8 @@ class Remainder(BinaryArithmetic):
 
 class Pmod(BinaryArithmetic):
     symbol = "pmod"
+
+    _decimal_result = Remainder._decimal_result
 
     @property
     def nullable(self):

@@ -43,8 +43,15 @@ def _col_np(table: pa.Table, i: int) -> Tuple[np.ndarray, np.ndarray]:
     elif dt in (T.STRING, T.BINARY):
         vals = np.array(arr.fill_null("").to_pylist(), dtype=object)
     elif isinstance(dt, T.DecimalType):
-        vals = np.array([int(v.scaleb(dt.scale)) if v is not None else 0
-                         for v in arr.to_pylist()], dtype=np.int64)
+        # p<=18 fits int64; wider decimals use Python-int object arrays so
+        # the CPU oracle stays exact at any precision (device: two-limb).
+        # scaleb under the default 28-digit context would round wide values.
+        import decimal as _dec
+        with _dec.localcontext() as _c:
+            _c.prec = 50
+            vals = np.array([int(v.scaleb(dt.scale)) if v is not None else 0
+                             for v in arr.to_pylist()],
+                            dtype=object if dt.precision > 18 else np.int64)
     elif dt == T.BOOLEAN:
         vals = np.asarray(arr.fill_null(False))
     else:
@@ -76,6 +83,9 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         if isinstance(expr.dtype, T.DecimalType):
             import decimal
             v = int(decimal.Decimal(v).scaleb(expr.dtype.scale))
+            if expr.dtype.precision > 18:
+                return np.array([v] * n, dtype=object), ones
+            return np.full(n, v, np.int64), ones
         if expr.dtype == T.STRING:
             return np.array([v] * n, dtype=object), ones
         return np.full(n, v), ones
@@ -85,6 +95,71 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
     if isinstance(expr, E.BinaryArithmetic):
         (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
         m = ma & mb
+        lt, rt = expr.left.dtype, expr.right.dtype
+        dec_in = (isinstance(lt, T.DecimalType)
+                  or isinstance(rt, T.DecimalType))
+        if dec_in and isinstance(expr, (E.IntegralDivide, E.Remainder,
+                                        E.Pmod)) and not (
+                lt in T.FRACTIONAL_TYPES or rt in T.FRACTIONAL_TYPES):
+            # exact decimal div/rem: rescale to the common scale in
+            # Python ints, then Java trunc-division semantics
+            sa, sb = _dec_scale(lt), _dec_scale(rt)
+            s = max(sa, sb)
+            ai = [int(x) * 10 ** (s - sa) for x in a]
+            bi = [int(x) * 10 ** (s - sb) for x in b]
+            def jrem(x, y):
+                q = abs(x) // abs(y) * (1 if (x >= 0) == (y >= 0) else -1)
+                return x - q * y
+
+            out = []
+            mm = m.copy()
+            for i, (x, y) in enumerate(zip(ai, bi)):
+                if y == 0:
+                    out.append(0)
+                    mm[i] = False
+                elif isinstance(expr, E.IntegralDivide):
+                    out.append(abs(x) // abs(y)
+                               * (1 if (x >= 0) == (y >= 0) else -1))
+                elif isinstance(expr, E.Pmod):
+                    out.append(jrem(jrem(x, y) + y, y))
+                else:
+                    out.append(jrem(x, y))
+            if isinstance(expr, E.IntegralDivide):
+                return np.array(out, np.int64), mm
+            return _dec_overflow(out, mm, expr.dtype)
+        if isinstance(expr.dtype, T.DecimalType):
+            sa, sb = _dec_scale(lt), _dec_scale(rt)
+            s = expr.dtype.scale
+            ai = [int(x) for x in a]
+            bi = [int(x) for x in b]
+            if isinstance(expr, (E.Add, E.Subtract)):
+                pa_, pb_ = 10 ** (s - sa), 10 ** (s - sb)
+                sign = 1 if isinstance(expr, E.Add) else -1
+                out = [x * pa_ + sign * y * pb_ for x, y in zip(ai, bi)]
+                return _dec_overflow(out, m, expr.dtype)
+            if isinstance(expr, E.Multiply):
+                return _dec_overflow([x * y for x, y in zip(ai, bi)],
+                                     m, expr.dtype)
+            if isinstance(expr, E.Divide):
+                # Spark decimal divide: exact HALF_UP at the result scale
+                shift = 10 ** (s - sa + sb)
+                out = []
+                mm = m.copy()
+                for i, (x, y) in enumerate(zip(ai, bi)):
+                    if y == 0:
+                        out.append(0)
+                        mm[i] = False
+                    else:
+                        num = x * shift
+                        out.append(_half_up_div(
+                            num if y > 0 else -num, abs(y)))
+                return _dec_overflow(out, mm, expr.dtype)
+            raise NotImplementedError(f"cpu decimal {type(expr).__name__}")
+        # decimal ⊗ float -> double (Spark casts the decimal side)
+        if isinstance(lt, T.DecimalType):
+            a = a.astype(np.float64) / (10.0 ** lt.scale)
+        if isinstance(rt, T.DecimalType):
+            b = b.astype(np.float64) / (10.0 ** rt.scale)
         if isinstance(expr, E.Add):
             return a + b, m
         if isinstance(expr, E.Subtract):
@@ -127,11 +202,22 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         if expr.left.dtype in (T.STRING, T.BINARY):
             eq = _obj_eq(a, b)
         else:
+            a, b = _dec_align(a, b, expr.left.dtype, expr.right.dtype)
             eq = (a == b) | (_isnan(a) & _isnan(b))
         return (eq & ma & mb) | (~ma & ~mb), ones
     if isinstance(expr, E.BinaryComparison):
         (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
         m = ma & mb
+        lt_, rt_ = expr.left.dtype, expr.right.dtype
+        if isinstance(lt_, T.DecimalType) or isinstance(rt_, T.DecimalType):
+            fa, fb = _dec_align(a, b, lt_, rt_)
+            name = type(expr).__name__
+            out = {"EqualTo": lambda: fa == fb,
+                   "LessThan": lambda: fa < fb,
+                   "GreaterThan": lambda: fa > fb,
+                   "LessThanOrEqual": lambda: fa <= fb,
+                   "GreaterThanOrEqual": lambda: fa >= fb}[name]()
+            return np.asarray(out, dtype=np.bool_), m
         if expr.left.dtype in (T.STRING, T.BINARY):
             cmp = {"EqualTo": lambda: _obj_eq(a, b),
                    "LessThan": lambda: _obj_cmp(a, b, "<"),
@@ -446,6 +532,53 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
     raise NotImplementedError(f"cpu eval {type(expr).__name__}")
 
 
+def _dec_scale(dt: T.DataType) -> int:
+    return dt.scale if isinstance(dt, T.DecimalType) else 0
+
+
+def _dec_array(vals, dt: T.DecimalType) -> np.ndarray:
+    return np.array(vals, dtype=object if dt.precision > 18 else np.int64)
+
+
+def _half_up_div(num: int, den: int) -> int:
+    """Exact ROUND_HALF_UP (away from zero) division; den > 0."""
+    q, r = divmod(abs(num), den)
+    if 2 * r >= den:
+        q += 1
+    return q if num >= 0 else -q
+
+
+def _dec_overflow(vals, m, dt: T.DecimalType):
+    """Spark non-ANSI decimal overflow -> NULL (values past 10^precision)."""
+    bound = 10 ** dt.precision
+    m = m.copy()
+    out = list(vals)
+    for i, v in enumerate(out):
+        if abs(v) >= bound:
+            out[i] = 0
+            m[i] = False
+    return _dec_array(out, dt), m
+
+
+def _dec_align(a, b, lt: T.DataType, rt: T.DataType):
+    """Coerce a decimal/other operand pair for comparison: floats win
+    (decimal -> double), otherwise exact compare at the common scale."""
+    if not (isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType)):
+        return a, b
+    if lt in T.FRACTIONAL_TYPES or rt in T.FRACTIONAL_TYPES:
+        fa = (a.astype(np.float64) / (10.0 ** _dec_scale(lt))
+              if isinstance(lt, T.DecimalType) else a.astype(np.float64))
+        fb = (b.astype(np.float64) / (10.0 ** _dec_scale(rt))
+              if isinstance(rt, T.DecimalType) else b.astype(np.float64))
+        return fa, fb
+    s = max(_dec_scale(lt), _dec_scale(rt))
+    fa = np.array([int(x) * 10 ** (s - _dec_scale(lt)) for x in a],
+                  dtype=object)
+    fb = np.array([int(y) * 10 ** (s - _dec_scale(rt)) for y in b],
+                  dtype=object)
+    return fa, fb
+
+
 def _null_fill(dtype: T.DataType, n: int) -> np.ndarray:
     """dtype-matched placeholder values for all-null columns (the device's
     _broadcast_literal analog); float64 zeros would silently corrupt int64
@@ -487,6 +620,49 @@ def _obj_cmp(a, b, op):
 def _cpu_cast(d, m, src: T.DataType, dst: T.DataType):
     if src == dst:
         return d, m
+    if isinstance(dst, T.DecimalType):
+        # mirrors device _cast_to_decimal (exprs/eval.py:309)
+        bound = 10 ** dst.precision
+        if isinstance(src, T.DecimalType):
+            diff = dst.scale - src.scale
+            if diff >= 0:
+                out = [int(x) * 10 ** diff for x in d]
+            else:
+                p = 10 ** (-diff)
+                out = [_half_up_div(int(x), p) for x in d]
+        elif src in T.INTEGRAL_TYPES:
+            out = [int(x) * 10 ** dst.scale for x in d]
+        else:
+            m = m.copy()
+            out = []
+            for i, x in enumerate(d):
+                fx = float(x) * (10.0 ** dst.scale)
+                if np.isnan(fx) or np.isinf(fx) or abs(fx) >= 2.0 ** 63:
+                    out.append(0)
+                    m[i] = False
+                else:
+                    out.append(int(np.sign(fx) * np.floor(abs(fx) + 0.5)))
+        m = m.copy()
+        for i, x in enumerate(out):
+            if abs(x) >= bound:
+                out[i] = 0
+                m[i] = False
+        return _dec_array(out, dst), m
+    if isinstance(src, T.DecimalType):
+        p = 10 ** src.scale
+        if dst in (T.FLOAT, T.DOUBLE):
+            return (np.array([float(x) for x in d])
+                    / float(p)).astype(T.numpy_dtype(dst)), m
+        if dst in T.INTEGRAL_TYPES:
+            whole = np.array([abs(int(x)) // p * (1 if x >= 0 else -1)
+                              for x in d], dtype=np.int64)
+            return _cpu_cast(whole, m, T.LONG, dst)
+        if dst == T.STRING:
+            import decimal
+            sc = decimal.Decimal(1).scaleb(-src.scale)
+            return np.array([str(decimal.Decimal(int(x)) * sc) for x in d],
+                            dtype=object), m
+        raise NotImplementedError(f"cpu cast {src}->{dst}")
     if dst == T.BOOLEAN:
         return d != 0, m
     if dst in T.INTEGRAL_TYPES:
@@ -518,9 +694,11 @@ def _values_to_arrow(vals: np.ndarray, valid: np.ndarray,
         return pa.array(py, pa.string())
     if isinstance(dt, T.DecimalType):
         import decimal
-        scale = decimal.Decimal(1).scaleb(-dt.scale)
-        py = [None if (mask is not None and mask[i])
-              else decimal.Decimal(int(vals[i])) * scale for i in range(len(vals))]
+        with decimal.localcontext() as dctx:
+            dctx.prec = 50  # default 28 silently rounds wide intermediates
+            py = [None if (mask is not None and mask[i])
+                  else decimal.Decimal(int(vals[i])).scaleb(-dt.scale)
+                  for i in range(len(vals))]
         return pa.array(py, dt.arrow_type())
     if dt == T.DATE:
         return pa.array(np.asarray(vals).astype(np.int32), pa.int32(),

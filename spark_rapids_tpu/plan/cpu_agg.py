@@ -103,14 +103,23 @@ class CpuAggregateExec(CpuExec, UnaryExec):
         schema = self.output_schema
         for i, kname in enumerate(key_names):
             vals = [order[g][i] for g in range(ng)]
-            out_arrays.append(pa.array(vals, schema[i].dtype.arrow_type()
-                                       if schema[i].dtype in (T.STRING,)
+            kdt = schema[i].dtype
+            if isinstance(kdt, T.DecimalType) or kdt in (T.DATE, T.TIMESTAMP):
+                nvals = np.array([0 if v is None else v for v in vals],
+                                 dtype=object)
+                nvalid = np.array([v is not None for v in vals], np.bool_)
+                out_arrays.append(_values_to_arrow(nvals, nvalid, kdt))
+                continue
+            out_arrays.append(pa.array(vals, kdt.arrow_type()
+                                       if kdt in (T.STRING,)
                                        else None))
-            if out_arrays[-1].type != schema[i].dtype.arrow_type():
-                out_arrays[-1] = out_arrays[-1].cast(schema[i].dtype.arrow_type())
+            if out_arrays[-1].type != kdt.arrow_type():
+                out_arrays[-1] = out_arrays[-1].cast(kdt.arrow_type())
         for (bound, name, vals, valid), f in zip(
                 agg_inputs, list(schema)[len(key_names):]):
             out = []
+            in_dt = bound.children[0].dtype if bound.children else None
+            dec_in = isinstance(in_dt, T.DecimalType)
             for g in range(ng):
                 sel = (gid == g) & valid
                 sel_any = (gid == g)
@@ -118,13 +127,31 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                     out.append(int(sel.sum()) if bound.children
                                else int(sel_any.sum()))
                 elif isinstance(bound, E.Sum):
-                    out.append(vals[sel].sum() if sel.any() else None)
+                    if not sel.any():
+                        out.append(None)
+                    elif dec_in:
+                        # exact Python-int sum (int64 numpy sum can overflow
+                        # at the promoted decimal(p+10) precision)
+                        out.append(sum(int(v) for v in vals[sel]))
+                    else:
+                        out.append(vals[sel].sum())
                 elif isinstance(bound, E.Min):
                     out.append(vals[sel].min() if sel.any() else None)
                 elif isinstance(bound, E.Max):
                     out.append(vals[sel].max() if sel.any() else None)
                 elif isinstance(bound, E.Average):
-                    out.append(float(vals[sel].mean()) if sel.any() else None)
+                    if not sel.any():
+                        out.append(None)
+                    elif dec_in:
+                        # Spark decimal avg: HALF_UP at scale(in)+4
+                        from spark_rapids_tpu.plan.cpu import _half_up_div
+                        ssum = sum(int(v) for v in vals[sel])
+                        cnt = int(sel.sum())
+                        shift = 10 ** (f.dtype.scale - in_dt.scale)
+                        out.append(_half_up_div(ssum * shift, cnt)
+                                   if cnt else None)
+                    else:
+                        out.append(float(vals[sel].mean()))
                 elif isinstance(bound, E.CountDistinct):
                     out.append(int(len(set(
                         v.item() if hasattr(v, "item") else v
@@ -135,10 +162,18 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                                          else -1]] if len(idxs) else None)
                 else:
                     raise NotImplementedError(type(bound).__name__)
-            out_arrays.append(pa.array(
-                [None if v is None else
-                 (v.item() if hasattr(v, "item") else v) for v in out]
-            ).cast(f.dtype.arrow_type()))
+            if isinstance(f.dtype, T.DecimalType):
+                bound = 10 ** f.dtype.precision
+                nvals = np.array([0 if v is None or abs(v) >= bound else v
+                                  for v in out], dtype=object)
+                nvalid = np.array([v is not None and abs(v) < bound
+                                   for v in out], np.bool_)
+                out_arrays.append(_values_to_arrow(nvals, nvalid, f.dtype))
+            else:
+                out_arrays.append(pa.array(
+                    [None if v is None else
+                     (v.item() if hasattr(v, "item") else v) for v in out]
+                ).cast(f.dtype.arrow_type()))
         yield pa.table(out_arrays, schema=schema.to_arrow())
 
 
@@ -352,7 +387,7 @@ class CpuWindowExec(CpuExec, UnaryExec):
                     dv, _ = cpu_eval(E.resolve(f.default, cs), t, cs)
                     res = res.fillna(np.atleast_1d(dv)[0])
             elif isinstance(f, E.AggregateExpression):
-                res = _cpu_window_agg(df, grouper, f, frame, cs, t)
+                res = _cpu_window_agg(df, grouper, f, frame, cs, t, okeys)
             else:
                 raise NotImplementedError(f"cpu window {type(f).__name__}")
             if hasattr(res, "reindex"):
@@ -370,6 +405,12 @@ class CpuWindowExec(CpuExec, UnaryExec):
         for (name, vals), fld in zip(out_cols.items(),
                                      list(out_schema)[len(list(cs)):]):
             mask = pd.isna(vals)
+            if isinstance(fld.dtype, T.DecimalType):
+                nvals = np.array([0 if m else int(v)
+                                  for v, m in zip(vals, mask)], dtype=object)
+                arrays.append(_values_to_arrow(nvals, ~np.asarray(mask),
+                                               fld.dtype))
+                continue
             arr = pa.array(
                 np.where(mask, 0, vals).astype(
                     T.numpy_dtype(fld.dtype), copy=False)
@@ -387,7 +428,22 @@ def _rank(df, grouper, okeys, method):
         return pd.Series(1, df.index)
     key = df[okeys].apply(tuple, axis=1)
     if grouper is None:
-        return key.rank(method=method).astype(int)
+        # rows are already sorted by the (asc/desc-aware) order keys —
+        # pandas .rank() would re-rank by raw value ASC, inverting desc
+        # keys (round-3 q44 bug); rank = position of first equal instead
+        first_pos = {}
+        seen = 0
+        dense = 0
+        ranks = []
+        prev = object()
+        for v in key:
+            seen += 1
+            if v != prev:
+                dense += 1
+                first_pos[v] = seen
+                prev = v
+            ranks.append(first_pos[v] if method == "min" else dense)
+        return pd.Series(ranks, df.index)
     # rank of the order tuple within each partition, respecting sort order:
     # rows are already partition-sorted, so rank = position of first equal
     out = []
@@ -425,11 +481,16 @@ def _ntile(df, grouper, n):
     return pd.concat([pd.Series(tile(len(g)), g.index) for _, g in grouper])
 
 
-def _cpu_window_agg(df, grouper, f, frame, cs, t):
+def _cpu_window_agg(df, grouper, f, frame, cs, t, okeys=()):
     import pandas as pd
 
     from spark_rapids_tpu.exprs import window as W
     from spark_rapids_tpu.plan.cpu import cpu_eval as _ce
+
+    kind = type(f).__name__
+    in_dt = E.resolve(f.children[0], cs).dtype if f.children else None
+    if isinstance(in_dt, T.DecimalType):
+        return _dec_window_agg(df, grouper, f, in_dt, frame, cs, t, okeys)
 
     if f.children:
         # vals is in ORIGINAL row order; df is partition-sorted and its
@@ -447,7 +508,6 @@ def _cpu_window_agg(df, grouper, f, frame, cs, t):
 
     groups = [df] if grouper is None else [g for _, g in grouper]
     pieces = []
-    kind = type(f).__name__
     for g in groups:
         gs = s.loc[g.index]
         if frame.is_unbounded_both or (frame.kind == "range"
@@ -456,7 +516,15 @@ def _cpu_window_agg(df, grouper, f, frame, cs, t):
                 pieces.append(_full_agg(gs, kind, g))
                 continue
         if frame.is_running or (frame.kind == "range" and frame.is_running):
-            pieces.append(_running_agg(gs, kind, g))
+            res = _running_agg(gs, kind, g)
+            if frame.kind == "range" and okeys:
+                # RANGE running frames include all peer rows tied on the
+                # order key (Spark default frame; the device exec scans to
+                # the peer-run end) — broadcast each run's last value
+                runs = g[list(okeys)].apply(tuple, axis=1)
+                run_id = (runs != runs.shift()).cumsum()
+                res = res.groupby(run_id).transform("last")
+            pieces.append(res)
             continue
         if frame.kind == "rows":
             lo = frame.start
@@ -464,6 +532,85 @@ def _cpu_window_agg(df, grouper, f, frame, cs, t):
             pieces.append(_rows_agg(gs, kind, lo, hi, g))
             continue
         raise NotImplementedError(f"cpu window frame {frame!r}")
+    return pd.concat(pieces)
+
+
+def _dec_window_agg(df, grouper, f, in_dt, frame, cs, t, okeys):
+    """Exact decimal window aggregation: Python-int sums, HALF_UP average —
+    mirrors the device int64 window path (exec/window.py _finish_agg)."""
+    import pandas as pd
+
+    from spark_rapids_tpu.exprs import window as W
+    from spark_rapids_tpu.plan.cpu import _half_up_div
+    from spark_rapids_tpu.plan.cpu import cpu_eval as _ce
+
+    kind = type(f).__name__
+    out_t = type(f)(E.resolve(f.children[0], cs)).dtype
+    vals, valid = _ce(E.resolve(f.children[0], cs), t, cs)
+    order = df.index.to_numpy()
+    ints = [int(vals[i]) for i in order]
+    ok = [bool(valid[i]) for i in order]
+
+    groups = [df] if grouper is None else [g for _, g in grouper]
+    pieces = []
+    pos_of = {idx: p for p, idx in enumerate(df.index)}
+    for g in groups:
+        gpos = [pos_of[i] for i in g.index]
+        n = len(gpos)
+        gi = [ints[p] for p in gpos]
+        gv = [ok[p] for p in gpos]
+
+        bound = 10 ** out_t.precision if isinstance(out_t, T.DecimalType) \
+            else None
+
+        def agg(i0, i1):
+            sel = [gi[j] for j in range(i0, i1 + 1) if gv[j]]
+            cnt = len(sel)
+            if kind == "Count":
+                return cnt, True
+            if not cnt:
+                return None, False
+            if kind == "Sum":
+                v = sum(sel)
+            elif kind == "Min":
+                v = min(sel)
+            elif kind == "Max":
+                v = max(sel)
+            elif kind == "Average":
+                shift = 10 ** (out_t.scale - in_dt.scale)
+                v = _half_up_div(sum(sel) * shift, cnt)
+            else:
+                raise NotImplementedError(f"cpu decimal window {kind}")
+            if bound is not None and abs(v) >= bound:
+                return None, False  # Spark non-ANSI overflow -> NULL
+            return v, True
+
+        if frame.is_unbounded_both:
+            bounds = [(0, n - 1)] * n
+        elif frame.is_running and frame.kind == "range" and okeys:
+            gk = [tuple(row) for row in g[list(okeys)].to_numpy()]
+            run_end = [0] * n
+            e = n - 1
+            for j in range(n - 1, -1, -1):
+                if j < n - 1 and gk[j] != gk[j + 1]:
+                    e = j
+                run_end[j] = e
+            bounds = [(0, run_end[j]) for j in range(n)]
+        elif frame.is_running:
+            bounds = [(0, j) for j in range(n)]
+        elif frame.kind == "rows":
+            lo, hi = frame.start, frame.end
+            bounds = [(0 if lo is None else max(0, j + lo),
+                       n - 1 if hi is None else min(n - 1, j + hi))
+                      for j in range(n)]
+        else:
+            raise NotImplementedError(f"cpu decimal window frame {frame!r}")
+
+        out = []
+        for b0, b1 in bounds:
+            v, has = agg(b0, b1)
+            out.append(v if has else None)
+        pieces.append(pd.Series(out, g.index, dtype=object))
     return pd.concat(pieces)
 
 

@@ -112,11 +112,13 @@ class DataFrame:
 
         # single-use handoff: device_plan_stats() leaves its (never-executed)
         # plan here so a following collect() doesn't re-run Overrides; an
-        # executed plan is never cached (shuffle state is cleaned up on use)
+        # executed plan is never cached (shuffle state is cleaned up on use),
+        # and the handoff is dropped if planning inputs changed in between
         cached = getattr(self, "_pplan", None)
-        if cached is not None:
-            self._pplan = None
-            return cached
+        self._pplan = None
+        if cached is not None and cached[0] == (self.conf,
+                                                self.shuffle_partitions):
+            return cached[1]
         return Overrides(self.conf, self.shuffle_partitions).apply(self.plan)
 
     def explain(self) -> str:
@@ -146,7 +148,8 @@ class DataFrame:
                 walk(c)
 
         walk(node)
-        self._pplan = node  # hand off to a following collect()
+        # hand off to a following collect(), keyed by the planning inputs
+        self._pplan = ((self.conf, self.shuffle_partitions), node)
         return {
             "total": counts["total"],
             "device": counts["device"],

@@ -96,6 +96,13 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
             if isinstance(bound, (E.Floor, E.Round)) and isinstance(
                     bound.children[0].dtype, T.DecimalType):
                 reasons.append("decimal floor/ceil/round not on device")
+            # decimal division/remainder needs exact wide intermediates
+            # (reference: jni DecimalUtils.divide128) — CPU fallback for now
+            if isinstance(bound, (E.Divide, E.IntegralDivide, E.Remainder,
+                                  E.Pmod)):
+                if any(isinstance(c.dtype, T.DecimalType)
+                       for c in bound.children):
+                    reasons.append("decimal division not on device")
             # probe regex compilability (reference: RegexParser transpiler
             # bail-outs -> willNotWorkOnGpu); patterns outside the DFA
             # subset fall back to CPU
@@ -200,11 +207,19 @@ class Overrides:
         node = meta.node
         child_schema = (node.children[0].schema if node.children else None)
         # every device node must be able to HOLD its output types on device
-        # (TypeChecks: the output type matrix applies to all operators)
+        # (TypeChecks: the output type matrix applies to all operators) —
+        # and its INPUTS: the host->device transition uploads the child's
+        # whole table, so a non-representable child column (decimal128)
+        # keeps this node on CPU until a projection drops it
         for f in node.schema:
             r = _check_dtype(f.dtype)
             if r:
                 meta.will_not_work(r)
+        for ch in node.children:
+            for f in ch.schema:
+                r = _check_dtype(f.dtype)
+                if r:
+                    meta.will_not_work(f"input {f.name}: {r}")
         if isinstance(node, L.Project):
             for e in node.exprs:
                 for r in check_expr(e, child_schema):
@@ -234,6 +249,20 @@ class Overrides:
                 for o in inner.spec.order_by:
                     for r in check_expr(o.child, child_schema):
                         meta.will_not_work(r)
+                # the window function's inputs and result type must be
+                # device-representable (e.g. sum(sum(decimal)) promotes
+                # past DECIMAL64 -> CPU window)
+                fn = inner.function
+                for c in getattr(fn, "children", ()) or ():
+                    for r in check_expr(c, child_schema):
+                        meta.will_not_work(r)
+                try:
+                    bound_fn = E.resolve(fn, child_schema)
+                    r = _check_dtype(bound_fn.dtype)
+                    if r:
+                        meta.will_not_work(r)
+                except (TypeError, KeyError, NotImplementedError) as ex:
+                    meta.will_not_work(str(ex))
         elif isinstance(node, L.Join):
             for e, s in ([(k, node.left.schema) for k in node.left_keys]
                          + [(k, node.right.schema) for k in node.right_keys]):
