@@ -89,7 +89,12 @@ class ColumnarBatch:
 def empty_batch(dtypes: Sequence[T.DataType], capacity: int = 1024) -> ColumnarBatch:
     cols = []
     for dt in dtypes:
-        if dt.fixed_width:
+        if (isinstance(dt, T.DecimalType)
+                and dt.precision > T.DecimalType.MAX_LONG_DIGITS):
+            z = jnp.zeros(capacity, jnp.int64)
+            cols.append(DeviceColumn(dt, z, jnp.zeros(capacity, jnp.bool_),
+                                     data2=z))
+        elif dt.fixed_width:
             cols.append(
                 make_fixed_column(dt, np.zeros(0, T.numpy_dtype(dt)), None, capacity)
             )
@@ -100,6 +105,27 @@ def empty_batch(dtypes: Sequence[T.DataType], capacity: int = 1024) -> ColumnarB
                 )
             )
     return ColumnarBatch(cols, jnp.int32(0))
+
+
+def _wide_decimal_from_arrow(arr: pa.Array, dt: T.DecimalType, cap: int,
+                             n: int) -> DeviceColumn:
+    """arrow decimal128 -> two-limb (hi, lo) int64 device column
+    (exec/int128.py representation)."""
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    valid = (None if arr.null_count == 0
+             else np.asarray(arr.is_valid(), dtype=np.bool_))
+    limbs = np.frombuffer(arr.buffers()[1], dtype=np.int64,
+                          count=2 * len(arr), offset=arr.offset * 16)
+    lo = np.zeros(cap, np.int64)
+    hi = np.zeros(cap, np.int64)
+    lo[:n] = limbs[0::2]
+    hi[:n] = limbs[1::2]
+    validity = np.zeros(cap, np.bool_)
+    validity[:n] = True if valid is None else valid
+    lo[~validity] = 0
+    hi[~validity] = 0
+    return DeviceColumn(dt, jnp.asarray(lo), jnp.asarray(validity),
+                        data2=jnp.asarray(hi))
 
 
 def _arrow_fixed_to_numpy(arr: pa.Array, dt: T.DataType):
@@ -294,7 +320,10 @@ def batch_from_arrow(
             # non-string dictionary values (or entries so long the decoded
             # worst case would overflow int32 offsets): plain layout
             arr = arr.cast(vt)
-        if dt.fixed_width:
+        if (isinstance(dt, T.DecimalType)
+                and dt.precision > T.DecimalType.MAX_LONG_DIGITS):
+            cols.append(_wide_decimal_from_arrow(arr, dt, cap, n))
+        elif dt.fixed_width:
             values, valid = _arrow_fixed_to_numpy(arr, dt)
             cols.append(make_fixed_column(dt, values, valid, cap))
         elif isinstance(dt, T.ArrayType):
@@ -373,17 +402,34 @@ def batch_to_arrow(batch: ColumnarBatch, schema: T.Schema) -> pa.Table:
                 pa.string() if dt == T.STRING else pa.binary())
             arrays.append(arr)
             continue
+        if col.is_wide_decimal:
+            from spark_rapids_tpu.exec import int128 as I128
+            import decimal as _d
+
+            lo = np.asarray(col.data)[:n]
+            hi = np.asarray(col.data2)[:n]
+            ints = I128.to_py_ints(hi, lo)  # already signed (hi is signed)
+            with _d.localcontext() as _c:
+                _c.prec = 50
+                pyvals = [
+                    None if (mask is not None and mask[i]) else
+                    _d.Decimal(v).scaleb(-dt.scale)
+                    for i, v in enumerate(ints)
+                ]
+            arrays.append(pa.array(pyvals, type=dt.arrow_type()))
+            continue
         if dt.fixed_width:
             values = np.asarray(col.data)[:n]
             if isinstance(dt, T.DecimalType):
                 import decimal as _d
 
-                scale = _d.Decimal(1).scaleb(-dt.scale)
-                pyvals = [
-                    None if (mask is not None and mask[i]) else
-                    _d.Decimal(int(values[i])) * scale
-                    for i in range(n)
-                ]
+                with _d.localcontext() as _c:
+                    _c.prec = 50
+                    pyvals = [
+                        None if (mask is not None and mask[i]) else
+                        _d.Decimal(int(values[i])).scaleb(-dt.scale)
+                        for i in range(n)
+                    ]
                 arr = pa.array(pyvals, type=dt.arrow_type())
             elif dt == T.DATE:
                 arr = pa.array(values.astype(np.int32), type=pa.int32(), mask=mask)

@@ -59,27 +59,40 @@ class DeviceColumn:
     dictionary: Optional["DeviceColumn"] = None  # only for dict-encoded
     dict_size: int = 0  # static: live entries in dictionary
     dict_max_len: int = 0  # static: longest dictionary entry in bytes
+    # DECIMAL128 (precision > 18): ``data`` holds the LOW 64 bits (unsigned
+    # semantics) and ``data2`` the signed HIGH limb; value = hi*2^64 + lo_u.
+    # Arithmetic lives in exec/int128.py. (cudf decimal128 analog.)
+    data2: Optional[jax.Array] = None
 
     def tree_flatten(self):
         aux = (self.dtype, self.offsets is not None,
-               self.dictionary is not None, self.dict_size, self.dict_max_len)
+               self.dictionary is not None, self.dict_size, self.dict_max_len,
+               self.data2 is not None)
         children = [self.data, self.validity]
         if self.offsets is not None:
             children.append(self.offsets)
         if self.dictionary is not None:
             children.append(self.dictionary)
+        if self.data2 is not None:
+            children.append(self.data2)
         return tuple(children), aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_offsets, has_dict, dict_size, dict_max_len = aux
+        (dtype, has_offsets, has_dict, dict_size, dict_max_len,
+         has_data2) = aux
         it = iter(children)
         data = next(it)
         validity = next(it)
         offsets = next(it) if has_offsets else None
         dictionary = next(it) if has_dict else None
+        data2 = next(it) if has_data2 else None
         return cls(dtype, data, validity, offsets, dictionary, dict_size,
-                   dict_max_len)
+                   dict_max_len, data2)
+
+    @property
+    def is_wide_decimal(self) -> bool:
+        return self.data2 is not None
 
     @property
     def is_dict(self) -> bool:
@@ -103,6 +116,8 @@ class DeviceColumn:
             n += self.offsets.size * 4
         if self.dictionary is not None:
             n += self.dictionary.nbytes()
+        if self.data2 is not None:
+            n += self.data2.size * self.data2.dtype.itemsize
         return n
 
     def as_colval(self) -> ColVal:

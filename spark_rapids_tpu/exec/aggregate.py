@@ -342,21 +342,35 @@ class HashAggregateExec(UnaryExec):
             code = jnp.where(c.validity, code, card - 1)  # null key slot
             ids = ids * card + code
         f64 = jnp.float64
-        mm_rows: List[jax.Array] = [active.astype(f64)]  # group-exists count
         in_vals = {}
         for s in self._specs:
             ii = s.input_index
             if ii is not None and ii not in in_vals:
                 in_vals[ii] = EV.eval_expr(self._pre_bound[ii], ctx)
 
-        LIMB = 21
-        MASK = (1 << LIMB) - 1
-        plans = []  # per buffer: how to assemble from matmul rows / scatters
-        row_cache = {}  # (kind, input_index) -> row offset; dedups shared
-                        # inputs (Sum(x) + Average(x) share all their rows)
+        # Three reduction lanes, all MXU/streaming — no scatter:
+        #   flag_rows  bool 0/1      -> one int8 matmul (counts, NaN flags)
+        #   int_rows   int64 values  -> 7-bit-limb int8 matmul (exact mod
+        #                               2^64 = Java long-sum wrap semantics)
+        #   f64_rows   double values -> fused masked reductions (exact f64)
+        flag_rows: List[jax.Array] = [active]  # row 0: group-exists count
+        int_rows: List[jax.Array] = []
+        f64_rows: List[jax.Array] = []
+        w_rows: List[jax.Array] = []   # 128-bit sum lanes (DECIMAL128)
+        w_neg: List[Optional[int]] = []  # flag-row index for neg correction
+        w_hi_lane = {}  # w_row index -> int_rows index carrying hi limbs
+        plans = []  # per buffer: how to assemble from the lane outputs
+        flag_cache = {"__active__": 0}
+        row_cache = {}  # dedups shared inputs (Sum(x)+Average(x))
 
         def nullable(ii):
             return self._pre_bound[ii].nullable
+
+        def flag_row(key, arr):
+            if key not in flag_cache:
+                flag_cache[key] = len(flag_rows)
+                flag_rows.append(arr)
+            return flag_cache[key]
 
         for s in self._specs:
             v = in_vals.get(s.input_index)
@@ -366,52 +380,85 @@ class HashAggregateExec(UnaryExec):
                     plans.append(("count", 0, bt))  # row 0 = active count
                     continue
                 if op == "count":
-                    key = ("count", ii)
-                    if key not in row_cache:
-                        row_cache[key] = len(mm_rows)
-                        mm_rows.append((active & v.validity).astype(f64))
-                    plans.append(("count", row_cache[key], bt))
+                    r = flag_row(("live", ii), active & v.validity)
+                    plans.append(("count", r, bt))
                     continue
                 if op == "sum":
                     live = active & v.validity
+                    wide_buf = (isinstance(bt, T.DecimalType)
+                                and bt.precision > T.DecimalType.MAX_LONG_DIGITS)
+                    if wide_buf or isinstance(v, EV.WideVal):
+                        wkey = ("wisum", ii)
+                        if wkey not in row_cache:
+                            row_cache[wkey] = len(w_rows)
+                            if isinstance(v, EV.WideVal):
+                                # lo residues ARE the unsigned lo limbs
+                                w_rows.append(jnp.where(live, v.lo, 0))
+                                w_neg.append(None)
+                                w_hi_lane[len(w_rows) - 1] = len(int_rows)
+                                int_rows.append(jnp.where(live, v.hi, 0))
+                            else:
+                                x = v.data.astype(jnp.int64)
+                                w_rows.append(jnp.where(live, x, 0))
+                                w_neg.append(flag_row(("neg", ii),
+                                                      live & (x < 0)))
+                        vrow = flag_row(("live", ii), live) \
+                            if nullable(ii) else 0
+                        plans.append(("wisum", row_cache[wkey], vrow, bt))
+                        continue
                     if jnp.issubdtype(v.data.dtype, jnp.floating):
                         key = ("fsum", ii)
                         if key not in row_cache:
-                            row_cache[key] = len(mm_rows)
+                            row_cache[key] = len(f64_rows)
                             # canonical values: NaNs -> 0 so they cannot
-                            # poison the matmul; NaN presence rides its own
-                            # count row. Non-nullable inputs reuse row 0 as
-                            # their validity count.
+                            # poison the sums; NaN presence rides its own
+                            # flag row
                             d, is_nan = K._float_canonical(v.data)
-                            mm_rows.append(jnp.where(live, d, 0.0))
-                            mm_rows.append((live & is_nan).astype(f64))
-                            if nullable(ii):
-                                mm_rows.append(live.astype(f64))
-                        r = row_cache[key]
-                        vrow = r + 2 if nullable(ii) else 0
-                        plans.append(("fsum", r, r + 1, vrow, bt))
+                            f64_rows.append(jnp.where(live, d, 0.0))
+                            row_cache[("fnan", ii)] = flag_row(
+                                ("nan", ii), live & is_nan)
+                        nan_r = row_cache[("fnan", ii)]
+                        vrow = flag_row(("live", ii), live) \
+                            if nullable(ii) else 0
+                        plans.append(("fsum", row_cache[key], nan_r, vrow,
+                                      bt))
                         continue
                     key = ("isum", ii)
                     if key not in row_cache:
-                        row_cache[key] = len(mm_rows)
+                        row_cache[key] = len(int_rows)
                         x = v.data.astype(jnp.int64)
-                        x = jnp.where(live, x, 0)
-                        mm_rows.append((x & MASK).astype(f64))
-                        mm_rows.append(((x >> LIMB) & MASK).astype(f64))
-                        mm_rows.append((x >> (2 * LIMB)).astype(f64))
-                        if nullable(ii):
-                            mm_rows.append(live.astype(f64))
-                    r = row_cache[key]
-                    vrow = r + 3 if nullable(ii) else 0
-                    plans.append(("isum", r, vrow, bt))
+                        int_rows.append(jnp.where(live, x, 0))
+                    vrow = flag_row(("live", ii), live) \
+                        if nullable(ii) else 0
+                    plans.append(("isum", row_cache[key], vrow, bt))
                     continue
                 # min/max/first/last: scatter path over the tiny id domain
-                plans.append(("seg", op, v, bt))
-        sums = K.dense_segment_sums(jnp.stack(mm_rows), ids, Gc)
-        # materialize the (R, Gc) sums once; without a barrier XLA fusion may
-        # re-run the whole reduction inside each consumer column
-        sums = jax.lax.optimization_barrier(sums)
-        exists = sums[0] > 0.5
+                if isinstance(v, EV.WideVal):
+                    plans.append(("wseg", op, v, bt))
+                else:
+                    plans.append(("seg", op, v, bt))
+        # barriers sit on the TINY (R, Gc) outputs so XLA cannot re-run a
+        # whole reduction per consumer column, while the big row builds
+        # still fuse INTO their reductions
+        counts = jax.lax.optimization_barrier(
+            K.dense_segment_counts(flag_rows, ids, Gc))
+        isums = jax.lax.optimization_barrier(
+            K.dense_segment_sums_int(int_rows, ids, Gc)) if int_rows \
+            else None
+        fsums = jax.lax.optimization_barrier(
+            K.dense_segment_sums(jnp.stack(f64_rows), ids, Gc)) \
+            if f64_rows else None
+        wsums = None
+        if w_rows:
+            negc = jnp.stack([
+                counts[r] if r is not None else jnp.zeros(Gc, jnp.int32)
+                for r in w_neg])
+            wh, wl = K.dense_segment_sums_int128(w_rows, ids, Gc, negc)
+            for wi, ir in w_hi_lane.items():
+                wh = wh.at[wi].add(isums[ir])  # + Σhi·2^64 (mod 2^64)
+            wsums = (jax.lax.optimization_barrier(wh),
+                     jax.lax.optimization_barrier(wl))
+        exists = counts[0] > 0
         g = jnp.arange(Gc, dtype=jnp.int32)
         in_domain = g < G
         exists = exists & in_domain
@@ -439,25 +486,61 @@ class HashAggregateExec(UnaryExec):
         for plan in plans:
             if plan[0] == "count":
                 _, r, bt = plan
-                data = jnp.where(exists, sums[r].astype(jnp.int64), 0)
+                data = jnp.where(exists, counts[r].astype(jnp.int64), 0)
                 # counts are never null (a rowless global agg counts 0)
                 buf_cols.append(DeviceColumn(bt, data, jnp.ones(Gc, jnp.bool_)))
             elif plan[0] == "fsum":
                 _, r, nan_r, vrow, bt = plan
-                nan_any = sums[nan_r] > 0.5
-                data = jnp.where(nan_any, jnp.float64(jnp.nan), sums[r])
-                valid = (sums[vrow] > 0.5) & exists
+                nan_any = counts[nan_r] > 0
+                data = jnp.where(nan_any, jnp.float64(jnp.nan), fsums[r])
+                valid = (counts[vrow] > 0) & exists
                 data = jnp.where(valid, data, 0.0).astype(T.numpy_dtype(bt))
                 buf_cols.append(DeviceColumn(bt, data, valid))
             elif plan[0] == "isum":
                 _, r, vrow, bt = plan
-                lo = sums[r].astype(jnp.int64)
-                mid = sums[r + 1].astype(jnp.int64)
-                hi = sums[r + 2].astype(jnp.int64)
-                data = (hi << (2 * LIMB)) + (mid << LIMB) + lo  # wraps mod 2^64
-                valid = (sums[vrow] > 0.5) & exists
-                data = jnp.where(valid, data, 0).astype(T.numpy_dtype(bt))
+                valid = (counts[vrow] > 0) & exists
+                data = jnp.where(valid, isums[r], 0).astype(T.numpy_dtype(bt))
                 buf_cols.append(DeviceColumn(bt, data, valid))
+            elif plan[0] == "wisum":
+                _, r, vrow, bt = plan
+                valid = (counts[vrow] > 0) & exists
+                lo = jnp.where(valid, wsums[1][r], 0)
+                hi = jnp.where(valid, wsums[0][r], 0)
+                buf_cols.append(DeviceColumn(bt, lo, valid, data2=hi))
+            elif plan[0] == "wseg":
+                _, op, v, bt = plan
+                from spark_rapids_tpu.exec import int128 as I128
+
+                live = active & v.validity
+                idx = jnp.arange(cap, dtype=jnp.int32)
+                seg = jnp.where(live, ids, Gc)
+                if op in ("first", "last"):
+                    pick = jnp.where(live, idx, cap if op == "first" else -1)
+                    sel = (jax.ops.segment_min if op == "first"
+                           else jax.ops.segment_max)(
+                        pick, seg, num_segments=Gc + 1)[:Gc]
+                else:
+                    kh, kl = I128.sortable_keys(v.hi, v.lo)
+                    if op == "min":
+                        red, ident = jax.ops.segment_min, jnp.int64(2**63 - 1)
+                    else:
+                        red, ident = jax.ops.segment_max, jnp.int64(-2**63)
+                    hm = jnp.where(live, kh, ident)
+                    rh = red(hm, seg, num_segments=Gc + 1)[:Gc]
+                    tie = live & (hm == rh[jnp.clip(ids, 0, Gc - 1)])
+                    lm = jnp.where(tie, kl, ident)
+                    rl = red(lm, seg, num_segments=Gc + 1)[:Gc]
+                    isel = jnp.where(tie & (lm == rl[jnp.clip(ids, 0, Gc - 1)]),
+                                     idx, cap)
+                    sel = jax.ops.segment_min(isel, seg,
+                                              num_segments=Gc + 1)[:Gc]
+                any_v = jax.ops.segment_max(
+                    live.astype(jnp.int32), seg, num_segments=Gc + 1)[:Gc] > 0
+                valid = any_v & exists
+                sel_c = jnp.clip(sel, 0, cap - 1)
+                lo = jnp.where(valid, v.lo[sel_c], 0)
+                hi = jnp.where(valid, v.hi[sel_c], 0)
+                buf_cols.append(DeviceColumn(bt, lo, valid, data2=hi))
             else:
                 _, op, v, bt = plan
                 data, avalid = K.segment_agg(
@@ -548,6 +631,10 @@ class HashAggregateExec(UnaryExec):
                                      avalid & out_row_valid, data.offsets)
                     )
                     continue
+                if src is not None and src.is_wide_decimal:
+                    out_cols.append(self._wide_agg(
+                        src, gi, contributing, op, bt, cap, out_row_valid))
+                    continue
                 data, avalid = K.segment_agg(vals, valid, contributing, gi.segment_ids,
                                              cap, op, ends=seg_ends,
                                              starts=gi.group_starts)
@@ -557,6 +644,57 @@ class HashAggregateExec(UnaryExec):
                                                            jnp.zeros_like(data)),
                                              avalid & out_row_valid))
         return ColumnarBatch(out_cols, gi.num_groups)
+
+    def _wide_agg(self, src: DeviceColumn, gi: K.GroupInfo, contributing,
+                  op: str, bt, cap: int, out_row_valid) -> DeviceColumn:
+        """Segment reduction over a DECIMAL128 (hi, lo) column."""
+        from spark_rapids_tpu.exec import int128 as I128
+
+        lo = src.data[gi.perm]
+        hi = src.data2[gi.perm]
+        valid = src.validity[gi.perm]
+        live = contributing & valid
+        any_valid = jax.ops.segment_max(
+            live.astype(jnp.int32), gi.segment_ids, num_segments=cap) > 0
+        v_out = any_valid & out_row_valid
+        if op in ("count", "count_all"):
+            flags = contributing if op == "count_all" else live
+            c = jax.ops.segment_sum(flags.astype(jnp.int64), gi.segment_ids,
+                                    num_segments=cap)
+            return DeviceColumn(bt, jnp.where(out_row_valid, c, 0),
+                                out_row_valid)
+        if op == "sum":
+            h, l = K.segment_sum_int128(
+                jnp.where(live, hi, 0), jnp.where(live, lo, 0),
+                gi.segment_ids, cap)
+            return DeviceColumn(bt, jnp.where(v_out, l, 0), v_out,
+                                data2=jnp.where(v_out, h, 0))
+        if op in ("min", "max", "first", "last"):
+            idx = jnp.arange(cap, dtype=jnp.int32)
+            if op in ("first", "last"):
+                pick = jnp.where(live, idx, cap if op == "first" else -1)
+                sel = (jax.ops.segment_min if op == "first"
+                       else jax.ops.segment_max)(
+                    pick, gi.segment_ids, num_segments=cap)
+            else:
+                # two-stage lexicographic reduce: signed hi, then unsigned lo
+                kh, kl = I128.sortable_keys(hi, lo)
+                if op == "min":
+                    red, ident = jax.ops.segment_min, jnp.int64(2**63 - 1)
+                else:
+                    red, ident = jax.ops.segment_max, jnp.int64(-2**63)
+                hm = jnp.where(live, kh, ident)
+                rh = red(hm, gi.segment_ids, num_segments=cap)
+                tie = live & (hm == rh[gi.segment_ids])
+                lm = jnp.where(tie, kl, ident)
+                rl = red(lm, gi.segment_ids, num_segments=cap)
+                isel = jnp.where(tie & (lm == rl[gi.segment_ids]), idx, cap)
+                sel = jax.ops.segment_min(isel, gi.segment_ids,
+                                          num_segments=cap)
+            rows = gi.perm[jnp.clip(sel, 0, cap - 1)]
+            out = K.gather_column(src, rows, v_out)
+            return DeviceColumn(bt, out.data, v_out, data2=out.data2)
+        raise NotImplementedError(f"decimal128 segment {op}")
 
     def _string_agg(self, src: DeviceColumn, gi: K.GroupInfo, contributing,
                     op: str, cap: int):
@@ -604,6 +742,48 @@ class HashAggregateExec(UnaryExec):
             if isinstance(s.func, E.Average):
                 ssum, cnt = bufs
                 nz = cnt.data > 0
+                if ssum.is_wide_decimal:
+                    from spark_rapids_tpu.exec import int128 as I128
+
+                    in_t = s.func.child.dtype
+                    # the sum intermediate overflows like Sum does -> NULL
+                    sum_ovf = I128.overflow_mask(
+                        ssum.data2, ssum.data, s.buffer_types[0].precision)
+                    d = rt.scale - in_t.scale
+                    S = 10 ** d
+                    den = jnp.maximum(cnt.data, 1).astype(jnp.int64)
+                    # divide FIRST, then scale the (small) remainder:
+                    # sum*10^d could wrap 2^127 before dividing.
+                    ah, al = I128.abs_(ssum.data2, ssum.data)
+                    q1h, q1l, r = I128._udivmod_small(ah, al, den)
+                    # |q1| >= 10^(p-d)  =>  |result| >= 10^p -> NULL
+                    pre_ovf = I128.overflow_mask(q1h, q1l, rt.precision - d)
+                    frac = r * jnp.int64(S)  # < 2^31 * 10^d
+                    f_q = frac // den
+                    f_r = frac - f_q * den
+                    f_q = f_q + (2 * f_r >= den).astype(jnp.int64)
+                    qh, ql = I128.mul_small(q1h, q1l, S)
+                    qh, ql = I128.add(qh, ql, jnp.zeros_like(f_q), f_q)
+                    nh, nl2 = I128.neg(qh, ql)
+                    neg = I128.is_neg(ssum.data2, ssum.data)
+                    qh = jnp.where(neg, nh, qh)
+                    ql = jnp.where(neg, nl2, ql)
+                    res_ovf = I128.overflow_mask(qh, ql, rt.precision)
+                    valid = (ssum.validity & nz & ~sum_ovf & ~pre_ovf
+                             & ~res_ovf)
+                    wide_rt = (rt.precision
+                               > T.DecimalType.MAX_LONG_DIGITS)
+                    if wide_rt:
+                        out_cols.append(DeviceColumn(
+                            rt, jnp.where(valid, ql, 0), valid,
+                            data2=jnp.where(valid, qh, 0)))
+                    else:
+                        fits = qh == jnp.where(ql < 0, jnp.int64(-1),
+                                               jnp.int64(0))
+                        valid = valid & fits
+                        out_cols.append(DeviceColumn(
+                            rt, jnp.where(valid, ql, 0), valid))
+                    continue
                 if isinstance(rt, T.DecimalType):
                     in_t = s.func.child.dtype
                     # avg = sum/count rounded HALF_UP at result scale
@@ -630,6 +810,15 @@ class HashAggregateExec(UnaryExec):
                     out_cols.append(b)  # dict string min/max/first/last
                 elif b.offsets is not None:
                     out_cols.append(DeviceColumn(rt, b.data, b.validity, b.offsets))
+                elif b.is_wide_decimal:
+                    from spark_rapids_tpu.exec import int128 as I128
+
+                    # Sum results: Spark overflow -> NULL past precision
+                    ovf = I128.overflow_mask(b.data2, b.data, rt.precision)
+                    valid = b.validity & ~ovf
+                    out_cols.append(DeviceColumn(
+                        rt, jnp.where(valid, b.data, 0), valid,
+                        data2=jnp.where(valid, b.data2, 0)))
                 else:
                     out_cols.append(
                         DeviceColumn(rt, b.data.astype(T.numpy_dtype(rt)), b.validity)

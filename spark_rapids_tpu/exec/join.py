@@ -219,6 +219,11 @@ def _pad_idx(idx: jax.Array, out_cap: int) -> jax.Array:
 
 
 def _null_column(dtype: T.DataType, capacity: int) -> DeviceColumn:
+    if (isinstance(dtype, T.DecimalType)
+            and dtype.precision > T.DecimalType.MAX_LONG_DIGITS):
+        z = jnp.zeros(capacity, jnp.int64)
+        return DeviceColumn(dtype, z, jnp.zeros(capacity, jnp.bool_),
+                            data2=z)
     if dtype.fixed_width:
         return DeviceColumn(
             dtype, jnp.zeros(capacity, T.numpy_dtype(dtype)),

@@ -69,8 +69,13 @@ def gather_column(
     if col.offsets is None:
         data = col.data[safe_idx]
         data = jnp.where(row_valid & validity, data, jnp.zeros_like(data))
+        data2 = None
+        if col.data2 is not None:
+            data2 = col.data2[safe_idx]
+            data2 = jnp.where(row_valid & validity, data2,
+                              jnp.zeros_like(data2))
         return DeviceColumn(col.dtype, data, validity, None, col.dictionary,
-                            col.dict_size, col.dict_max_len)
+                            col.dict_size, col.dict_max_len, data2)
     lens = col.offsets[1:] - col.offsets[:-1]
     out_lens = jnp.where(row_valid, lens[safe_idx], 0)
     out_offsets = jnp.concatenate(
@@ -223,6 +228,13 @@ def sortable_keys(
     elif dt in (T.STRING, T.BINARY):
         pk = string_prefix_keys(col)  # [hi_word, lo_word]; emit lo-first
         data_keys = [pk[1], pk[0]]
+        if not ascending:
+            data_keys = [~k for k in data_keys]
+    elif col.is_wide_decimal:
+        from spark_rapids_tpu.exec import int128 as I128
+
+        kh, kl = I128.sortable_keys(col.data2, col.data)
+        data_keys = [kl, kh]  # least-significant first
         if not ascending:
             data_keys = [~k for k in data_keys]
     elif dt in T.FRACTIONAL_TYPES:
@@ -756,6 +768,114 @@ def dense_segment_sums(rows: jax.Array, ids: jax.Array, num_ids: int
     return jnp.stack(outs, axis=1)
 
 
+_INT8_LIMB = 7
+_INT8_NLIMBS = 10  # 10 x 7 = 70 bits >= 64: full two's-complement coverage
+
+
+def dense_segment_sums_int(rows: Sequence[jax.Array], ids: jax.Array,
+                           num_ids: int) -> jax.Array:
+    """Exact int64 per-id sums on the MXU: (R x (n,) int64) -> (R, num_ids).
+
+    TPU-first design with no cuDF analog: each int64 value is decomposed
+    into 10 unsigned 7-bit limbs (via uint64 logical shifts, so negative
+    values are their two's-complement residues), every limb row is summed
+    per id by ONE int8 x int8 -> int32 matmul against the one-hot id matrix
+    (native int8 MXU path, exact), and limb sums are recombined in uint64.
+    All arithmetic is exact mod 2^64 — identical to Java/Spark long-sum
+    wraparound semantics.
+
+    Per-limb per-id sums stay below 127 * n; n <= 2^24 keeps them inside
+    int32. Masked rows must carry value 0 (their id may be anything valid).
+    """
+    s64 = _limb_matmul(rows, ids, num_ids)
+    total = jnp.zeros((len(rows), num_ids), jnp.uint64)
+    for j in range(_INT8_NLIMBS):
+        total = total + (s64[:, j, :] << (_INT8_LIMB * j))
+    return total.astype(jnp.int64)
+
+
+def _limb_matmul(rows: Sequence[jax.Array], ids: jax.Array,
+                 num_ids: int) -> jax.Array:
+    """(R x (n,) int64) -> per-id 7-bit-limb sums (R, 10, num_ids) uint64."""
+    n = ids.shape[0]
+    assert n <= (1 << 24), "int8-limb path needs per-id limb sums < 2^31"
+    oh = (ids[:, None] == jnp.arange(num_ids, dtype=jnp.int32)[None, :]
+          ).astype(jnp.int8)
+    limb_rows = []
+    for r in rows:
+        xu = r.astype(jnp.uint64)
+        for j in range(_INT8_NLIMBS):
+            limb_rows.append(
+                ((xu >> (_INT8_LIMB * j)) & 127).astype(jnp.int8))
+    L = jnp.stack(limb_rows)  # (R*10, n) int8
+    s = jax.lax.dot_general(L, oh, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    return s.astype(jnp.uint64).reshape(len(rows), _INT8_NLIMBS, num_ids)
+
+
+def dense_segment_sums_int128(rows: Sequence[jax.Array], ids: jax.Array,
+                              num_ids: int, neg_counts: jax.Array):
+    """Exact 128-bit per-id sums of int64 rows: -> (hi, lo) (R, num_ids).
+
+    Limb sums recombine into (hi, lo) pairs with carries; residue
+    recombination counts each negative input as +2^64, corrected by
+    ``neg_counts`` ((R, num_ids) int32: negatives per id per row).
+    """
+    from spark_rapids_tpu.exec import int128 as I128
+
+    s64 = _limb_matmul(rows, ids, num_ids)
+    R = len(rows)
+    hi = jnp.zeros((R, num_ids), jnp.int64)
+    lo = jnp.zeros((R, num_ids), jnp.int64)
+    for j in range(_INT8_NLIMBS):
+        s = s64[:, j, :]  # uint64, < 2^31
+        sh = _INT8_LIMB * j
+        t_lo = (s << sh).astype(jnp.int64)
+        t_hi = (s >> (64 - sh)).astype(jnp.int64) if sh > 0 else \
+            jnp.zeros_like(t_lo)
+        hi, lo = I128.add(hi, lo, t_hi, t_lo)
+    # residues counted negatives as v + 2^64 -> subtract 2^64 per negative
+    hi = hi - neg_counts.astype(jnp.int64)
+    return hi, lo
+
+
+def segment_sum_int128(hi: jax.Array, lo: jax.Array, seg_ids: jax.Array,
+                       num_segments: int):
+    """Scatter-based exact 128-bit segment sums for (hi, lo) columns
+    (merge passes over small partial batches; the dense MXU path handles
+    the large first pass).  Decomposes lo into 32-bit halves so int64
+    scatter-adds cannot lose carries (n < 2^31)."""
+    lo_u = lo.astype(jnp.uint64)
+    lo0 = (lo_u & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
+    lo1 = (lo_u >> 32).astype(jnp.int64)
+    s_lo0 = jax.ops.segment_sum(lo0, seg_ids, num_segments=num_segments)
+    s_lo1 = jax.ops.segment_sum(lo1, seg_ids, num_segments=num_segments)
+    s_hi = jax.ops.segment_sum(hi, seg_ids, num_segments=num_segments)
+    from spark_rapids_tpu.exec import int128 as I128
+
+    # total_lo_u = s_lo0 + s_lo1 * 2^32 as 128-bit
+    h = (s_lo1.astype(jnp.uint64) >> 32).astype(jnp.int64)
+    l = (s_lo1.astype(jnp.uint64) << 32).astype(jnp.int64)
+    h2, l2 = I128.add(h, l, jnp.zeros_like(s_lo0), s_lo0)
+    # + s_hi * 2^64 (mod 2^128: only the hi limb) ... but s_hi summed lo's
+    # SIGNED values? No: hi rows are the stored signed hi limbs; their sum
+    # mod 2^64 is the hi contribution. Residue correction: none needed for
+    # lo (we summed unsigned halves exactly).
+    h3 = h2 + s_hi
+    return h3, l2
+
+
+def dense_segment_counts(flags: Sequence[jax.Array], ids: jax.Array,
+                         num_ids: int) -> jax.Array:
+    """Per-id counts of boolean flag rows via one int8 matmul:
+    (R x (n,) bool) -> (R, num_ids) int32. Exact for n < 2^31 / 1."""
+    oh = (ids[:, None] == jnp.arange(num_ids, dtype=jnp.int32)[None, :]
+          ).astype(jnp.int8)
+    L = jnp.stack([f.astype(jnp.int8) for f in flags])
+    return jax.lax.dot_general(L, oh, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
+
+
 # ---------------------------------------------------------------------------
 # Device concatenation (GpuCoalesceBatches concat, on device)
 # ---------------------------------------------------------------------------
@@ -785,6 +905,8 @@ def concat_device(
         if not is_string:
             data = jnp.zeros(out_capacity, batches[0].columns[ci].data.dtype)
             validity = jnp.zeros(out_capacity, jnp.bool_)
+            wide = batches[0].columns[ci].data2 is not None
+            data2 = jnp.zeros(out_capacity, jnp.int64) if wide else None
             for b, st in zip(batches, starts):
                 c = b.columns[ci]
                 j = jnp.arange(c.capacity, dtype=jnp.int32)
@@ -792,12 +914,14 @@ def concat_device(
                 pos = jnp.where(live, st + j, out_capacity)  # OOB drops
                 data = data.at[pos].set(c.data, mode="drop")
                 validity = validity.at[pos].set(c.validity, mode="drop")
+                if wide:
+                    data2 = data2.at[pos].set(c.data2, mode="drop")
             # dict codes concat only when every input shares one dictionary
             # (the concat_jit host wrapper decodes mismatched dicts first)
             first = batches[0].columns[ci]
             out_cols.append(DeviceColumn(dtype, data, validity, None,
                                          first.dictionary, first.dict_size,
-                                         first.dict_max_len))
+                                         first.dict_max_len, data2))
             continue
         out_bytes = out_byte_capacities[ci]
         lens_out = jnp.zeros(out_capacity, jnp.int32)

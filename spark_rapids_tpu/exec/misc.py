@@ -258,7 +258,8 @@ _truncate_jit = jax.jit(
         [DeviceColumn(c.dtype,
                       c.data,
                       c.validity & (jnp.arange(c.capacity, dtype=jnp.int32) < n),
-                      c.offsets, c.dictionary, c.dict_size, c.dict_max_len)
+                      c.offsets, c.dictionary, c.dict_size, c.dict_max_len,
+                      c.data2)
          for c in b.columns],
         jnp.minimum(b.num_rows, n).astype(jnp.int32),
     )

@@ -383,18 +383,21 @@ def _finish_agg(f, out_t, s, c, active) -> DeviceColumn:
         nz = c > 0
         if isinstance(out_t, T.DecimalType):
             # exact HALF_UP at scale(in)+4 over the int64 window sums
-            # (same rule as HashAggregateExec decimal avg)
+            # (same rule as HashAggregateExec decimal avg); divide FIRST so
+            # sum * 10^4 cannot wrap int64 for huge windows
             in_t = f.children[0].dtype
-            shift = 10 ** (out_t.scale - in_t.scale)
-            num = s.astype(jnp.int64) * jnp.int64(shift)
-            den = jnp.maximum(c, 1)
-            q = num // den
-            r = num - q * den
-            neg = num < 0
-            q_t = jnp.where(neg & (r != 0), q + 1, q)
-            r_t = jnp.abs(num - q_t * den)
-            data = q_t + jnp.where(2 * r_t >= den,
-                                   jnp.where(neg, -1, 1), 0)
+            shift = jnp.int64(10 ** (out_t.scale - in_t.scale))
+            den = jnp.maximum(c, 1).astype(jnp.int64)
+            sv = s.astype(jnp.int64)
+            sa = jnp.abs(sv)
+            q1 = sa // den
+            r = sa - q1 * den
+            frac = r * shift  # < den * 10^4 < 2^45
+            fq = frac // den
+            fr = frac - fq * den
+            fq = fq + (2 * fr >= den).astype(jnp.int64)
+            q = q1 * shift + fq
+            data = jnp.where(sv < 0, -q, q)
             return _win_out(out_t, data, nz, active)
         data = s.astype(jnp.float64) / jnp.maximum(c, 1).astype(jnp.float64)
         return _win_out(out_t, data, nz, active)

@@ -35,7 +35,16 @@ from spark_rapids_tpu.exprs import expr as E
 
 from spark_rapids_tpu.exprs.strings import StringVal, row_ids as _string_row_ids
 
-Val = Union[ColVal, StringVal]
+class WideVal(NamedTuple):
+    """A DECIMAL128 expression value: (hi, lo) int64 limbs + validity
+    (exec/int128.py arithmetic; cudf decimal128 analog)."""
+
+    hi: jax.Array
+    lo: jax.Array
+    validity: jax.Array
+
+
+Val = Union[ColVal, StringVal, WideVal]
 
 
 class EvalContext:
@@ -57,11 +66,51 @@ class EvalContext:
             return StringVal(p.data, p.offsets, p.validity)
         if c.offsets is not None:
             return StringVal(c.data, c.offsets, c.validity)
+        if c.is_wide_decimal:
+            return WideVal(c.data2, c.data, c.validity)
         return ColVal(c.data, c.validity)
 
 
 def _all_valid(capacity: int) -> jax.Array:
     return jnp.ones((capacity,), dtype=jnp.bool_)
+
+
+def _is_wide(dt: T.DataType) -> bool:
+    return (isinstance(dt, T.DecimalType)
+            and dt.precision > T.DecimalType.MAX_LONG_DIGITS)
+
+
+def _as_wide(v: Val, dt: T.DataType, to_scale: int) -> "WideVal":
+    """Promote a decimal/integral value to (hi, lo) limbs at ``to_scale``."""
+    if isinstance(v, WideVal):
+        h, l = v.hi, v.lo
+    else:
+        from spark_rapids_tpu.exec import int128 as I128
+        h, l = I128.from_i64(v.data)
+    s = dt.scale if isinstance(dt, T.DataType) and isinstance(
+        dt, T.DecimalType) else 0
+    if to_scale > s:
+        from spark_rapids_tpu.exec import int128 as I128
+        h, l = I128.rescale10(h, l, to_scale - s)
+    return WideVal(h, l, v.validity)
+
+
+def _as_wide_checked(v: Val, dt: T.DataType, to_scale: int,
+                     precision: int):
+    """_as_wide with overflow detection on the rescale (a wrapped rescale
+    would dodge the result-level overflow mask)."""
+    from spark_rapids_tpu.exec import int128 as I128
+
+    if isinstance(v, WideVal):
+        h, l = v.hi, v.lo
+    else:
+        h, l = I128.from_i64(v.data)
+    s = dt.scale if isinstance(dt, T.DecimalType) else 0
+    if to_scale > s:
+        h, l, ovf = I128.rescale10_checked(h, l, to_scale - s, precision)
+    else:
+        ovf = jnp.zeros_like(h, dtype=jnp.bool_)
+    return WideVal(h, l, v.validity), ovf
 
 
 def _broadcast_literal(value, dtype: T.DataType, capacity: int) -> Val:
@@ -77,6 +126,20 @@ def _broadcast_literal(value, dtype: T.DataType, capacity: int) -> Val:
         data = jnp.asarray(np.tile(raw, capacity) if n else np.zeros(0, np.uint8))
         offsets = jnp.arange(capacity + 1, dtype=jnp.int32) * n
         return StringVal(data, offsets, _all_valid(capacity))
+    if _is_wide(dtype):
+        from spark_rapids_tpu.exec import int128 as I128
+
+        if value is None:
+            z = jnp.zeros((capacity,), jnp.int64)
+            return WideVal(z, z, jnp.zeros((capacity,), jnp.bool_))
+        import decimal
+        with decimal.localcontext() as _c:
+            _c.prec = 50
+            v = int(decimal.Decimal(value).scaleb(dtype.scale))
+        hi_np, lo_np = I128.from_py_ints([v])
+        return WideVal(jnp.full((capacity,), int(hi_np[0]), jnp.int64),
+                       jnp.full((capacity,), int(lo_np[0]), jnp.int64),
+                       _all_valid(capacity))
     np_dtype = T.numpy_dtype(dtype if dtype != T.NULL else T.BOOLEAN)
     if value is None:
         return ColVal(
@@ -251,6 +314,8 @@ def cast_val(cv: Val, src: T.DataType, dst: T.DataType, ansi: bool,
              capacity: int) -> Val:
     if src == dst:
         return cv
+    if isinstance(cv, WideVal) or _is_wide(dst):
+        return _cast_wide(cv, src, dst)
     assert isinstance(cv, ColVal), f"device cast from {src} not supported"
     data, valid = cv
     if dst == T.BOOLEAN:
@@ -304,6 +369,132 @@ def _float_or_int_to_int(data, valid, dst: T.DataType) -> ColVal:
         ).astype(np_dtype)
         return ColVal(out, valid)
     return ColVal(data.astype(np_dtype), valid)  # wraps like Java
+
+
+def _wide_div_pow10_half_up(h, l, k: int):
+    """(hi, lo) / 10^k with a single ROUND_HALF_UP at the full divisor.
+
+    Chained small divides keep the exact remainder (sum of step remainders
+    at their place values fits int64 for k <= 18), so rounding applies once.
+    """
+    from spark_rapids_tpu.exec import int128 as I128
+
+    assert 0 < k <= 18, "scale reduction beyond 18 digits not on device"
+    ah, al = I128.abs_(h, l)
+    neg = I128.is_neg(h, l)
+    rem = jnp.zeros_like(h)
+    place = 1
+    kk = k
+    while kk > 0:
+        step = min(kk, 9)
+        d = jnp.full_like(h, 10 ** step)
+        ah, al, rr = I128._udivmod_small(ah, al, d)
+        rem = rem + rr * jnp.int64(place)
+        place *= 10 ** step
+        kk -= step
+    div = jnp.int64(10 ** k)
+    up = (2 * rem >= div).astype(jnp.int64)
+    qh, ql = I128.add(ah, al, jnp.zeros_like(up), up)
+    nh, nl = I128.neg(qh, ql)
+    return jnp.where(neg, nh, qh), jnp.where(neg, nl, ql)
+
+
+def _cast_wide(cv: Val, src: T.DataType, dst: T.DataType) -> Val:
+    """Casts involving DECIMAL128 (reference GpuCast decimal paths via
+    jni DecimalUtils; here: exact (hi, lo) limb arithmetic)."""
+    from spark_rapids_tpu.exec import int128 as I128
+
+    if _is_wide(dst):
+        assert isinstance(dst, T.DecimalType)
+        pre_ovf = None
+        if isinstance(cv, WideVal):
+            assert isinstance(src, T.DecimalType)
+            diff = dst.scale - src.scale
+            h, l, valid = cv.hi, cv.lo, cv.validity
+            if diff >= 0:
+                h, l, pre_ovf = I128.rescale10_checked(h, l, diff,
+                                                       dst.precision)
+            else:
+                h, l = _wide_div_pow10_half_up(h, l, -diff)
+        elif src in T.INTEGRAL_TYPES or isinstance(src, T.DecimalType):
+            s = src.scale if isinstance(src, T.DecimalType) else 0
+            diff = dst.scale - s
+            if diff >= 0:
+                h, l = I128.from_i64(cv.data)
+                h, l, pre_ovf = I128.rescale10_checked(h, l, diff,
+                                                       dst.precision)
+            else:
+                # reduce scale in int64 first (value shrinks), then widen
+                nv = _cast_to_decimal(cv.data, cv.validity, src,
+                                      T.DecimalType(18, dst.scale), False)
+                h, l = I128.from_i64(nv.data)
+                return WideVal(h, l, nv.validity)
+            valid = cv.validity
+        elif src in (T.FLOAT, T.DOUBLE):
+            # double -> decimal128: scale in f64, split at 2^64 (f64 has 53
+            # significant bits — approximation inherent to the source type)
+            x = cv.data.astype(jnp.float64) * (10.0 ** dst.scale)
+            bad = jnp.isnan(x) | jnp.isinf(x) | (jnp.abs(x) >= 2.0 ** 127)
+            xs = jnp.where(bad, 0.0, x)
+            sign = jnp.sign(xs)
+            ax = jnp.abs(xs)
+            ax = jnp.floor(ax + 0.5)  # HALF_UP at target scale
+            hi_f = jnp.floor(ax / (2.0 ** 64))
+            lo_f = ax - hi_f * (2.0 ** 64)
+            lo_u = lo_f.astype(jnp.uint64).astype(jnp.int64)
+            hpos = hi_f.astype(jnp.int64)
+            nh, nl = I128.neg(hpos, lo_u)
+            h = jnp.where(sign < 0, nh, hpos)
+            l = jnp.where(sign < 0, nl, lo_u)
+            valid = cv.validity & ~bad
+        else:
+            raise NotImplementedError(f"cast {src} -> {dst}")
+        ovf = I128.overflow_mask(h, l, dst.precision)
+        if pre_ovf is not None:
+            ovf = ovf | pre_ovf
+        z = jnp.zeros_like(h)
+        return WideVal(jnp.where(ovf, z, h), jnp.where(ovf, z, l),
+                       valid & ~ovf)
+
+    # source is wide
+    assert isinstance(cv, WideVal) and isinstance(src, T.DecimalType)
+    if dst in (T.FLOAT, T.DOUBLE):
+        return ColVal((_wide_to_f64(cv) / (10.0 ** src.scale)).astype(
+            T.numpy_dtype(dst)), cv.validity)
+    if isinstance(dst, T.DecimalType) or dst in T.INTEGRAL_TYPES:
+        s_dst = dst.scale if isinstance(dst, T.DecimalType) else 0
+        diff = s_dst - src.scale
+        h, l = cv.hi, cv.lo
+        fits_extra = None
+        if diff > 0:
+            h, l, fits_extra = I128.rescale10_checked(h, l, diff, 38)
+        elif diff < 0:
+            if isinstance(dst, T.DecimalType):
+                h, l = _wide_div_pow10_half_up(h, l, -diff)
+            else:
+                # integral cast truncates toward zero
+                ah, al = I128.abs_(h, l)
+                kk = -diff
+                while kk > 0:
+                    step = min(kk, 9)
+                    d = jnp.full_like(h, 10 ** step)
+                    ah, al, _ = I128._udivmod_small(ah, al, d)
+                    kk -= step
+                nh, nl = I128.neg(ah, al)
+                m = I128.is_neg(h, l)
+                h = jnp.where(m, nh, ah)
+                l = jnp.where(m, nl, al)
+        # narrow: value must fit the destination representation
+        fits = h == jnp.where(l < 0, jnp.int64(-1), jnp.int64(0))
+        valid = cv.validity & fits
+        if fits_extra is not None:
+            valid = valid & ~fits_extra
+        if isinstance(dst, T.DecimalType):
+            bound = jnp.int64(10 ** min(dst.precision, 18))
+            ovf = jnp.abs(l) >= bound
+            return ColVal(jnp.where(valid & ~ovf, l, 0), valid & ~ovf)
+        return _float_or_int_to_int(jnp.where(valid, l, 0), valid, dst)
+    raise NotImplementedError(f"cast {src} -> {dst}")
 
 
 def _cast_to_decimal(data, valid, src: T.DataType, dst: T.DecimalType, ansi):
@@ -401,6 +592,14 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         vals = [eval_expr(c, ctx) for c in expr.children]
         if isinstance(vals[0], StringVal):
             return _string_select_n([v.validity for v in vals], vals)
+        if isinstance(vals[0], WideVal):
+            hi, lo = vals[-1].hi, vals[-1].lo
+            valid = vals[-1].validity
+            for v in reversed(vals[:-1]):
+                hi = jnp.where(v.validity, v.hi, hi)
+                lo = jnp.where(v.validity, v.lo, lo)
+                valid = v.validity | valid
+            return WideVal(hi, lo, valid)
         data = vals[-1].data
         valid = vals[-1].validity
         for v in reversed(vals[:-1]):
@@ -416,6 +615,13 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         if isinstance(t, StringVal):
             assert isinstance(f, StringVal)
             return _string_select(take_t, t, f)
+        if isinstance(t, WideVal) or isinstance(f, WideVal):
+            assert isinstance(t, WideVal) and isinstance(f, WideVal)
+            return WideVal(
+                jnp.where(take_t, t.hi, f.hi),
+                jnp.where(take_t, t.lo, f.lo),
+                jnp.where(take_t, t.validity, f.validity),
+            )
         return ColVal(
             jnp.where(take_t, t.data, f.data),
             jnp.where(take_t, t.validity, f.validity),
@@ -436,6 +642,16 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
             takes.append(jnp.ones_like(takes[0]))
             vals.append(else_v)
             return _string_select_n(takes, vals)
+        if _is_wide(expr.dtype):
+            hi, lo, valid = else_v.hi, else_v.lo, else_v.validity
+            for p_ex, v_ex in reversed(expr.branches):
+                p = eval_expr(p_ex, ctx)
+                v = eval_expr(v_ex, ctx)
+                take = p.data & p.validity
+                hi = jnp.where(take, v.hi, hi)
+                lo = jnp.where(take, v.lo, lo)
+                valid = jnp.where(take, v.validity, valid)
+            return WideVal(hi, lo, valid)
         data, valid = else_v.data, else_v.validity
         for p_ex, v_ex in reversed(expr.branches):
             p = eval_expr(p_ex, ctx)
@@ -672,6 +888,115 @@ def _dec_to_f64(v: ColVal, dt: T.DecimalType) -> ColVal:
     return ColVal(v.data.astype(jnp.float64) / (10.0 ** dt.scale), v.validity)
 
 
+def _wide_to_f64(v: "WideVal") -> jax.Array:
+    lo_u = v.lo.astype(jnp.float64) + jnp.where(
+        v.lo < 0, jnp.float64(2.0 ** 64), jnp.float64(0.0))
+    return v.hi.astype(jnp.float64) * (2.0 ** 64) + lo_u
+
+
+def _dec_any_to_f64(v, dt: T.DecimalType) -> jax.Array:
+    if isinstance(v, WideVal):
+        return _wide_to_f64(v) / (10.0 ** dt.scale)
+    return v.data.astype(jnp.float64) / (10.0 ** dt.scale)
+
+
+def _eval_arith_wide(expr, out_t: T.DecimalType, lt, rt, l, r,
+                     valid) -> "WideVal":
+    """DECIMAL128 add/sub/multiply on (hi, lo) limbs; overflow -> NULL
+    (Spark non-ANSI; reference jni DecimalUtils.add128/multiply128)."""
+    from spark_rapids_tpu.exec import int128 as I128
+
+    if isinstance(expr, (E.Add, E.Subtract)):
+        s = out_t.scale
+        wl, ovf_l = _as_wide_checked(l, lt, s, out_t.precision)
+        wr, ovf_r = _as_wide_checked(r, rt, s, out_t.precision)
+        if isinstance(expr, E.Add):
+            h, lo = I128.add(wl.hi, wl.lo, wr.hi, wr.lo)
+        else:
+            h, lo = I128.sub(wl.hi, wl.lo, wr.hi, wr.lo)
+        ovf = I128.overflow_mask(h, lo, out_t.precision) | ovf_l | ovf_r
+        z = jnp.zeros_like(h)
+        return WideVal(jnp.where(ovf, z, h), jnp.where(ovf, z, lo),
+                       valid & ~ovf)
+    if isinstance(expr, E.Multiply):
+        # scaled product of two NARROW operands: out scale == s1 + s2, the
+        # raw 64x64 -> 128 product IS the result (wide operands stay on CPU)
+        assert isinstance(l, ColVal) and isinstance(r, ColVal), \
+            "decimal128 multiply operands must be DECIMAL64"
+        h, lo = I128.mul_64x64(l.data.astype(jnp.int64),
+                               r.data.astype(jnp.int64))
+        ovf = I128.overflow_mask(h, lo, out_t.precision)
+        z = jnp.zeros_like(h)
+        return WideVal(jnp.where(ovf, z, h), jnp.where(ovf, z, lo),
+                       valid & ~ovf)
+    raise NotImplementedError(f"decimal128 {expr.symbol}")
+
+
+def _wide_floor_div_pow10(h, l, k: int):
+    """FLOOR((hi, lo) / 10^k) plus a remainder-nonzero flag, for the
+    overflow-free mixed-scale comparison (divide the finer side instead of
+    rescaling the coarser side up)."""
+    from spark_rapids_tpu.exec import int128 as I128
+
+    ah, al = I128.abs_(h, l)
+    neg = I128.is_neg(h, l)
+    rem_any = jnp.zeros_like(h, dtype=jnp.bool_)
+    kk = k
+    while kk > 0:
+        step = min(kk, 9)
+        d = jnp.full_like(h, 10 ** step)
+        ah, al, rr = I128._udivmod_small(ah, al, d)
+        rem_any = rem_any | (rr != 0)
+        kk -= step
+    # floor for negatives: -(q + (rem ? 1 : 0))
+    qh, ql = ah, al
+    nh, nl = I128.neg(qh, ql)
+    bump = rem_any.astype(jnp.int64)
+    nh2, nl2 = I128.sub(nh, nl, jnp.zeros_like(bump), bump)
+    out_h = jnp.where(neg, nh2, qh)
+    out_l = jnp.where(neg, nl2, ql)
+    return out_h, out_l, rem_any
+
+
+def _eval_compare_wide(expr, lt, rt, l, r, cap) -> ColVal:
+    """DECIMAL128-aware comparisons: exact at mixed scales without
+    overflow-prone up-rescaling."""
+    from spark_rapids_tpu.exec import int128 as I128
+
+    sa = lt.scale if isinstance(lt, T.DecimalType) else 0
+    sb = rt.scale if isinstance(rt, T.DecimalType) else 0
+    wl = _as_wide(l, lt, sa)
+    wr = _as_wide(r, rt, sb)
+    if sa == sb:
+        lt_m = I128.cmp_lt(wl.hi, wl.lo, wr.hi, wr.lo)
+        eq_m = I128.cmp_eq(wl.hi, wl.lo, wr.hi, wr.lo)
+    elif sa > sb:
+        qh, ql, rem = _wide_floor_div_pow10(wl.hi, wl.lo, sa - sb)
+        lt_m = I128.cmp_lt(qh, ql, wr.hi, wr.lo)
+        eq_m = I128.cmp_eq(qh, ql, wr.hi, wr.lo) & ~rem
+    else:
+        qh, ql, rem = _wide_floor_div_pow10(wr.hi, wr.lo, sb - sa)
+        lt_m = (I128.cmp_lt(wl.hi, wl.lo, qh, ql)
+                | (I128.cmp_eq(wl.hi, wl.lo, qh, ql) & rem))
+        eq_m = I128.cmp_eq(wl.hi, wl.lo, qh, ql) & ~rem
+    valid = l.validity & r.validity
+    if isinstance(expr, E.EqualTo):
+        return ColVal(eq_m, valid)
+    if isinstance(expr, E.EqualNullSafe):
+        both = l.validity & r.validity
+        neither = ~l.validity & ~r.validity
+        return ColVal((eq_m & both) | neither, _all_valid(cap))
+    if isinstance(expr, E.LessThan):
+        return ColVal(lt_m, valid)
+    if isinstance(expr, E.GreaterThan):
+        return ColVal(~lt_m & ~eq_m, valid)
+    if isinstance(expr, E.LessThanOrEqual):
+        return ColVal(lt_m | eq_m, valid)
+    if isinstance(expr, E.GreaterThanOrEqual):
+        return ColVal(~lt_m, valid)
+    raise NotImplementedError(expr.symbol)
+
+
 def _eval_arith(expr: E.BinaryArithmetic, ctx: EvalContext) -> ColVal:
     out_t = expr.dtype
     lt, rt = expr.left.dtype, expr.right.dtype
@@ -680,6 +1005,9 @@ def _eval_arith(expr: E.BinaryArithmetic, ctx: EvalContext) -> ColVal:
     valid = l.validity & r.validity
 
     if isinstance(out_t, T.DecimalType):
+        if (_is_wide(out_t) or isinstance(l, WideVal)
+                or isinstance(r, WideVal)):
+            return _eval_arith_wide(expr, out_t, lt, rt, l, r, valid)
         a, sa = _dec_parts(l, lt)
         b, sb = _dec_parts(r, rt)
         if isinstance(expr, (E.Add, E.Subtract)):
@@ -695,9 +1023,9 @@ def _eval_arith(expr: E.BinaryArithmetic, ctx: EvalContext) -> ColVal:
 
     # decimal ⊗ float -> double (Spark casts the decimal side)
     if isinstance(lt, T.DecimalType):
-        l, lt = _dec_to_f64(l, lt), T.DOUBLE
+        l, lt = ColVal(_dec_any_to_f64(l, lt), l.validity), T.DOUBLE
     if isinstance(rt, T.DecimalType):
-        r, rt = _dec_to_f64(r, rt), T.DOUBLE
+        r, rt = ColVal(_dec_any_to_f64(r, rt), r.validity), T.DOUBLE
 
     np_dtype = T.numpy_dtype(out_t)
     a = l.data.astype(np_dtype)
@@ -754,10 +1082,12 @@ def _eval_compare(expr: E.BinaryComparison, ctx: EvalContext) -> ColVal:
     if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
         if lt in T.FRACTIONAL_TYPES or rt in T.FRACTIONAL_TYPES:
             # decimal vs float: compare as double
-            a = (_dec_to_f64(l, lt).data if isinstance(lt, T.DecimalType)
+            a = (_dec_any_to_f64(l, lt) if isinstance(lt, T.DecimalType)
                  else l.data.astype(jnp.float64))
-            b = (_dec_to_f64(r, rt).data if isinstance(rt, T.DecimalType)
+            b = (_dec_any_to_f64(r, rt) if isinstance(rt, T.DecimalType)
                  else r.data.astype(jnp.float64))
+        elif isinstance(l, WideVal) or isinstance(r, WideVal):
+            return _eval_compare_wide(expr, lt, rt, l, r, cap)
         else:
             # decimal vs decimal/integral: exact compare without rescaling
             # UP (10^diff multiply overflows int64 for large operands) —
@@ -953,6 +1283,8 @@ def project_batch(
         v = eval_expr(e, ctx)
         if isinstance(v, StringVal):
             cols.append(DeviceColumn(T.STRING, v.data, v.validity, v.offsets))
+        elif isinstance(v, WideVal):
+            cols.append(DeviceColumn(e.dtype, v.lo, v.validity, data2=v.hi))
         else:
             dt = e.dtype if e.dtype != T.NULL else T.BOOLEAN
             cols.append(
@@ -962,7 +1294,8 @@ def project_batch(
     active = batch.active_mask()
     cols = [
         DeviceColumn(c.dtype, c.data, c.validity & active, c.offsets,
-                     c.dictionary, c.dict_size, c.dict_max_len) for c in cols
+                     c.dictionary, c.dict_size, c.dict_max_len, c.data2)
+        for c in cols
     ]
     return ColumnarBatch(cols, batch.num_rows)
 

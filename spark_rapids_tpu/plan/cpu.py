@@ -118,8 +118,11 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
                     out.append(0)
                     mm[i] = False
                 elif isinstance(expr, E.IntegralDivide):
-                    out.append(abs(x) // abs(y)
-                               * (1 if (x >= 0) == (y >= 0) else -1))
+                    q = abs(x) // abs(y) * (1 if (x >= 0) == (y >= 0) else -1)
+                    if not (-(2**63) <= q < 2**63):
+                        q = 0
+                        mm[i] = False  # long overflow -> NULL (non-ANSI)
+                    out.append(q)
                 elif isinstance(expr, E.Pmod):
                     out.append(jrem(jrem(x, y) + y, y))
                 else:
@@ -654,9 +657,17 @@ def _cpu_cast(d, m, src: T.DataType, dst: T.DataType):
             return (np.array([float(x) for x in d])
                     / float(p)).astype(T.numpy_dtype(dst)), m
         if dst in T.INTEGRAL_TYPES:
-            whole = np.array([abs(int(x)) // p * (1 if x >= 0 else -1)
-                              for x in d], dtype=np.int64)
-            return _cpu_cast(whole, m, T.LONG, dst)
+            # whole part beyond int64: Spark non-ANSI overflow -> NULL
+            m = m.copy()
+            vals = []
+            for i, x in enumerate(d):
+                w = abs(int(x)) // p * (1 if x >= 0 else -1)
+                if not (-(2**63) <= w < 2**63):
+                    vals.append(0)
+                    m[i] = False
+                else:
+                    vals.append(w)
+            return _cpu_cast(np.array(vals, np.int64), m, T.LONG, dst)
         if dst == T.STRING:
             import decimal
             sc = decimal.Decimal(1).scaleb(-src.scale)

@@ -65,10 +65,18 @@ _DEVICE_EXPRS = (
 )
 
 
-def _check_dtype(dt: T.DataType) -> Optional[str]:
-    if isinstance(dt, T.DecimalType) and dt.precision > T.DecimalType.MAX_LONG_DIGITS:
-        return f"decimal precision {dt.precision} > 18 not on device yet"
-    return None
+def _is_wide(dt: T.DataType) -> bool:
+    return (isinstance(dt, T.DecimalType)
+            and dt.precision > T.DecimalType.MAX_LONG_DIGITS)
+
+
+# operations with a decimal128 device implementation; anything else touching
+# a wide value falls back (reference: cuDF decimal128 coverage is similarly
+# narrower than decimal64's)
+_WIDE_OK = (E.Alias, E.ColumnRef, E.UnresolvedColumn, E.Literal, E.Cast,
+            E.Add, E.Subtract, E.BinaryComparison, E.IsNull, E.IsNotNull,
+            E.If, E.CaseWhen, E.Coalesce, E.Sum, E.Min, E.Max, E.Average,
+            E.Count, E.First, E.Last)
 
 
 def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
@@ -83,9 +91,27 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
             return
         try:
             bound = E.resolve(e, schema)
-            r = _check_dtype(bound.dtype)
-            if r:
-                reasons.append(r)
+            wide_touch = _is_wide(bound.dtype) or any(
+                _is_wide(c.dtype) for c in bound.children)
+            if wide_touch:
+                if isinstance(bound, E.Multiply):
+                    if any(_is_wide(c.dtype) for c in bound.children):
+                        reasons.append(
+                            "decimal128 multiply operand not on device")
+                elif not isinstance(bound, _WIDE_OK):
+                    reasons.append(
+                        f"{type(bound).__name__} not on device for "
+                        "decimal128")
+                if isinstance(bound, E.Cast) and bound.to in (
+                        T.STRING, T.BINARY):
+                    reasons.append("decimal128 cast to string not on device")
+                if isinstance(bound, E.Cast) and isinstance(
+                        bound.to, T.DecimalType) and isinstance(
+                        bound.children[0].dtype, T.DecimalType):
+                    drop = bound.children[0].dtype.scale - bound.to.scale
+                    if drop > 18:
+                        reasons.append(
+                            "decimal128 scale reduction > 18 not on device")
             # string ordering comparisons are CPU-only in round 1
             if isinstance(bound, (E.LessThan, E.LessThanOrEqual,
                                   E.GreaterThan, E.GreaterThanOrEqual)):
@@ -206,20 +232,9 @@ class Overrides:
     def _tag(self, meta: PlanMeta) -> None:
         node = meta.node
         child_schema = (node.children[0].schema if node.children else None)
-        # every device node must be able to HOLD its output types on device
-        # (TypeChecks: the output type matrix applies to all operators) —
-        # and its INPUTS: the host->device transition uploads the child's
-        # whole table, so a non-representable child column (decimal128)
-        # keeps this node on CPU until a projection drops it
-        for f in node.schema:
-            r = _check_dtype(f.dtype)
-            if r:
-                meta.will_not_work(r)
-        for ch in node.children:
-            for f in ch.schema:
-                r = _check_dtype(f.dtype)
-                if r:
-                    meta.will_not_work(f"input {f.name}: {r}")
+        # all scalar types (incl. DECIMAL128 two-limb) are device
+        # REPRESENTABLE; per-operation wide-decimal support is gated in
+        # check_expr / the node-specific blocks below
         if isinstance(node, L.Project):
             for e in node.exprs:
                 for r in check_expr(e, child_schema):
@@ -231,6 +246,13 @@ class Overrides:
             for e in list(node.group_exprs) + list(node.agg_exprs):
                 for r in check_expr(e, child_schema):
                     meta.will_not_work(r)
+            for e in node.group_exprs:
+                try:
+                    if _is_wide(E.resolve(e, child_schema).dtype):
+                        meta.will_not_work(
+                            "decimal128 group key not on device")
+                except (TypeError, KeyError):
+                    pass
         elif isinstance(node, L.Sort):
             for o in node.orders:
                 for r in check_expr(o.child, child_schema):
@@ -258,9 +280,11 @@ class Overrides:
                         meta.will_not_work(r)
                 try:
                     bound_fn = E.resolve(fn, child_schema)
-                    r = _check_dtype(bound_fn.dtype)
-                    if r:
-                        meta.will_not_work(r)
+                    if _is_wide(bound_fn.dtype) or any(
+                            _is_wide(c.dtype)
+                            for c in getattr(bound_fn, "children", ())):
+                        meta.will_not_work(
+                            "decimal128 window function not on device")
                 except (TypeError, KeyError, NotImplementedError) as ex:
                     meta.will_not_work(str(ex))
         elif isinstance(node, L.Join):
@@ -268,6 +292,12 @@ class Overrides:
                          + [(k, node.right.schema) for k in node.right_keys]):
                 for r in check_expr(e, s):
                     meta.will_not_work(r)
+                try:
+                    if _is_wide(E.resolve(e, s).dtype):
+                        meta.will_not_work(
+                            "decimal128 join key not on device")
+                except (TypeError, KeyError):
+                    pass
             if node.condition is not None:
                 pair = T.Schema(list(node.left.schema) + list(node.right.schema))
                 for r in check_expr(node.condition, pair):

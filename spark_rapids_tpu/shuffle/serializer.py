@@ -33,7 +33,7 @@ _MAGIC = 0x54505553
 _TYPE_CODES = {
     "boolean": 0, "tinyint": 1, "smallint": 2, "int": 3, "bigint": 4,
     "float": 5, "double": 6, "date": 7, "timestamp": 8, "string": 9,
-    "binary": 10,
+    "binary": 10, "decimal128": 11,
 }
 _CODE_TYPES = {v: k for k, v in _TYPE_CODES.items()}
 _NAME_TO_TYPE = {
@@ -46,11 +46,16 @@ _CODECS = {"none": 0, "zlib": 1}
 _CODEC_NAMES = {v: k for k, v in _CODECS.items()}
 
 
+def _is_wide_dec(dt: T.DataType) -> bool:
+    return (isinstance(dt, T.DecimalType)
+            and dt.precision > T.DecimalType.MAX_LONG_DIGITS)
+
+
 def _type_code(dt: T.DataType) -> int:
     if isinstance(dt, T.DecimalType):
-        # decimal64 rides as bigint + scale encoded out-of-band by the plan
-        # (schema travels with the shuffle dependency, not the wire)
-        return _TYPE_CODES["bigint"]
+        # decimal64 rides as bigint, decimal128 as 16-byte rows; scale is
+        # out-of-band (schema travels with the shuffle dependency)
+        return _TYPE_CODES["decimal128" if _is_wide_dec(dt) else "bigint"]
     return _TYPE_CODES[dt.name]
 
 
@@ -116,6 +121,8 @@ def serialize_batch(batch, schema: T.Schema, codec: str = "none") -> bytes:
 def _decimal_to_bytes(arr: pa.Array, dt: T.DecimalType) -> bytes:
     limbs = np.frombuffer(arr.buffers()[1], dtype=np.int64,
                           count=2 * len(arr), offset=arr.offset * 16)
+    if _is_wide_dec(dt):
+        return limbs.copy().tobytes()  # full (lo, hi) 16-byte rows
     return limbs[0::2].copy().tobytes()
 
 
@@ -157,6 +164,9 @@ def deserialize_table(buf: bytes, schema: T.Schema,
         elif dt == T.BOOLEAN:
             bits = np.frombuffer(data, np.uint8).astype(np.bool_)
             arr = pa.array(bits, mask=_null_mask(validity, n_rows))
+        elif _is_wide_dec(dt):
+            arr = pa.Array.from_buffers(dt.arrow_type(), n_rows,
+                                        [vbuf, pa.py_buffer(data)])
         elif isinstance(dt, T.DecimalType):
             vals = np.frombuffer(data, np.int64)
             arr = _decimal_from_int64(vals, _null_mask(validity, n_rows), dt)
@@ -225,7 +235,7 @@ def merge_to_batch(blocks: List[bytes], schema: T.Schema,
     native_ok = all(len(b) >= 13 and b[12] == 0 for b in blocks)  # codec none
     res = None
     if native_ok and not any(isinstance(f.dtype, T.ArrayType)
-                             for f in schema):
+                             or _is_wide_dec(f.dtype) for f in schema):
         from spark_rapids_tpu.native import kudo as NK
 
         has_off = [not f.dtype.fixed_width for f in schema]
@@ -267,7 +277,7 @@ def serialize_batch_device(batch, schema: T.Schema) -> Optional[bytes]:
     from spark_rapids_tpu.native import kudo as NK
 
     if not available() or any(isinstance(f.dtype, T.ArrayType)
-                              for f in schema):
+                              or _is_wide_dec(f.dtype) for f in schema):
         return None
     from spark_rapids_tpu.exec.kernels import ensure_plain_batch
 

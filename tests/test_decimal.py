@@ -184,6 +184,28 @@ def test_compare_decimal_vs_large_long():
             {"m": D("12.34"), "big": 2 ** 62}], f"enabled={enabled}"
 
 
+def test_grouped_wide_agg():
+    """decimal128 sum/avg/min/max grouped — dense + shuffled partial/final
+    paths with (hi, lo) buffers riding the wire format."""
+    dev = assert_same(lambda df: df.group_by("k").agg(
+        Sum(col("w")).alias("s"), Min(col("w")).alias("lo"),
+        Max(col("w")).alias("hi"), Average(col("w")).alias("a"),
+    ).sort("k"))
+    assert dev[0]["lo"] == D("1.000000000000000000")
+    assert dev[0]["hi"] == D("12345678901234567890.123456789012345678")
+    assert dev[1]["lo"] == D("-0.000000000000000001")
+    assert dev[1]["hi"] == D("99999999999999999999.999999999999999999")
+
+
+def test_wide_sum_of_products():
+    """sum(m * n): the decimal64 x decimal64 -> decimal128 product feeds a
+    128-bit device sum — the TPC-DS sum(price*qty) shape."""
+    dev = assert_same(lambda df: df.agg(
+        Sum(Multiply(col("m"), col("n"))).alias("s")))
+    # 12.34*1.5 + (-5)*2.25 + 99999.99*10 = 1000007.16 at scale 6
+    assert dev[0]["s"] == D("1000007.160000")
+
+
 def test_group_by_decimal_key():
     assert_same(lambda df: df.group_by("m").agg(Count().alias("c"))
                 .sort("m"))
@@ -212,16 +234,13 @@ def test_window_decimal_aggs():
 
 
 def test_device_placement():
-    """p<=18 flows stay on device once wide columns are projected away;
-    any node touching a decimal128 column falls back (input-schema tag)."""
+    """DECIMAL128 storage + sum/avg/min/max/compare run on device (two-limb
+    int64); division and wide multiply still fall back."""
     t = table()
     df = from_arrow(t, RapidsConf({}))
-    # the pruning projection itself is CPU (its input still has `w`), but
-    # downstream agg over the clean schema goes back to device
-    pruned = df.select("k", "m")
-    stats_dev = (pruned.group_by("k").agg(Sum(col("m")).alias("s"))
+    stats = (df.group_by("k").agg(Sum(col("w")).alias("s"))
+             .device_plan_stats())
+    assert stats["device_fraction"] == 1.0, stats
+    stats_div = (df.select(Divide(col("w"), col("w")).alias("d"))
                  .device_plan_stats())
-    assert "CpuAggregateExec" not in stats_dev["cpu_nodes"], stats_dev
-    stats_cpu = (df.group_by("k").agg(Sum(col("w")).alias("s"))
-                 .device_plan_stats())
-    assert stats_cpu["cpu_nodes"], stats_cpu
+    assert stats_div["cpu_nodes"], stats_div

@@ -173,16 +173,18 @@ def test_cpu_join_shared_column_names():
         key=repr)
 
 
-def test_decimal128_scan_falls_back():
+def test_decimal128_scan_on_device():
+    """round 3: decimal128 is a device layout ((hi, lo) limbs) — the scan
+    stays on device and values round-trip exactly."""
     import decimal
     t = pa.table({"d": pa.array([decimal.Decimal(10**20), None],
                                 pa.decimal128(25, 0))})
     df = from_arrow(t)
     ex = df.physical_plan()
-    assert isinstance(ex, CpuExec)
+    assert not isinstance(ex, CpuExec)
     got = df.collect()
     assert got[0]["d"] == decimal.Decimal(10**20)  # value survives exactly
-    assert "decimal precision 25" in df.explain()
+    assert got[1]["d"] is None
 
 
 def test_cpu_sort_null_placement():
