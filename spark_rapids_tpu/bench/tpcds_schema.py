@@ -92,6 +92,21 @@ def gen_item(sf: float, seed: int = 1) -> pa.Table:
     rng = np.random.default_rng(seed)
     cats = np.array(["Books", "Home", "Electronics", "Jewelry", "Music",
                      "Shoes", "Sports", "Women", "Men", "Children"])
+    # classes belong to their category (dsdgen hierarchy): picking them
+    # independently can leave official (category, class) pairs like
+    # Women/dresses empty at small scale
+    cat_classes = {
+        "Books": ["fiction", "history", "self-help", "romance"],
+        "Home": ["accessories", "estate", "custom"],
+        "Electronics": ["classical", "custom", "accessories"],
+        "Jewelry": ["estate", "custom", "birdal"],
+        "Music": ["classical", "romance"],
+        "Shoes": ["pants", "custom"],
+        "Sports": ["fishing", "golf"],
+        "Women": ["dresses", "accessories", "birdal"],
+        "Men": ["shirts", "pants", "accessories"],
+        "Children": ["shirts", "pants"],
+    }
     classes = np.array(["accessories", "classical", "fiction", "history",
                         "self-help", "fishing", "golf", "dresses", "pants",
                         "shirts", "birdal", "estate", "custom", "romance"])
@@ -102,7 +117,12 @@ def gen_item(sf: float, seed: int = 1) -> pa.Table:
                       "N/A", "petite"])
     units = np.array(["Each", "Dozen", "Case", "Pound", "Oz", "Gross"])
     cat_id = rng.integers(0, len(cats), n)
-    class_id = rng.integers(0, len(classes), n)
+    class_lut = {c: np.array([int(np.where(classes == cl)[0][0])
+                              for cl in cls])
+                 for c, cls in cat_classes.items()}
+    class_id = np.array([
+        class_lut[cats[ci]][rng.integers(0, len(class_lut[cats[ci]]))]
+        for ci in cat_id], dtype=np.int64)
     brand_id = rng.integers(1, 1000, n)
     manufact_id = rng.integers(1, 1000, n)
     cur = _money(rng, 0.5, 100.0, n)
@@ -144,10 +164,14 @@ def gen_store(sf: float, seed: int = 2) -> pa.Table:
                                pa.string()),
         "s_store_name": pa.array(names[rng.integers(0, len(names), n)],
                                  pa.string()),
-        "s_state": pa.array(_STATES[rng.integers(0, len(_STATES), n)],
-                            pa.string()),
-        "s_county": pa.array(_COUNTIES[rng.integers(0, len(_COUNTIES), n)],
-                             pa.string()),
+        # first store pinned to dsdgen's mode values so official query
+        # constants (TN / Williamson County) always hit at every scale
+        "s_state": pa.array(
+            np.concatenate([["TN"], _STATES[rng.integers(
+                0, len(_STATES), n - 1)]]) if n else [], pa.string()),
+        "s_county": pa.array(
+            np.concatenate([["Williamson County"], _COUNTIES[rng.integers(
+                0, len(_COUNTIES), n - 1)]]) if n else [], pa.string()),
         "s_city": pa.array(_CITIES[rng.integers(0, len(_CITIES), n)],
                            pa.string()),
         "s_zip": pa.array([f"{z:05d}" for z in rng.integers(10000, 99999, n)],
@@ -397,23 +421,36 @@ def gen_store_sales(sf: float, seed: int = 3) -> pa.Table:
     n = int(2_880_000 * sf)
     rng = np.random.default_rng(seed)
     c = _sales_common(rng, n, sf)
+    # tickets: variable size 1..20, with date/time/customer/demo/addr/store
+    # CONSTANT within a ticket (dsdgen models a basket the same way) — the
+    # per-ticket count-band queries (q34 15..20, q73 1..5) need real baskets
+    n_tick_est = n // 8 + 21
+    sizes = rng.integers(1, 21, n_tick_est)
+    tick_of_row = np.repeat(np.arange(len(sizes)), sizes)[:n]
+    n_tick = int(tick_of_row[-1]) + 1 if n else 0
+
+    def per_ticket(vals):
+        return vals[tick_of_row]
+
     return pa.table({
-        "ss_sold_date_sk": pa.array(rng.integers(1, _N_DATES + 1, n),
-                                    pa.int64()),
-        "ss_sold_time_sk": pa.array(rng.integers(0, 86400 // 60, n) * 60,
-                                    pa.int64()),
+        "ss_sold_date_sk": pa.array(per_ticket(
+            rng.integers(1, _N_DATES + 1, n_tick)), pa.int64()),
+        "ss_sold_time_sk": pa.array(per_ticket(
+            rng.integers(0, 86400 // 60, n_tick) * 60), pa.int64()),
         "ss_item_sk": pa.array(rng.integers(1, n_items(sf) + 1, n),
                                pa.int64()),
-        "ss_customer_sk": pa.array(rng.integers(1, n_customers(sf) + 1, n),
-                                   pa.int64()),
-        "ss_cdemo_sk": pa.array(rng.integers(1, 19_201, n), pa.int64()),
-        "ss_hdemo_sk": pa.array(rng.integers(1, 7201, n), pa.int64()),
-        "ss_addr_sk": pa.array(rng.integers(1, n_addresses(sf) + 1, n),
-                               pa.int64()),
-        "ss_store_sk": pa.array(rng.integers(1, n_stores(sf) + 1, n),
-                                pa.int64()),
+        "ss_customer_sk": pa.array(per_ticket(
+            rng.integers(1, n_customers(sf) + 1, n_tick)), pa.int64()),
+        "ss_cdemo_sk": pa.array(per_ticket(
+            rng.integers(1, 19_201, n_tick)), pa.int64()),
+        "ss_hdemo_sk": pa.array(per_ticket(
+            rng.integers(1, 7201, n_tick)), pa.int64()),
+        "ss_addr_sk": pa.array(per_ticket(
+            rng.integers(1, n_addresses(sf) + 1, n_tick)), pa.int64()),
+        "ss_store_sk": pa.array(per_ticket(
+            rng.integers(1, n_stores(sf) + 1, n_tick)), pa.int64()),
         "ss_promo_sk": pa.array(rng.integers(1, 301, n), pa.int64()),
-        "ss_ticket_number": pa.array(np.arange(1, n + 1) // 4 + 1, pa.int64()),
+        "ss_ticket_number": pa.array(tick_of_row + 1, pa.int64()),
         "ss_quantity": pa.array(c["qty"].astype(np.float64), pa.float64()),
         "ss_wholesale_cost": pa.array(c["wholesale"], pa.float64()),
         "ss_list_price": pa.array(c["list_price"], pa.float64()),
@@ -440,19 +477,22 @@ def gen_store_returns(sf: float, store_sales: pa.Table,
     item = store_sales.column("ss_item_sk").to_numpy()[pick]
     ticket = store_sales.column("ss_ticket_number").to_numpy()[pick]
     cust = store_sales.column("ss_customer_sk").to_numpy()[pick]
+    store = store_sales.column("ss_store_sk").to_numpy()[pick]
+    # returns happen 1-90 days AFTER the sale (dsdgen ties them the same
+    # way; random dates starve bought-then-returned window chains like q25)
+    sold = store_sales.column("ss_sold_date_sk").to_numpy()[pick]
+    ret_date = np.minimum(sold + rng.integers(1, 91, n), _N_DATES)
     qty = rng.integers(1, 51, n)
     amt = _money(rng, 1.0, 300.0, n)
     return pa.table({
-        "sr_returned_date_sk": pa.array(rng.integers(1, _N_DATES + 1, n),
-                                        pa.int64()),
+        "sr_returned_date_sk": pa.array(ret_date, pa.int64()),
         "sr_item_sk": pa.array(item, pa.int64()),
         "sr_customer_sk": pa.array(cust, pa.int64()),
         "sr_cdemo_sk": pa.array(rng.integers(1, 19_201, n), pa.int64()),
         "sr_hdemo_sk": pa.array(rng.integers(1, 7201, n), pa.int64()),
         "sr_addr_sk": pa.array(rng.integers(1, n_addresses(sf) + 1, n),
                                pa.int64()),
-        "sr_store_sk": pa.array(rng.integers(1, n_stores(sf) + 1, n),
-                                pa.int64()),
+        "sr_store_sk": pa.array(store, pa.int64()),
         "sr_reason_sk": pa.array(rng.integers(1, 13, n), pa.int64()),
         "sr_ticket_number": pa.array(ticket, pa.int64()),
         "sr_return_quantity": pa.array(qty.astype(np.float64), pa.float64()),
@@ -469,19 +509,44 @@ def gen_store_returns(sf: float, store_sales: pa.Table,
     })
 
 
-def gen_catalog_sales(sf: float, seed: int = 5) -> pa.Table:
+def _correlate_baskets(rng, n: int, cust: np.ndarray, item: np.ndarray,
+                       basket: Optional[pa.Table], cust_col: str,
+                       item_col: str, frac: float = 0.25):
+    """Overwrite a fraction of (customer, item) pairs with pairs drawn from
+    another channel's sales — dsdgen models repeat customers the same way;
+    without it, cross-channel chains (q25/q29/q54/q64: bought in store,
+    returned, re-bought via catalog) are empty at small scale."""
+    if basket is None or n == 0 or basket.num_rows == 0:
+        return cust, item
+    k = int(n * frac)
+    idx = rng.choice(n, size=k, replace=False)
+    pick = rng.integers(0, basket.num_rows, k)
+    cust = cust.copy()
+    item = item.copy()
+    cust[idx] = basket.column(cust_col).to_numpy()[pick]
+    item[idx] = basket.column(item_col).to_numpy()[pick]
+    return cust, item
+
+
+def gen_catalog_sales(sf: float, seed: int = 5,
+                      basket: Optional[pa.Table] = None,
+                      basket_cols=("ss_customer_sk", "ss_item_sk")
+                      ) -> pa.Table:
     n = int(1_440_000 * sf)
     rng = np.random.default_rng(seed)
     c = _sales_common(rng, n, sf)
     ship_date = rng.integers(1, _N_DATES + 1, n)
+    bill_cust, cs_item = _correlate_baskets(
+        rng, n, rng.integers(1, n_customers(sf) + 1, n),
+        rng.integers(1, n_items(sf) + 1, n), basket,
+        basket_cols[0], basket_cols[1])
     return pa.table({
         "cs_sold_date_sk": pa.array(rng.integers(1, _N_DATES + 1, n),
                                     pa.int64()),
         "cs_sold_time_sk": pa.array(rng.integers(0, 86400 // 60, n) * 60,
                                     pa.int64()),
         "cs_ship_date_sk": pa.array(ship_date, pa.int64()),
-        "cs_bill_customer_sk": pa.array(
-            rng.integers(1, n_customers(sf) + 1, n), pa.int64()),
+        "cs_bill_customer_sk": pa.array(bill_cust, pa.int64()),
         "cs_bill_cdemo_sk": pa.array(rng.integers(1, 19_201, n), pa.int64()),
         "cs_bill_hdemo_sk": pa.array(rng.integers(1, 7201, n), pa.int64()),
         "cs_bill_addr_sk": pa.array(rng.integers(1, n_addresses(sf) + 1, n),
@@ -495,8 +560,7 @@ def gen_catalog_sales(sf: float, seed: int = 5) -> pa.Table:
         "cs_catalog_page_sk": pa.array(rng.integers(1, 11_001, n), pa.int64()),
         "cs_warehouse_sk": pa.array(
             rng.integers(1, n_warehouses(sf) + 1, n), pa.int64()),
-        "cs_item_sk": pa.array(rng.integers(1, n_items(sf) + 1, n),
-                               pa.int64()),
+        "cs_item_sk": pa.array(cs_item, pa.int64()),
         "cs_promo_sk": pa.array(rng.integers(1, 301, n), pa.int64()),
         "cs_order_number": pa.array(np.arange(1, n + 1) // 4 + 1, pa.int64()),
         "cs_quantity": pa.array(c["qty"].astype(np.float64), pa.float64()),
@@ -553,10 +617,15 @@ def gen_catalog_returns(sf: float, catalog_sales: pa.Table,
     })
 
 
-def gen_web_sales(sf: float, seed: int = 7) -> pa.Table:
+def gen_web_sales(sf: float, seed: int = 7,
+                  basket: Optional[pa.Table] = None) -> pa.Table:
     n = int(720_000 * sf)
     rng = np.random.default_rng(seed)
     c = _sales_common(rng, n, sf)
+    bill_cust, ws_item = _correlate_baskets(
+        rng, n, rng.integers(1, n_customers(sf) + 1, n),
+        rng.integers(1, n_items(sf) + 1, n), basket,
+        "ss_customer_sk", "ss_item_sk")
     return pa.table({
         "ws_sold_date_sk": pa.array(rng.integers(1, _N_DATES + 1, n),
                                     pa.int64()),
@@ -564,10 +633,8 @@ def gen_web_sales(sf: float, seed: int = 7) -> pa.Table:
                                     pa.int64()),
         "ws_ship_date_sk": pa.array(rng.integers(1, _N_DATES + 1, n),
                                     pa.int64()),
-        "ws_item_sk": pa.array(rng.integers(1, n_items(sf) + 1, n),
-                               pa.int64()),
-        "ws_bill_customer_sk": pa.array(
-            rng.integers(1, n_customers(sf) + 1, n), pa.int64()),
+        "ws_item_sk": pa.array(ws_item, pa.int64()),
+        "ws_bill_customer_sk": pa.array(bill_cust, pa.int64()),
         "ws_bill_cdemo_sk": pa.array(rng.integers(1, 19_201, n), pa.int64()),
         "ws_bill_hdemo_sk": pa.array(rng.integers(1, 7201, n), pa.int64()),
         "ws_bill_addr_sk": pa.array(rng.integers(1, n_addresses(sf) + 1, n),
@@ -696,8 +763,13 @@ def _decimalize(table: pa.Table) -> pa.Table:
 def tables_for(sf: float, seed: int = 0) -> Dict[str, pa.Table]:
     """All 24 TPC-DS tables, seeded and internally consistent."""
     ss = gen_store_sales(sf, seed + 3)
-    cs = gen_catalog_sales(sf, seed + 5)
-    ws = gen_web_sales(sf, seed + 7)
+    sr = gen_store_returns(sf, ss, seed + 4)
+    # catalog re-buys correlate with RETURNED store pairs (q25/q29-family
+    # bought->returned->re-bought chains); web buys correlate with store
+    # sales (q54-family cross-channel customers)
+    cs = gen_catalog_sales(sf, seed + 5, basket=sr,
+                           basket_cols=("sr_customer_sk", "sr_item_sk"))
+    ws = gen_web_sales(sf, seed + 7, basket=ss)
     out = {
         "date_dim": gen_date_dim(seed),
         "time_dim": gen_time_dim(),
@@ -717,7 +789,7 @@ def tables_for(sf: float, seed: int = 0) -> Dict[str, pa.Table]:
         "call_center": gen_call_center(),
         "catalog_page": gen_catalog_page(),
         "store_sales": ss,
-        "store_returns": gen_store_returns(sf, ss, seed + 4),
+        "store_returns": sr,
         "catalog_sales": cs,
         "catalog_returns": gen_catalog_returns(sf, cs, seed + 6),
         "web_sales": ws,

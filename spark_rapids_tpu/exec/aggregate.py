@@ -631,7 +631,9 @@ class HashAggregateExec(UnaryExec):
                                      avalid & out_row_valid, data.offsets)
                     )
                     continue
-                if src is not None and src.is_wide_decimal:
+                wide_bt = (isinstance(bt, T.DecimalType) and bt.precision
+                           > T.DecimalType.MAX_LONG_DIGITS)
+                if src is not None and (src.is_wide_decimal or wide_bt):
                     out_cols.append(self._wide_agg(
                         src, gi, contributing, op, bt, cap, out_row_valid))
                     continue
@@ -647,11 +649,16 @@ class HashAggregateExec(UnaryExec):
 
     def _wide_agg(self, src: DeviceColumn, gi: K.GroupInfo, contributing,
                   op: str, bt, cap: int, out_row_valid) -> DeviceColumn:
-        """Segment reduction over a DECIMAL128 (hi, lo) column."""
+        """Segment reduction over a DECIMAL128 (hi, lo) column — or a
+        narrow int64 decimal whose sum buffer is wide (sign-extended)."""
         from spark_rapids_tpu.exec import int128 as I128
 
-        lo = src.data[gi.perm]
-        hi = src.data2[gi.perm]
+        if src.is_wide_decimal:
+            lo = src.data[gi.perm]
+            hi = src.data2[gi.perm]
+        else:
+            lo = src.data.astype(jnp.int64)[gi.perm]
+            hi = jnp.where(lo < 0, jnp.int64(-1), jnp.int64(0))
         valid = src.validity[gi.perm]
         live = contributing & valid
         any_valid = jax.ops.segment_max(
