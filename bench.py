@@ -1,14 +1,23 @@
-"""Benchmark driver: TPC-H Q1+Q6 on the TPU exec stack vs a host-CPU engine.
+"""Benchmark driver: TPC-H Q1+Q6 (scan/filter/agg) on the TPU exec stack
+vs a vectorized host-CPU engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints two JSON lines; the LAST is the driver metric
+{"metric", "value", "unit", "vs_baseline"} (the first is diagnostics).
 
-Measures the steady-state device pipeline: input batches are TPU-resident
-(as they are mid-query after a scan/shuffle stage), and each run executes
-the full operator pipeline (filter -> compaction -> grouped aggregation ->
-sort) on device. ``vs_baseline`` is the speedup over the same queries on a
-vectorized host CPU engine (pandas/numpy — the in-environment stand-in for
-CPU Spark; the reference repo publishes no absolute numbers, BASELINE.md).
-Metric value is total processed rows/sec across both queries.
+Methodology (this platform): the axon tunnel has a fixed ~100ms
+dispatch+readback round trip, so single-iteration wall-clock mostly measures
+the tunnel, not the engine.  Sustained throughput is the engine-relevant
+number: N iterations are dispatched back-to-back (the device pipeline keeps
+them in flight) and ONE fence closes the run; per-iteration time is
+total/N.  The same statistic (min over repeats) is used on the CPU side.
+Single-iteration latency (incl. one round trip) is also printed per query
+for honesty — it is the interactive-query floor on this tunnel.
+
+``vs_baseline`` is the speedup over the same queries (Q1+Q6) on the host
+CPU engine (pandas/numpy — the in-environment stand-in for CPU Spark; the
+reference repo publishes no absolute numbers, BASELINE.md).  Join (Q3)
+timing lives in docs/perf_notes_r03.md until join kernels fit the
+driver-run budget (tests/test_tpch.py covers join correctness).
 """
 
 from __future__ import annotations
@@ -19,7 +28,8 @@ import time
 import numpy as np
 
 SF = 2.0  # 12M lineitem rows; ~800MB device-resident, well within 16GB HBM
-RUNS = 5
+RUNS = 4
+DEPTH = 8  # pipelined iterations per timed run
 
 
 def _cpu_engine(li):
@@ -32,7 +42,7 @@ def _cpu_engine(li):
     hi = (np.datetime64("1995-01-01") - np.datetime64("1970-01-01")).astype(int)
     cut = (np.datetime64("1998-09-03") - np.datetime64("1970-01-01")).astype(int)
 
-    def run():
+    def run_q1q6():
         # Q6
         m = ((ship >= lo) & (ship < hi)
              & (df.l_discount.to_numpy() >= 0.05 - 1e-9)
@@ -55,28 +65,32 @@ def _cpu_engine(li):
                    n=("l_quantity", "size")))
         return q6, q1
 
-    return run
+    return None, run_q1q6
 
 
 def main():
     from spark_rapids_tpu.bench import tpch
     from spark_rapids_tpu.bench.tpch import _source
     from spark_rapids_tpu.columnar.batch import batch_to_arrow
+    from spark_rapids_tpu.utils.sync import fence
 
     li = tpch.gen_lineitem(SF, seed=7)
     n_rows = li.num_rows
 
-    cpu = _cpu_engine(li)
-    q6_expected, q1_expected = cpu()  # warm
-    cpu_times = []
+    _, cpu16 = _cpu_engine(li)
+    q6_expected, q1_expected = cpu16()  # warm
+    cpu16_times = []
     for _ in range(RUNS):
         t0 = time.perf_counter()
-        cpu()
-        cpu_times.append(time.perf_counter() - t0)
-    cpu_s = min(cpu_times)  # same statistic as the TPU side
+        cpu16()
+        cpu16_times.append(time.perf_counter() - t0)
+    cpu_q1q6 = min(cpu16_times)
 
-    # device-resident source, built once (steady-state pipeline input)
-    src = _source(li, batch_rows=1 << 23)
+    # device-resident source, built once (steady-state pipeline input);
+    # one batch for lineitem: per-batch fixed costs (merge/concat) vanish.
+    # (Q3/joins are benchmarked separately — docs/perf_notes_r03.md — their
+    # first-compile cost doesn't fit the driver's bench budget yet.)
+    src = _source(li, batch_rows=1 << 24)
     for c in src._parts[0][0].columns:
         c.data.block_until_ready()
 
@@ -84,45 +98,53 @@ def main():
     # jit caches hit and the loop measures execution, not tracing/compiling
     nodes = {"q6": tpch.q6(src), "q1": tpch.q1(src)}
 
-    from spark_rapids_tpu.utils.sync import fence
-
-    def run_tpu():
-        # fence() forces execution with a dependent 1-element readback per
-        # output array — block_until_ready returns at dispatch on this
-        # platform and would time async queueing, not compute
+    def run_query(name):
+        node = nodes[name]
         out = []
-        for q in ("q6", "q1"):
-            node = nodes[q]
-            batches = list(node.execute_all())
-            out.append((node, batches))
-        for _, batches in out:
-            fence(batches)
-        return out
+        for p in range(node.num_partitions()):
+            out.extend(node.execute(p))
+        return node, out
 
-    out = run_tpu()  # warm: compile
-    got_q6 = batch_to_arrow(out[0][1][0], out[0][0].output_schema).to_pylist()
+    # correctness gate (one run per query, fenced + checked)
+    node, bs = run_query("q6")
+    got_q6 = batch_to_arrow(bs[0], node.output_schema).to_pylist()
     assert abs(got_q6[0]["revenue"] - q6_expected) <= 1e-6 * abs(q6_expected)
-    got_q1 = [r for b in out[1][1]
-              for r in batch_to_arrow(b, out[1][0].output_schema).to_pylist()]
+    node, bs = run_query("q1")
+    got_q1 = [r for b in bs
+              for r in batch_to_arrow(b, node.output_schema).to_pylist()]
     assert len(got_q1) == len(q1_expected)
     for row, (_, e) in zip(got_q1, q1_expected.reset_index().iterrows()):
         assert row["l_returnflag"] == e.l_returnflag
         assert row["count_order"] == e.n
         assert abs(row["sum_disc_price"] - e.sum_disc) <= 1e-9 * abs(e.sum_disc)
-
+    # sustained throughput: DEPTH pipelined iterations, one fence.
+    # headline = Q1+Q6 (same metric as BENCH_r02); Q3 (join) is reported
+    # separately — the sorted-hash join is its own optimization frontier.
+    lat = {}
     times = []
-    for _ in range(RUNS):
+    for r in range(RUNS):
         t0 = time.perf_counter()
-        run_tpu()
-        times.append(time.perf_counter() - t0)
+        outs = []
+        for _ in range(DEPTH):
+            for qn in ("q6", "q1"):
+                outs.append(run_query(qn)[1])
+        fence(outs)
+        times.append((time.perf_counter() - t0) / DEPTH)
     tpu_s = min(times)
+    for qn in ("q6", "q1"):
+        t0 = time.perf_counter()
+        fence([run_query(qn)[1]])
+        lat[qn] = round((time.perf_counter() - t0) * 1e3, 1)
 
-    rows_per_sec = 2 * n_rows / tpu_s  # both queries scan lineitem once each
+    rows_per_sec = 2 * n_rows / tpu_s
+    print(json.dumps({"latency_ms_single_iter": lat,
+                      "cpu_s_q1_q6": round(cpu_q1q6, 3),
+                      "tpu_s_per_iter_q1q6": round(tpu_s, 4)}))
     print(json.dumps({
         "metric": f"tpch_q1_q6_sf{SF}_rows_per_sec",
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
-        "vs_baseline": round(cpu_s / tpu_s, 3),
+        "vs_baseline": round(cpu_q1q6 / tpu_s, 3),
     }))
 
 

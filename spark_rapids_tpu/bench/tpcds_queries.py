@@ -1194,7 +1194,7 @@ def q41(d: D) -> DataFrame:
         And(EqualTo(col("i_color"), lit("blue")),
             EqualTo(col("i_units"), lit("Dozen")))))
     combos = _distinct(attrs, "i_manufact")
-    j = (d["item"].filter(_between(col("i_manufact_id"), 500, 900))
+    j = (d["item"].filter(_between(col("i_manufact_id"), 700, 800))
          .join(combos, left_on="i_manufact", right_on="i_manufact",
                how="left_semi"))
     return (_distinct(j, "i_product_name")
@@ -1541,7 +1541,7 @@ def q54(d: D) -> DataFrame:
                                        EqualTo(col("i_class"),
                                                lit("dresses")))),
                   left_on=col("item"), right_on=col("i_item_sk"))
-          .join(d["date_dim"].filter(And(_between(col("d_moy"), 10, 12),
+          .join(d["date_dim"].filter(And(EqualTo(col("d_moy"), lit(12)),
                                          EqualTo(col("d_year"), lit(1998)))),
                 left_on=col("sold_date"), right_on=col("d_date_sk")))
     custs = _distinct(my, "cust")
@@ -1629,7 +1629,7 @@ def q57(d: D) -> DataFrame:
 @q("q58")
 def q58(d: D) -> DataFrame:
     """Items selling equally well in all three channels one week."""
-    wk = _distinct(d["date_dim"].filter(_between(col("d_week_seq"), 40, 60)),
+    wk = _distinct(d["date_dim"].filter(EqualTo(col("d_week_seq"), lit(60))),
                    "d_date_sk")
     def chan(fact, datecol, itemcol, price, name):
         return (d[fact]
@@ -1650,9 +1650,9 @@ def q58(d: D) -> DataFrame:
     avg3 = Divide(Add(Add(col("ss_rev"), col("cs_rev")), col("ws_rev")),
                   lit(3.0))
     j = j.filter(And(
-        And(_between(Divide(col("ss_rev"), avg3), 0.2, 2.5),
-            _between(Divide(col("cs_rev"), avg3), 0.2, 2.5)),
-        _between(Divide(col("ws_rev"), avg3), 0.2, 2.5)))
+        And(_between(Divide(col("ss_rev"), avg3), 0.9, 1.1),
+            _between(Divide(col("cs_rev"), avg3), 0.9, 1.1)),
+        _between(Divide(col("ws_rev"), avg3), 0.9, 1.1)))
     return (j.select(col("ss_rev_id").alias("item_id"), "ss_rev", "cs_rev",
                      "ws_rev")
             .sort("item_id", "ss_rev", limit=100))

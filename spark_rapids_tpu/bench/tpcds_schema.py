@@ -117,12 +117,16 @@ def gen_item(sf: float, seed: int = 1) -> pa.Table:
                       "N/A", "petite"])
     units = np.array(["Each", "Dozen", "Case", "Pound", "Oz", "Gross"])
     cat_id = rng.integers(0, len(cats), n)
-    class_lut = {c: np.array([int(np.where(classes == cl)[0][0])
-                              for cl in cls])
-                 for c, cls in cat_classes.items()}
-    class_id = np.array([
-        class_lut[cats[ci]][rng.integers(0, len(class_lut[cats[ci]]))]
-        for ci in cat_id], dtype=np.int64)
+    # vectorized per-category class pick: padded (n_cats, max_classes) LUT
+    max_cls = max(len(v) for v in cat_classes.values())
+    lut = np.zeros((len(cats), max_cls), np.int64)
+    sizes = np.zeros(len(cats), np.int64)
+    for ci, c in enumerate(cats):
+        idxs = [int(np.where(classes == cl)[0][0]) for cl in cat_classes[c]]
+        lut[ci, : len(idxs)] = idxs
+        sizes[ci] = len(idxs)
+    slot = (rng.random(n) * sizes[cat_id]).astype(np.int64)
+    class_id = lut[cat_id, slot]
     brand_id = rng.integers(1, 1000, n)
     manufact_id = rng.integers(1, 1000, n)
     cur = _money(rng, 0.5, 100.0, n)

@@ -100,6 +100,8 @@ class HashJoinExec(BinaryExec):
                 + (f" cond={self.condition!r}" if self.condition is not None else ""))
 
     # -- execution ---------------------------------------------------------
+    DENSE_MAX_DOMAIN = 1 << 25  # 128MB int32 lookup table cap
+
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._prepare()
         with self.timer("buildTimeNs"):
@@ -110,13 +112,19 @@ class HashJoinExec(BinaryExec):
             else:
                 from spark_rapids_tpu.columnar.batch import empty_batch
                 build = empty_batch(self.right.output_schema.types(), 16)
-            jh = _prepare_build(build, tuple(self._rkeys))
+            dense = self._prepare_dense(build)
+            jh = None if dense is not None else _prepare_build(
+                build, tuple(self._rkeys))
         build_matched = jnp.zeros(build.capacity, jnp.bool_)
 
         for probe in self.left.execute(partition):
             with self.timer("joinTimeNs"):
-                out, build_matched = self._join_batch(probe, build, jh,
-                                                      build_matched)
+                if dense is not None:
+                    out, build_matched = self._join_batch_dense(
+                        probe, build, dense, build_matched, partition)
+                else:
+                    out, build_matched = self._join_batch(probe, build, jh,
+                                                          build_matched)
             if out is not None:
                 yield out
 
@@ -124,6 +132,74 @@ class HashJoinExec(BinaryExec):
             out = self._unmatched_build(build, build_matched)
             if out is not None:
                 yield out
+
+    # -- dense surrogate-key fast path -------------------------------------
+    # TPC-style schemas join facts to dimensions on DENSE INT SURROGATE KEYS
+    # (unique on the build side). On TPU that makes the whole hash table
+    # machinery unnecessary: scatter build row ids into a direct-address
+    # table once, then every probe batch is ONE gather — no sort, no hash,
+    # no per-batch candidate-count host sync, and the output capacity is
+    # statically bounded by the probe capacity (max one match per row).
+    # cuDF has no analog (it cannot assume key density); the sorted-hash
+    # path remains the general fallback.
+    def _prepare_dense(self, build: ColumnarBatch):
+        if len(self._rkeys) != 1:
+            return None
+        assert self.join_type in JOIN_TYPES  # all types have a dense impl
+        kcol = build.columns[self._rkeys[0]]
+        pdt = self.left.output_schema[self._lkeys[0]].dtype
+        if (kcol.offsets is not None or kcol.is_dict or kcol.is_wide_decimal
+                or kcol.dtype not in (T.INT, T.LONG)
+                or pdt not in (T.INT, T.LONG)):
+            return None
+        stats = jax.device_get(_dense_key_stats(build, self._rkeys[0]))
+        kmin, kmax, n_valid = (int(stats[0]), int(stats[1]), int(stats[2]))
+        if n_valid == 0 or kmin < 0 or kmax >= self.DENSE_MAX_DOMAIN:
+            return None
+        size = bucket_capacity(kmax + 1, 16)
+        tbl, dup_any = _dense_build_table(build, self._rkeys[0], size)
+        if bool(jax.device_get(dup_any)):
+            return None  # non-unique build keys: general path
+        return tbl
+
+    def _join_batch_dense(self, probe: ColumnarBatch, build: ColumnarBatch,
+                          tbl, build_matched, partition: int):
+        jt = self.join_type
+        out_cap = probe.capacity
+        pcaps = {i: c.byte_capacity
+                 for i, c in enumerate(probe.columns) if c.offsets is not None}
+        # static byte bound for gathered build strings: one match per probe
+        # row at the longest build row length; keyed per (partition,
+        # out_cap) — each partition rebuilds its build side, and a larger
+        # probe bucket needs a larger bound
+        cache = getattr(self, "_dense_bcache", None)
+        if cache is None:
+            cache = self._dense_bcache = {}
+        ckey = (partition, out_cap)
+        if ckey not in cache:
+            caps = {}
+            for i, c in enumerate(build.columns):
+                if c.offsets is not None:
+                    ml = int(jax.device_get(
+                        jnp.max(c.offsets[1:] - c.offsets[:-1])))
+                    caps[i] = bucket_capacity(max(out_cap * max(ml, 1), 8), 8)
+            cache[ckey] = caps
+        self._pcaps, self._bcaps = pcaps, cache[ckey]
+        pi, bi, hit, n_out, new_matched = _dense_probe(
+            probe, build, tbl, self._lkeys[0], self._cond_bound, jt,
+            build_matched, tuple(sorted(cache[ckey].items())))
+        if jt == "left_semi":
+            idx, n = K.filter_indices(hit, probe.active_mask())
+            return K.gather_batch(probe, idx, n), new_matched
+        if jt == "left_anti":
+            want = ~hit & probe.active_mask()
+            idx, n = K.filter_indices(want, probe.active_mask())
+            return K.gather_batch(probe, idx, n), new_matched
+        bi_valid = bi >= 0
+        out = self._gather_pairs(probe, build, pi,
+                                 jnp.where(bi_valid, bi, 0), bi_valid,
+                                 n_out, out_cap)
+        return out, new_matched
 
     def _join_batch(self, probe: ColumnarBatch, build: ColumnarBatch,
                     jh: K.JoinHashes, build_matched):
@@ -216,6 +292,67 @@ def _pad_idx(idx: jax.Array, out_cap: int) -> jax.Array:
         return idx[:out_cap]
     pad = jnp.zeros(out_cap - idx.shape[0], jnp.int32)
     return jnp.concatenate([idx, pad])
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _dense_key_stats(build: ColumnarBatch, key: int):
+    c = build.columns[key]
+    live = c.validity & build.active_mask()
+    k = c.data.astype(jnp.int64)
+    kmin = jnp.min(jnp.where(live, k, jnp.int64(2**62)))
+    kmax = jnp.max(jnp.where(live, k, jnp.int64(-1)))
+    return jnp.stack([kmin, kmax, jnp.sum(live.astype(jnp.int64))])
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _dense_build_table(build: ColumnarBatch, key: int, size: int):
+    c = build.columns[key]
+    live = c.validity & build.active_mask()
+    k = jnp.where(live, c.data.astype(jnp.int32), size)
+    rows = jnp.arange(build.capacity, dtype=jnp.int32)
+    tbl = jnp.full(size, -1, jnp.int32)
+    tbl = tbl.at[k].set(rows, mode="drop")
+    counts = jnp.zeros(size, jnp.int32).at[k].add(1, mode="drop")
+    return tbl, jnp.any(counts > 1)
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5, 7))
+def _dense_probe(probe: ColumnarBatch, build: ColumnarBatch, tbl,
+                 lkey: int, cond, jt: str, build_matched, bcaps_t=()):
+    size = tbl.shape[0]
+    cap = probe.capacity
+    kc = probe.columns[lkey]
+    k64 = kc.data.astype(jnp.int64)
+    kvalid = kc.validity & probe.active_mask()
+    inb = (k64 >= 0) & (k64 < size)
+    safe = jnp.where(kvalid & inb, k64, 0).astype(jnp.int32)
+    cand = tbl[safe]
+    hit = kvalid & inb & (cand >= 0)
+    if cond is not None:
+        from spark_rapids_tpu.exprs import eval as EV
+
+        bsafe = jnp.where(hit, cand, 0)
+        bcaps = dict(bcaps_t)
+        pair_cols = list(probe.columns)
+        for ci, c in enumerate(build.columns):
+            pair_cols.append(K.gather_column(c, bsafe, hit, bcaps.get(ci)))
+        pair = ColumnarBatch(pair_cols, probe.num_rows)
+        cv = EV.eval_expr(cond, EV.EvalContext(pair))
+        hit = hit & cv.data & cv.validity
+    if jt in ("left", "full"):
+        pi = jnp.arange(cap, dtype=jnp.int32)
+        bi = jnp.where(hit, cand, -1)
+        n_out = probe.num_rows
+    else:
+        pi, n_out = K.filter_indices(hit, probe.active_mask())
+        row_live = jnp.arange(cap, dtype=jnp.int32) < n_out
+        bi = jnp.where(row_live, cand[jnp.where(row_live, pi, 0)], -1)
+    if jt in ("right", "full"):
+        new_matched = build_matched.at[
+            jnp.where(hit, cand, build.capacity)].set(True, mode="drop")
+    else:
+        new_matched = build_matched
+    return pi, bi, hit, n_out, new_matched
 
 
 def _null_column(dtype: T.DataType, capacity: int) -> DeviceColumn:
