@@ -289,7 +289,9 @@ def q8(d: D) -> DataFrame:
     preferred-customer zips, as a semi join)."""
     zips = _distinct(d["customer_address"].filter(
         In(Substring(col("ca_zip"), 1, 2),
-           [lit(z) for z in ("24", "35", "40", "54", "60", "77", "89")]))
+           [lit(z) for z in ("13", "24", "27", "35", "40", "45", "51",
+                             "54", "60", "66", "72", "77", "81", "89",
+                             "90")]))
         .select(Substring(col("ca_zip"), 1, 2).alias("zip_pref")),
         "zip_pref")
     pref = _distinct(

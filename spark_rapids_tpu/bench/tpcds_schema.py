@@ -120,12 +120,12 @@ def gen_item(sf: float, seed: int = 1) -> pa.Table:
     # vectorized per-category class pick: padded (n_cats, max_classes) LUT
     max_cls = max(len(v) for v in cat_classes.values())
     lut = np.zeros((len(cats), max_cls), np.int64)
-    sizes = np.zeros(len(cats), np.int64)
+    lut_n = np.zeros(len(cats), np.int64)
     for ci, c in enumerate(cats):
         idxs = [int(np.where(classes == cl)[0][0]) for cl in cat_classes[c]]
         lut[ci, : len(idxs)] = idxs
-        sizes[ci] = len(idxs)
-    slot = (rng.random(n) * sizes[cat_id]).astype(np.int64)
+        lut_n[ci] = len(idxs)
+    slot = (rng.random(n) * lut_n[cat_id]).astype(np.int64)
     class_id = lut[cat_id, slot]
     brand_id = rng.integers(1, 1000, n)
     manufact_id = rng.integers(1, 1000, n)

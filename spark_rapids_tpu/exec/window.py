@@ -298,8 +298,10 @@ class WindowExec(UnaryExec):
             a = frame.start
             b = frame.end
             assert a is not W.UNBOUNDED and b is not W.UNBOUNDED
-            assert not isinstance(f, (E.Min, E.Max)), (
-                "bounded min/max windows not on device in round 1")
+            if isinstance(f, (E.Min, E.Max)):
+                return self._bounded_minmax(f, vals, valid, active, seg_flag,
+                                            seg_start, seg_end, idx, a, b,
+                                            out_t, cap)
             pre_s = jnp.cumsum(masked)
             pre_c = jnp.cumsum(ones)
             lo = jnp.maximum(idx + a, seg_start)
@@ -314,6 +316,85 @@ class WindowExec(UnaryExec):
             return _finish_agg(f, out_t, s, c, active)
 
         raise NotImplementedError(f"window frame {frame!r}")
+
+    def _bounded_minmax(self, f, vals, valid, active, seg_flag, seg_start,
+                        seg_end, idx, a: int, b: int, out_t, cap: int):
+        """Bounded-ROWS min/max via the sliding-window block trick with
+        SEGMENT-aware resets (no sort, no per-row loop, O(n)).
+
+        Windows of fixed row width w = b-a+1 span at most two w-aligned
+        blocks; a prefix scan that resets at block AND segment starts plus a
+        suffix scan that resets at block AND segment ends cover the clipped
+        window exactly:
+          lo' = max(i+a, seg_start); hi = min(i+b, seg_end)
+          blockstart(hi) <= lo'  ->  prefix[hi]           (one-block window)
+          else                   ->  op(suffix[lo'], prefix[hi])
+        (reference: cudf uses per-row windowed reductions; this formulation
+        is TPU-first — two scans and two gathers.)
+        """
+        op = jnp.minimum if isinstance(f, E.Min) else jnp.maximum
+        w = max(b - a + 1, 1)
+        pos = idx
+        block_flag = (pos % w) == 0
+        pre_flags = seg_flag | block_flag
+        # suffix resets (scanning right-to-left): block ends / segment ends
+        rev_block_end = (pos % w) == (w - 1)
+        suf_reset = _rev_flags(seg_flag) | rev_block_end[::-1]
+
+        is_f = jnp.issubdtype(vals.dtype, jnp.floating)
+        if is_f:
+            d, is_nan = K._float_canonical(vals)
+            live = valid & active & ~is_nan
+            ident = jnp.float64(np.inf if isinstance(f, E.Min) else -np.inf)
+            m = jnp.where(live, d, ident)
+            nanrow = (valid & active & is_nan).astype(jnp.int32)
+        else:
+            live = valid & active
+            if vals.dtype == jnp.bool_:
+                ident = isinstance(f, E.Min)  # True for Min, False for Max
+            else:
+                ii = jnp.iinfo(vals.dtype)
+                ident = ii.max if isinstance(f, E.Min) else ii.min
+            m = jnp.where(live, vals, jnp.full_like(vals, ident))
+            nanrow = None
+        cnt_row = live.astype(jnp.int32)
+
+        def two_sided(row, comb, identity):
+            pre = _segmented_scan(row, pre_flags, comb)
+            suf = _segmented_scan(row[::-1], suf_reset, comb)[::-1]
+            lo = jnp.maximum(pos + a, seg_start)
+            hi = jnp.minimum(pos + b, seg_end)
+            empty = hi < lo
+            lo_c = jnp.clip(lo, 0, cap - 1)
+            hi_c = jnp.clip(hi, 0, cap - 1)
+            # pre[hi] covers [max(blockstart(hi), seg_start) .. hi];
+            # suf[lo] covers [lo .. min(blockend(lo), seg_end)].
+            # Different blocks: the two halves tile [lo..hi] exactly.
+            # Same block: exactly one of the scans starts/ends ON the
+            # window bound (windows are full-width or segment-clipped) —
+            # pick pre when its reset IS lo, else suf.
+            blockstart_hi = (hi_c // w) * w
+            same_block = blockstart_hi <= lo_c
+            pre_exact = jnp.maximum(blockstart_hi, seg_start) == lo_c
+            out = jnp.where(
+                same_block,
+                jnp.where(pre_exact, pre[hi_c], suf[lo_c]),
+                comb(suf[lo_c], pre[hi_c]))
+            return jnp.where(empty, identity, out), empty
+
+        red, empty = two_sided(m, op, jnp.asarray(ident, m.dtype))
+        cnt, _ = two_sided(cnt_row, jnp.add, jnp.int32(0))
+        has = (cnt > 0) & ~empty
+        if is_f:
+            nan_cnt, _ = two_sided(nanrow, jnp.add, jnp.int32(0))
+            nan_seen = nan_cnt > 0
+            any_val = has | (nan_seen & ~empty)
+            if isinstance(f, E.Max):
+                dec = jnp.where(nan_seen, jnp.float64(np.nan), red)
+            else:
+                dec = jnp.where(has, red, jnp.float64(np.nan))
+            return _win_out(out_t, dec.astype(vals.dtype), any_val, active)
+        return _win_out(out_t, red, has, active)
 
     def _scan_minmax(self, f, vals, valid, seg_flag, cnt, out_t, active,
                      gather_at, idx):
@@ -342,8 +423,11 @@ class WindowExec(UnaryExec):
             else:
                 dec = jnp.where(clean_seen, red, jnp.float64(np.nan))
             return _win_out(out_t, dec.astype(vals.dtype), cnt > 0, active)
-        ii = jnp.iinfo(vals.dtype if vals.dtype != jnp.bool_ else jnp.int8)
-        ident = ii.max if isinstance(f, E.Min) else ii.min
+        if vals.dtype == jnp.bool_:
+            ident = isinstance(f, E.Min)  # True for Min, False for Max
+        else:
+            ii = jnp.iinfo(vals.dtype)
+            ident = ii.max if isinstance(f, E.Min) else ii.min
         m = jnp.where(valid & active, vals, jnp.full_like(vals, ident))
         red = _segmented_scan(m, seg_flag, op)
         if gather_at is not None:

@@ -387,7 +387,7 @@ class CpuWindowExec(CpuExec, UnaryExec):
                     dv, _ = cpu_eval(E.resolve(f.default, cs), t, cs)
                     res = res.fillna(np.atleast_1d(dv)[0])
             elif isinstance(f, E.AggregateExpression):
-                res = _cpu_window_agg(df, grouper, f, frame, cs, t, okeys)
+                res = _cpu_window_agg(df, grouper, f, frame, cs, t, okeys, asc)
             else:
                 raise NotImplementedError(f"cpu window {type(f).__name__}")
             if hasattr(res, "reindex"):
@@ -481,7 +481,7 @@ def _ntile(df, grouper, n):
     return pd.concat([pd.Series(tile(len(g)), g.index) for _, g in grouper])
 
 
-def _cpu_window_agg(df, grouper, f, frame, cs, t, okeys=()):
+def _cpu_window_agg(df, grouper, f, frame, cs, t, okeys=(), asc=()):
     import pandas as pd
 
     from spark_rapids_tpu.exprs import window as W
@@ -490,7 +490,7 @@ def _cpu_window_agg(df, grouper, f, frame, cs, t, okeys=()):
     kind = type(f).__name__
     in_dt = E.resolve(f.children[0], cs).dtype if f.children else None
     if isinstance(in_dt, T.DecimalType):
-        return _dec_window_agg(df, grouper, f, in_dt, frame, cs, t, okeys)
+        return _dec_window_agg(df, grouper, f, in_dt, frame, cs, t, okeys, asc)
 
     if f.children:
         # vals is in ORIGINAL row order; df is partition-sorted and its
@@ -510,11 +510,9 @@ def _cpu_window_agg(df, grouper, f, frame, cs, t, okeys=()):
     pieces = []
     for g in groups:
         gs = s.loc[g.index]
-        if frame.is_unbounded_both or (frame.kind == "range"
-                                       and not frame.is_running):
-            if frame.is_unbounded_both:
-                pieces.append(_full_agg(gs, kind, g))
-                continue
+        if frame.is_unbounded_both:
+            pieces.append(_full_agg(gs, kind, g))
+            continue
         if frame.is_running or (frame.kind == "range" and frame.is_running):
             res = _running_agg(gs, kind, g)
             if frame.kind == "range" and okeys:
@@ -531,11 +529,86 @@ def _cpu_window_agg(df, grouper, f, frame, cs, t, okeys=()):
             hi = frame.end
             pieces.append(_rows_agg(gs, kind, lo, hi, g))
             continue
+        if frame.kind == "range":
+            # bounded RANGE: window = rows whose order-key VALUE lies in
+            # [v_i + start, v_i + end] (one numeric order key; Spark rule)
+            assert len(okeys) == 1, "bounded RANGE needs one order key"
+            kv = g[okeys[0]].to_numpy().astype(np.float64)
+            los, his = _range_bounds(kv, frame.start, frame.end,
+                                     ascending=asc[0] if asc else True)
+            pieces.append(_bounds_agg(gs, kind, los, his, g))
+            continue
         raise NotImplementedError(f"cpu window frame {frame!r}")
     return pd.concat(pieces)
 
 
-def _dec_window_agg(df, grouper, f, in_dt, frame, cs, t, okeys):
+def _range_bounds(kv: np.ndarray, start, end, ascending: bool = True):
+    """Window index bounds for value-range frames.
+
+    NULL order keys (NaN here) form their own peer group: their frame is
+    the whole run of nulls (Spark RangeFrame null handling).  Descending
+    keys search on the negated array with swapped offsets.
+    """
+    n = len(kv)
+    isnull = np.isnan(kv)
+    if not ascending:
+        kv = -kv
+        start, end = (None if end is None else -end,
+                      None if start is None else -start)
+    # nulls sort to one end; searchsorted needs the non-null run
+    nn = np.flatnonzero(~isnull)
+    los = np.zeros(n, np.int64)
+    his = np.full(n, n - 1, np.int64)
+    if len(nn):
+        n0, n1 = nn[0], nn[-1]          # non-null run [n0, n1]
+        sub = kv[n0: n1 + 1]
+        if start is None:
+            los[n0: n1 + 1] = n0
+        else:
+            los[n0: n1 + 1] = n0 + np.searchsorted(sub, sub + start,
+                                                   side="left")
+        if end is None:
+            his[n0: n1 + 1] = n1
+        else:
+            his[n0: n1 + 1] = n0 + np.searchsorted(sub, sub + end,
+                                                   side="right") - 1
+    if isnull.any():
+        nl = np.flatnonzero(isnull)
+        los[nl] = nl[0]
+        his[nl] = nl[-1]
+    return los, his
+
+
+def _bounds_agg(gs, kind, los, his, g):
+    import pandas as pd
+
+    vals = gs.to_numpy()
+    out = []
+    for i, (a, b) in enumerate(zip(los, his)):
+        window = vals[a:b + 1] if b >= a else vals[:0]
+        window = window[~pd.isna(window)]
+        if kind == "Count":
+            out.append(len(window))
+        elif len(window) == 0:
+            out.append(np.nan)
+        elif kind == "Sum":
+            out.append(window.sum())
+        elif kind == "Average":
+            out.append(window.mean())
+        elif kind == "Min":
+            out.append(window.min())
+        elif kind == "Max":
+            out.append(window.max())
+        elif kind == "First":
+            out.append(window[0])
+        elif kind == "Last":
+            out.append(window[-1])
+        else:
+            raise NotImplementedError(kind)
+    return pd.Series(out, g.index)
+
+
+def _dec_window_agg(df, grouper, f, in_dt, frame, cs, t, okeys, asc=()):
     """Exact decimal window aggregation: Python-int sums, HALF_UP average —
     mirrors the device int64 window path (exec/window.py _finish_agg)."""
     import pandas as pd
@@ -603,6 +676,12 @@ def _dec_window_agg(df, grouper, f, in_dt, frame, cs, t, okeys):
             bounds = [(0 if lo is None else max(0, j + lo),
                        n - 1 if hi is None else min(n - 1, j + hi))
                       for j in range(n)]
+        elif frame.kind == "range":
+            assert len(okeys) == 1, "bounded RANGE needs one order key"
+            kv = g[list(okeys)[0]].to_numpy().astype(np.float64)
+            los, his = _range_bounds(kv, frame.start, frame.end,
+                                     ascending=asc[0] if asc else True)
+            bounds = list(zip(los.tolist(), his.tolist()))
         else:
             raise NotImplementedError(f"cpu decimal window frame {frame!r}")
 
@@ -627,6 +706,12 @@ def _full_agg(gs, kind, g):
         v = gs.min()
     elif kind == "Max":
         v = gs.max()
+    elif kind == "First":
+        nn = gs.dropna()
+        v = nn.iloc[0] if len(nn) else np.nan
+    elif kind == "Last":
+        nn = gs.dropna()
+        v = nn.iloc[-1] if len(nn) else np.nan
     else:
         raise NotImplementedError(kind)
     return pd.Series(v, g.index)
@@ -643,32 +728,23 @@ def _running_agg(gs, kind, g):
         return gs.expanding().min()
     if kind == "Max":
         return gs.expanding().max()
+    if kind == "First":
+        # running first non-null: forward-fill of the first valid value
+        first_val = gs.dropna().iloc[0] if gs.notna().any() else np.nan
+        seen = gs.notna().cummax()
+        import pandas as pd
+        return pd.Series(np.where(seen, first_val, np.nan), gs.index)
+    if kind == "Last":
+        return gs.ffill()
     raise NotImplementedError(kind)
 
 
 def _rows_agg(gs, kind, lo, hi, g):
-    import pandas as pd
-
     n = len(gs)
-    vals = gs.to_numpy()
-    out = []
-    for i in range(n):
-        a = 0 if lo is None else max(0, i + lo)
-        b = n - 1 if hi is None else min(n - 1, i + hi)
-        window = vals[a:b + 1] if b >= a else vals[:0]
-        window = window[~pd.isna(window)]
-        if kind == "Count":
-            out.append(len(window))
-        elif len(window) == 0:
-            out.append(np.nan)
-        elif kind == "Sum":
-            out.append(window.sum())
-        elif kind == "Average":
-            out.append(window.mean())
-        elif kind == "Min":
-            out.append(window.min())
-        elif kind == "Max":
-            out.append(window.max())
-        else:
-            raise NotImplementedError(kind)
-    return pd.Series(out, g.index)
+    idx = np.arange(n)
+    los = np.zeros(n, np.int64) if lo is None else np.maximum(0, idx + lo)
+    his = (np.full(n, n - 1, np.int64) if hi is None
+           else np.minimum(n - 1, idx + hi))
+    return _bounds_agg(gs, kind, los, his, g)
+
+

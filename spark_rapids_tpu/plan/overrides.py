@@ -287,6 +287,19 @@ class Overrides:
                             "decimal128 window function not on device")
                 except (TypeError, KeyError, NotImplementedError) as ex:
                     meta.will_not_work(str(ex))
+                # frame support (reference: GpuWindowExecMeta tags frame
+                # kinds; unsupported frames must FALL BACK, not crash)
+                fr = inner.spec.resolved_frame()
+                bounded_range = (fr.kind == "range"
+                                 and not fr.is_unbounded_both
+                                 and not fr.is_running)
+                if bounded_range:
+                    meta.will_not_work(
+                        "bounded RANGE frames not on device (value-search "
+                        "windows run on the CPU engine)")
+                if isinstance(fn, (E.First, E.Last)):
+                    meta.will_not_work(
+                        "first/last window functions not on device")
         elif isinstance(node, L.Join):
             for e, s in ([(k, node.left.schema) for k in node.left_keys]
                          + [(k, node.right.schema) for k in node.right_keys]):

@@ -1,0 +1,182 @@
+"""Window frame completeness: bounded ROWS min/max on device, bounded RANGE
+on the CPU engine via plan-time tagging (no runtime crash reachable from a
+planned query) — reference: window/GpuWindowExecMeta.scala:262-299.
+"""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs.expr import col, Sum, Min, Max, Average, Count
+from spark_rapids_tpu.exprs.window import (WindowFrame, over, window_spec)
+from spark_rapids_tpu.exec.sort import SortOrder
+from spark_rapids_tpu.plan import from_arrow
+
+
+def table():
+    rng = np.random.default_rng(7)
+    n = 300
+    return pa.table({
+        "p": pa.array(rng.integers(0, 5, n).astype(np.int64)),
+        # unique order key per row: ROWS frames over order-key ties are
+        # order-dependent (both engines and Spark are non-deterministic)
+        "o": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array([None if i % 11 == 0 else float(rng.normal())
+                       for i in range(n)], type=pa.float64()),
+        "iv": pa.array(rng.integers(-50, 50, n).astype(np.int64)),
+    })
+
+
+def run(build, enabled=True):
+    df = from_arrow(table(), RapidsConf(
+        {"spark.rapids.tpu.sql.enabled": enabled}))
+    df.shuffle_partitions = 2
+    return build(df).collect()
+
+
+def assert_same(build):
+    dev, cpu = run(build, True), run(build, False)
+    assert len(dev) == len(cpu)
+    def norm(v):
+        if v is None:
+            return "\x00null"
+        if isinstance(v, float):
+            return "NaN" if math.isnan(v) else str(round(v, 9))
+        return str(v)
+
+    key = lambda r: tuple((k, norm(v)) for k, v in sorted(r.items()))
+    assert sorted(map(key, dev)) == sorted(map(key, cpu))
+    return dev
+
+
+FRAMES = [
+    WindowFrame("rows", -3, 2),
+    WindowFrame("rows", -5, 0),
+    WindowFrame("rows", 0, 4),
+    WindowFrame("rows", 2, 5),   # forward-only window (can be empty)
+    WindowFrame("rows", -1, -1),
+]
+
+
+@pytest.mark.parametrize("frame", FRAMES, ids=[repr(f) for f in FRAMES])
+def test_bounded_rows_minmax_device(frame):
+    def build(df):
+        spec = window_spec(partition_by=[col("p")],
+                           order_by=[SortOrder(col("o"))], frame=frame)
+        return df.with_window(
+            over(Min(col("v")), spec).alias("mn"),
+            over(Max(col("v")), spec).alias("mx"),
+            over(Min(col("iv")), spec).alias("imn"),
+            over(Max(col("iv")), spec).alias("imx"),
+            over(Sum(col("iv")), spec).alias("s"),
+            over(Count(col("v")), spec).alias("c"),
+        )
+    assert_same(build)
+
+
+def test_bounded_rows_minmax_stays_on_device():
+    df = from_arrow(table(), RapidsConf({}))
+    spec = window_spec(partition_by=[col("p")],
+                       order_by=[SortOrder(col("o"))],
+                       frame=WindowFrame("rows", -3, 2))
+    stats = df.with_window(
+        over(Min(col("iv")), spec).alias("mn")).device_plan_stats()
+    assert not any("Window" in c for c in stats["cpu_nodes"]), stats
+
+
+def test_bounded_range_tags_to_cpu_no_crash():
+    df = from_arrow(table(), RapidsConf({}))
+    spec = window_spec(partition_by=[col("p")],
+                       order_by=[SortOrder(col("o"))],
+                       frame=WindowFrame("range", -10, 10))
+    plan = df.with_window(over(Sum(col("iv")), spec).alias("s"))
+    stats = plan.device_plan_stats()
+    assert any("Window" in c for c in stats["cpu_nodes"]), stats
+    rows = plan.collect()  # must not raise
+    assert len(rows) == table().num_rows
+
+
+def test_bounded_range_values():
+    """RANGE BETWEEN 2 PRECEDING AND 2 FOLLOWING over integer order keys:
+    hand-checked oracle on a small partition."""
+    t = pa.table({
+        "p": pa.array([1, 1, 1, 1, 1], type=pa.int64()),
+        "o": pa.array([1, 2, 4, 7, 8], type=pa.int64()),
+        "v": pa.array([10.0, 20.0, 30.0, 40.0, 50.0]),
+    })
+    df = from_arrow(t, RapidsConf({}))
+    spec = window_spec(partition_by=[col("p")],
+                       order_by=[SortOrder(col("o"))],
+                       frame=WindowFrame("range", -2, 2))
+    rows = df.with_window(over(Sum(col("v")), spec).alias("s")).collect()
+    got = {r["o"]: r["s"] for r in rows}
+    # o=1: keys in [-1,3] -> {1,2} = 30; o=2: [0,4] -> {1,2,4} = 60
+    # o=4: [2,6] -> {2,4} = 50; o=7: [5,9] -> {7,8} = 90; o=8: [6,10] -> 90
+    assert got == {1: 30.0, 2: 60.0, 4: 50.0, 7: 90.0, 8: 90.0}, got
+
+
+def test_first_last_window_cpu_fallback():
+    """First/Last window functions tag to CPU and actually run there."""
+    t = pa.table({
+        "p": pa.array([1, 1, 1, 2, 2], type=pa.int64()),
+        "o": pa.array([1, 2, 3, 1, 2], type=pa.int64()),
+        "v": pa.array([None, 10.0, 20.0, 30.0, None], type=pa.float64()),
+    })
+    df = from_arrow(t, RapidsConf({}))
+    spec = window_spec(partition_by=[col("p")],
+                       order_by=[SortOrder(col("o"))])
+    plan = df.with_window(over(E.First(col("v")), spec).alias("f"),
+                          over(E.Last(col("v")), spec).alias("l"))
+    stats = plan.device_plan_stats()
+    assert any("Window" in c for c in stats["cpu_nodes"]), stats
+    got = {(r["p"], r["o"]): (r["f"], r["l"]) for r in plan.collect()}
+    # running frame: first valid so far / last valid so far
+    assert got[(1, 1)] == (None, None)
+    assert got[(1, 2)] == (10.0, 10.0)
+    assert got[(1, 3)] == (10.0, 20.0)
+    assert got[(2, 1)] == (30.0, 30.0)
+    assert got[(2, 2)] == (30.0, 30.0)
+
+
+def test_bounded_range_desc_order():
+    """bounded RANGE over a DESCENDING order key (searchsorted on the
+    negated key with swapped offsets)."""
+    t = pa.table({
+        "p": pa.array([1] * 5, type=pa.int64()),
+        "o": pa.array([1, 2, 4, 7, 8], type=pa.int64()),
+        "v": pa.array([10.0, 20.0, 30.0, 40.0, 50.0]),
+    })
+    df = from_arrow(t, RapidsConf({}))
+    spec = window_spec(
+        partition_by=[col("p")],
+        order_by=[SortOrder(col("o"), ascending=False)],
+        frame=WindowFrame("range", -2, 2))
+    rows = df.with_window(over(Sum(col("v")), spec).alias("s")).collect()
+    got = {r["o"]: r["s"] for r in rows}
+    # value window is still [o-2, o+2] regardless of sort direction
+    assert got == {1: 30.0, 2: 60.0, 4: 50.0, 7: 90.0, 8: 90.0}, got
+
+
+def test_running_range_peers_included():
+    """default ordered frame includes peer rows tied on the order key."""
+    t = pa.table({
+        "p": pa.array([1, 1, 1, 1], type=pa.int64()),
+        "o": pa.array([1, 2, 2, 3], type=pa.int64()),
+        "v": pa.array([1.0, 2.0, 3.0, 4.0]),
+    })
+    for enabled in (True, False):
+        df = from_arrow(t, RapidsConf(
+            {"spark.rapids.tpu.sql.enabled": enabled}))
+        spec = window_spec(partition_by=[col("p")],
+                           order_by=[SortOrder(col("o"))])
+        rows = df.with_window(
+            over(Sum(col("v")), spec).alias("s")).collect()
+        got = sorted((r["o"], r["s"]) for r in rows)
+        # peers at o=2 both see 1+2+3=6
+        assert got == [(1, 1.0), (2, 6.0), (2, 6.0), (3, 10.0)], (enabled,
+                                                                  got)
