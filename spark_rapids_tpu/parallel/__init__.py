@@ -10,6 +10,6 @@ case (UCX shuffle: SURVEY.md §2.8; shuffle-plugin/.../UCX.scala).
 
 from spark_rapids_tpu.parallel.mesh import device_mesh, shard_batch  # noqa: F401
 from spark_rapids_tpu.parallel.exchange import (  # noqa: F401
-    all_to_all_by_key,
     distributed_agg_step,
+    windowed_exchange_merge,
 )

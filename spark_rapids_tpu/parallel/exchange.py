@@ -7,11 +7,13 @@ that fits one mesh runs as a single SPMD program where repartitioning is
 (shuffle-plugin/.../UCXShuffleTransport; SURVEY.md §2.8 "TPU-native
 equivalent").
 
-Round-1 scope: fixed-width columns (strings ride the host shuffle path);
-per-target capacity equals local capacity, so the exchange buffer is n_dev x
-local_cap — safe (a device can receive at most every row) but n_dev-times
-oversized; tightening via count-prefixed variable windows is future work,
-mirroring the reference's bounce-buffer windowing (BufferSendState).
+Round-3 scope: fixed-width + dict-encoded string columns (codes shard over
+ICI, dictionaries replicate); the aggregation exchange is WINDOWED — rows
+stream in count-prefixed windows of W rows per peer and every received
+window is merged into the running aggregation state immediately, so receive
+buffering is n_dev*W = 2x local capacity instead of n_dev x local_cap.
+This mirrors the reference's bounce-buffer windowing (BufferSendState /
+WindowedBlockIterator, shuffle/RapidsShuffleServer.scala) in SPMD form.
 """
 
 from __future__ import annotations
@@ -21,6 +23,7 @@ from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -30,48 +33,95 @@ from spark_rapids_tpu.columnar.column import DeviceColumn
 from spark_rapids_tpu.exec import kernels as K
 
 
-def all_to_all_by_key(cols: Sequence[jax.Array], valids: Sequence[jax.Array],
-                      num_rows: jax.Array, key_hash: jax.Array,
-                      axis: str, n_dev: int):
-    """Inside shard_map: route each live row to device ``hash % n_dev``.
-
-    ``cols``/``valids`` are local (local_cap,) arrays; returns
-    (new_cols, new_valids, new_num_rows) with local capacity n_dev*local_cap,
-    rows front-packed in (source_device, original_order)."""
-    local_cap = cols[0].shape[0]
+def _route_by_hash(key_hash, num_rows, local_cap: int, n_dev: int):
+    """Per-target compaction maps: row indices + counts per destination."""
     live = jnp.arange(local_cap, dtype=jnp.int32) < num_rows
     target = (key_hash % jnp.uint64(n_dev)).astype(jnp.int32)
-    # per-target compaction maps
-    idx_rows = []
-    counts = []
+    idx_rows, counts = [], []
     for t in range(n_dev):
         idx_t, cnt_t = K.filter_indices(target == t, live)
         idx_rows.append(idx_t)
         counts.append(cnt_t)
-    idx = jnp.stack(idx_rows)  # (n_dev, local_cap)
-    cnt = jnp.stack(counts)  # (n_dev,)
-    slot_live = jnp.arange(local_cap, dtype=jnp.int32)[None, :] < cnt[:, None]
+    return jnp.stack(idx_rows), jnp.stack(counts)
 
-    recv_cnt = jax.lax.all_to_all(cnt, axis, 0, 0, tiled=True)  # (n_dev,)
-    out_cols, out_valids = [], []
-    flat_live = None
-    for data, valid in zip(cols, valids):
-        send = jnp.where(slot_live, data[idx], jnp.zeros_like(data)[None, :1])
-        send_v = jnp.where(slot_live, valid[idx], False)
-        recv = jax.lax.all_to_all(send, axis, 0, 0)  # (n_dev, local_cap)
-        recv_v = jax.lax.all_to_all(send_v, axis, 0, 0)
-        if flat_live is None:
-            flat_live = (jnp.arange(local_cap, dtype=jnp.int32)[None, :]
-                         < recv_cnt[:, None]).reshape(-1)
-        out_cols.append(recv.reshape(-1))
-        out_valids.append(recv_v.reshape(-1))
-    # compact received rows to the front
-    cidx, total = K.filter_indices(flat_live, jnp.ones_like(flat_live))
-    row_valid = jnp.arange(flat_live.shape[0], dtype=jnp.int32) < total
-    out_cols = [jnp.where(row_valid, c[cidx], jnp.zeros_like(c[:1]))
-                for c in out_cols]
-    out_valids = [jnp.where(row_valid, v[cidx], False) for v in out_valids]
-    return out_cols, out_valids, total
+
+def windowed_exchange_merge(part: ColumnarBatch, key_hash, n_keys: int,
+                            merge_ops, axis: str, n_dev: int,
+                            window: int = 0):
+    """Stream partial-agg rows to their hash-owner devices in W-row windows,
+    merging each received window into the running aggregation state.
+
+    Receive buffering is (n_dev, W) = 2x local rows (W = 2*local/n_dev).
+    The merge scratch holds state_cap + n_dev*W rows so a window can never
+    be dropped before merging; if MERGED distinct groups ever exceed the
+    scratch (pathological skew beyond 2x local + one window), an overflow
+    flag is returned so the caller can raise instead of mis-aggregating.
+    One lax.fori_loop round processes one window: the compiled program is
+    O(1) in round count.
+    """
+    local_cap = part.capacity
+    W = window or max(2 * local_cap // n_dev, 8)
+    rounds = -(-local_cap // W)
+    scratch_cap = 2 * local_cap + n_dev * W
+
+    idx, cnt = _route_by_hash(key_hash, part.num_rows, local_cap, n_dev)
+    idx_pad = jnp.pad(idx, ((0, 0), (0, rounds * W - idx.shape[1]))) \
+        if idx.shape[1] < rounds * W else idx
+    ncols = len(part.columns)
+    # dtype-stable carry: a dry merge of an empty scratch yields the exact
+    # post-merge column dtypes (e.g. count buffers promote to int64)
+    dry = _local_partial_agg(
+        ColumnarBatch(
+            [DeviceColumn(c.dtype, jnp.zeros(scratch_cap, c.data.dtype),
+                          jnp.zeros(scratch_cap, jnp.bool_), None,
+                          c.dictionary, c.dict_size, c.dict_max_len)
+             for c in part.columns], jnp.int32(0)),
+        n_keys, merge_ops)
+    state_d = tuple(jnp.zeros_like(c.data) for c in dry.columns)
+    state_v = tuple(jnp.zeros(scratch_cap, jnp.bool_)
+                    for _ in part.columns)
+
+    def round_body(r, carry):
+        state_d, state_v, state_n, ovf = carry
+        sl = jax.lax.dynamic_slice_in_dim(idx_pad, r * W, W, axis=1)
+        cnt_r = jnp.clip(cnt - r * W, 0, W)
+        slot_live = jnp.arange(W, dtype=jnp.int32)[None, :] < cnt_r[:, None]
+        recv_cnt = jax.lax.all_to_all(cnt_r, axis, 0, 0, tiled=True)
+        flat_live = (jnp.arange(W, dtype=jnp.int32)[None, :]
+                     < recv_cnt[:, None]).reshape(-1)
+        crank = jnp.cumsum(flat_live.astype(jnp.int32)) - 1
+        n_recv = jnp.sum(recv_cnt).astype(jnp.int32)
+        dst = jnp.where(flat_live, state_n + crank, scratch_cap)
+        ovf = ovf | (state_n + n_recv > scratch_cap)
+        new_d, new_v = [], []
+        for ci in range(ncols):
+            c = part.columns[ci]
+            send = jnp.where(slot_live, c.data[sl],
+                             jnp.zeros_like(c.data)[:1])
+            send_v = jnp.where(slot_live, c.validity[sl], False)
+            recv = jax.lax.all_to_all(send, axis, 0, 0).reshape(-1)
+            recv_v = jax.lax.all_to_all(send_v, axis, 0, 0).reshape(-1)
+            new_d.append(state_d[ci].at[dst].set(
+                recv.astype(state_d[ci].dtype), mode="drop"))
+            new_v.append(state_v[ci].at[dst].set(recv_v, mode="drop"))
+        state_n = jnp.minimum(state_n + n_recv, scratch_cap)
+        # merge duplicates so the state stays front-packed and small
+        sbatch = ColumnarBatch(
+            [DeviceColumn(c.dtype, d, v, None, c.dictionary, c.dict_size,
+                          c.dict_max_len)
+             for c, d, v in zip(part.columns, new_d, new_v)], state_n)
+        merged = _local_partial_agg(sbatch, n_keys, merge_ops)
+        return (tuple(c.data for c in merged.columns),
+                tuple(c.validity for c in merged.columns),
+                merged.num_rows, ovf)
+
+    state_d, state_v, state_n, ovf = jax.lax.fori_loop(
+        0, rounds, round_body,
+        (state_d, state_v, dry.num_rows * 0, jnp.bool_(False)))
+    return ColumnarBatch(
+        [DeviceColumn(c.dtype, d, v, None, c.dictionary, c.dict_size,
+                      c.dict_max_len)
+         for c, d, v in zip(part.columns, state_d, state_v)], state_n), ovf
 
 
 _SEG_OPS = {"sum", "count", "count_all", "min", "max"}
@@ -155,21 +205,13 @@ def distributed_agg_step(mesh: Mesh, batch: ColumnarBatch, n_keys: int,
             return (tuple(o for o in outs),
                     tuple(jnp.broadcast_to(v, o.shape) for v, o in
                           zip(valids, outs)),
-                    n_out[None])
+                    n_out[None], jnp.zeros(1, jnp.bool_))
         kh = K.hash_keys(part, list(range(n_keys)))
-        datas = [c.data for c in part.columns]
-        vals = [c.validity for c in part.columns]
-        ex_cols, ex_valids, ex_n = all_to_all_by_key(
-            datas, vals, part.num_rows, kh, axis, n_dev)
-        ex_batch = ColumnarBatch(
-            [DeviceColumn(c.dtype, d, v, None, c.dictionary, c.dict_size,
-                          c.dict_max_len)
-             for c, d, v in zip(part.columns, ex_cols, ex_valids)],
-            ex_n)
-        merged = _local_partial_agg(ex_batch, n_keys, merge_ops)
+        merged, ovf = windowed_exchange_merge(part, kh, n_keys, merge_ops,
+                                              axis, n_dev)
         return (tuple(c.data for c in merged.columns),
                 tuple(c.validity for c in merged.columns),
-                merged.num_rows[None])
+                merged.num_rows[None], ovf[None])
 
     spec_cols = tuple(P(axis) for _ in batch.columns)
     fn = shard_map(
@@ -177,16 +219,28 @@ def distributed_agg_step(mesh: Mesh, batch: ColumnarBatch, n_keys: int,
         in_specs=(spec_cols, spec_cols, P(axis)),
         out_specs=(tuple(P(axis) for _ in range(n_keys + n_bufs)),
                    tuple(P(axis) for _ in range(n_keys + n_bufs)),
-                   P(axis)),
+                   P(axis), P(axis)),
         check_vma=False,
     )
     datas = tuple(c.data for c in batch.columns)
     valids = tuple(c.validity for c in batch.columns)
-    out_d, out_v, out_n = jax.jit(fn)(datas, valids, batch.num_rows)
+    out_d, out_v, out_n, ovf = jax.jit(fn)(datas, valids, batch.num_rows)
+    if bool(np.any(np.asarray(ovf))):
+        raise RuntimeError(
+            "distributed agg state overflow (skew beyond 2x local groups "
+            "per owner) — raise shuffle partitions / use the host shuffle")
     dtypes = ([batch.columns[i].dtype for i in range(n_keys)]
               + [T.LONG if op in ("count", "count_all")
                  else batch.columns[ci].dtype for ci, op in ops])
-    cols = [DeviceColumn(dt, d, v) for dt, d, v in zip(dtypes, out_d, out_v)]
+    cols = []
+    for i, (dt, d, v) in enumerate(zip(dtypes, out_d, out_v)):
+        src = batch.columns[i] if i < n_keys else None
+        if src is not None and src.is_dict:
+            # key codes came back; reattach the (replicated) dictionary
+            cols.append(DeviceColumn(dt, d, v, None, src.dictionary,
+                                     src.dict_size, src.dict_max_len))
+        else:
+            cols.append(DeviceColumn(dt, d, v))
     return ColumnarBatch(cols, out_n)
 
 

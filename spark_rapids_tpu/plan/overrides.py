@@ -268,9 +268,22 @@ class Overrides:
                 for p in inner.spec.partition_by:
                     for r in check_expr(p, child_schema):
                         meta.will_not_work(r)
+                    try:
+                        if _is_wide(E.resolve(p, child_schema).dtype):
+                            meta.will_not_work(
+                                "decimal128 window partition key "
+                                "not on device")
+                    except (TypeError, KeyError):
+                        pass
                 for o in inner.spec.order_by:
                     for r in check_expr(o.child, child_schema):
                         meta.will_not_work(r)
+                    try:
+                        if _is_wide(E.resolve(o.child, child_schema).dtype):
+                            meta.will_not_work(
+                                "decimal128 window order key not on device")
+                    except (TypeError, KeyError):
+                        pass
                 # the window function's inputs and result type must be
                 # device-representable (e.g. sum(sum(decimal)) promotes
                 # past DECIMAL64 -> CPU window)

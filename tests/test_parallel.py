@@ -75,3 +75,60 @@ def test_distributed_global_agg(mesh):
     assert counts.tolist() == [1, 0, 0, 0, 0, 0, 0, 0]
     assert int(np.asarray(out.columns[0].data)[0]) == int(vals.sum())
     assert int(np.asarray(out.columns[1].data)[0]) == 256
+
+
+def test_windowed_exchange_multi_round_skew():
+    """Many DISTINCT keys all hash-owned by one device: every source sends
+    more groups to that owner than one window holds, so rows stream across
+    multiple rounds and later windows must merge into existing state
+    (BufferSendState windowing analog)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    from spark_rapids_tpu.exec import kernels as K
+    from spark_rapids_tpu.parallel import (device_mesh,
+                                           distributed_agg_step,
+                                           shard_batch)
+
+    # pick 192 distinct int keys whose engine hash lands on owner 0
+    cand = pa.table({"k": pa.array(np.arange(20000, dtype=np.int64))})
+    cb = batch_from_arrow(cand)
+    h = np.asarray(K.hash_keys(cb, [0]))[:20000]
+    owned = np.arange(20000)[(h % 8) == 0][:192]
+    assert len(owned) == 192
+
+    mesh = device_mesh(8)
+    rng = np.random.default_rng(5)
+    n = 512  # 64 rows/device; W = 16 -> owner receives 8x~24 groups over rounds
+    k = owned[rng.integers(0, len(owned), n)]
+    v = rng.integers(-100, 100, n)
+    t = pa.table({"k": pa.array(k, pa.int64()),
+                  "v": pa.array(v, pa.int64())})
+    sb = shard_batch(batch_from_arrow(t, min_bucket=n), mesh)
+    out = distributed_agg_step(mesh, sb, n_keys=1,
+                               ops=[(1, "sum"), (1, "count")])
+    counts = np.asarray(out.num_rows)
+    kk = np.asarray(out.columns[0].data)
+    ss = np.asarray(out.columns[1].data)
+    cc = np.asarray(out.columns[2].data)
+    local_cap = kk.shape[0] // 8
+    got = {}
+    for d in range(8):
+        for i in range(int(counts[d])):
+            j = d * local_cap + i
+            assert int(kk[j]) not in got
+            got[int(kk[j])] = (int(ss[j]), int(cc[j]))
+    exp = {}
+    for ki, vi in zip(k, v):
+        e = exp.setdefault(int(ki), [0, 0])
+        e[0] += int(vi)
+        e[1] += 1
+    assert got == {kk_: tuple(vv) for kk_, vv in exp.items()}
+
+
+def test_distributed_q1_string_keys():
+    """the graft dryrun body as a pytest (distributed Q1, dict keys)."""
+    import __graft_entry__ as g
+
+    g._dryrun_multichip_inline(8)
