@@ -238,6 +238,22 @@ CBO_TRANSFER_COST = conf(
     doc="Relative per-row cost of crossing the host<->device boundary "
         "(row<->columnar transition analog).", internal=True)
 
+JOIN_BROADCAST_ROWS = conf(
+    "spark.rapids.tpu.sql.join.broadcastRowThreshold", default=500_000,
+    doc="Estimated build-side row count at or below which a multi-partition "
+        "hash join uses a broadcast build instead of co-partitioning both "
+        "sides (reference: spark.sql.autoBroadcastJoinThreshold consumed by "
+        "GpuBroadcastHashJoinExecBase; size-based strategy per "
+        "GpuShuffledSizedHashJoinExec.scala:768).")
+
+JOIN_MAX_OUTPUT_ROWS = conf(
+    "spark.rapids.tpu.sql.join.maxCandidateRowsPerBatch",
+    default=1 << 27,
+    doc="Hard cap on candidate join pairs produced by ONE probe batch. A "
+        "plan whose join explodes past this raises a clear error instead "
+        "of hanging/OOMing (JoinGatherer chunking analog; the round-2 q72 "
+        "semi-cartesian hang motivates the guard).")
+
 DPP_ENABLED = conf(
     "spark.rapids.tpu.sql.dynamicPartitionPruning.enabled", default=True,
     doc="Dynamic partition pruning: collect a join's build-side key values "

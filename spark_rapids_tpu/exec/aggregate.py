@@ -53,7 +53,7 @@ class _AggSpec:
 
 
 _MERGE_OP = {"sum": "sum", "count": "sum", "count_all": "sum", "min": "min",
-             "max": "max", "first": "first", "last": "last"}
+             "max": "max", "first": "first", "last": "last", "sumsq": "sum"}
 
 
 def _lower_agg(func: E.AggregateExpression, name: str,
@@ -72,6 +72,11 @@ def _lower_agg(func: E.AggregateExpression, name: str,
         sum_t = T.DecimalType(min(38, c.precision + 10), c.scale) if isinstance(
             c, T.DecimalType) else T.DOUBLE if c in T.FRACTIONAL_TYPES else T.LONG
         return _AggSpec(func, name, input_index, ["sum", "count"], [sum_t, T.LONG])
+    if isinstance(func, E._VarianceBase):
+        # (sum, sum_sq, n) moment buffers; the final division happens in
+        # _final_project (reference: cudf VARIANCE/STD groupby aggs)
+        return _AggSpec(func, name, input_index, ["sum", "sumsq", "count"],
+                        [T.DOUBLE, T.DOUBLE, T.LONG])
     if isinstance(func, E.First):
         return _AggSpec(func, name, input_index, ["first"], [func.dtype])
     if isinstance(func, E.Last):
@@ -144,6 +149,10 @@ class HashAggregateExec(UnaryExec):
                         bound_child = func.children[0]
                     else:
                         bound_child = E.resolve(func.children[0], in_schema)
+                    if (isinstance(func, E._VarianceBase)
+                            and bound_child.dtype != T.DOUBLE):
+                        # moments are computed in f64 (Spark casts the input)
+                        bound_child = E.Cast(bound_child, T.DOUBLE)
                     func = type(func)(bound_child)
                     idx = len(pre_exprs)
                     pre_exprs.append(bound_child)
@@ -382,6 +391,20 @@ class HashAggregateExec(UnaryExec):
                 if op == "count":
                     r = flag_row(("live", ii), active & v.validity)
                     plans.append(("count", r, bt))
+                    continue
+                if op == "sumsq":
+                    live = active & v.validity
+                    key = ("sumsq", ii)
+                    if key not in row_cache:
+                        row_cache[key] = len(f64_rows)
+                        d, is_nan = K._float_canonical(v.data)
+                        f64_rows.append(jnp.where(live, d * d, 0.0))
+                        row_cache[("sqnan", ii)] = flag_row(
+                            ("nan", ii), live & is_nan)
+                    vrow = flag_row(("live", ii), live) \
+                        if nullable(ii) else 0
+                    plans.append(("fsum", row_cache[key],
+                                  row_cache[("sqnan", ii)], vrow, bt))
                     continue
                 if op == "sum":
                     live = active & v.validity
@@ -637,8 +660,12 @@ class HashAggregateExec(UnaryExec):
                     out_cols.append(self._wide_agg(
                         src, gi, contributing, op, bt, cap, out_row_valid))
                     continue
+                seg_op = op
+                if op == "sumsq":
+                    vals = vals.astype(jnp.float64) ** 2
+                    seg_op = "sum"
                 data, avalid = K.segment_agg(vals, valid, contributing, gi.segment_ids,
-                                             cap, op, ends=seg_ends,
+                                             cap, seg_op, ends=seg_ends,
                                              starts=gi.group_starts)
                 np_t = T.numpy_dtype(bt)
                 data = data.astype(np_t)
@@ -811,6 +838,22 @@ class HashAggregateExec(UnaryExec):
                     ).astype(jnp.float64)
                 valid = ssum.validity & nz
                 out_cols.append(DeviceColumn(rt, jnp.where(valid, data, 0), valid))
+            elif isinstance(s.func, E._VarianceBase):
+                ssum, ssq, cnt = bufs
+                n = jnp.maximum(cnt.data, 1).astype(jnp.float64)
+                mean = ssum.data.astype(jnp.float64) / n
+                m2 = ssq.data.astype(jnp.float64) - n * mean * mean
+                m2 = jnp.maximum(m2, 0.0)  # FP guard: variance >= 0
+                samp = isinstance(s.func, (E.VarianceSamp, E.StddevSamp))
+                den = jnp.maximum(n - 1, 1) if samp else n
+                var = m2 / den
+                data = jnp.sqrt(var) if isinstance(
+                    s.func, (E.StddevSamp, E.StddevPop)) else var
+                # modern Spark (legacy.statisticalAggregate=false): a
+                # single sample -> NULL for the _samp variants
+                valid = (cnt.data > 1) if samp else (cnt.data > 0)
+                out_cols.append(DeviceColumn(
+                    rt, jnp.where(valid, data, 0.0), valid))
             else:
                 b = bufs[0]
                 if b.is_dict:

@@ -47,9 +47,14 @@ class HashJoinExec(BinaryExec):
     def __init__(self, left_keys: Sequence[E.Expression],
                  right_keys: Sequence[E.Expression],
                  join_type: str, left: TpuExec, right: TpuExec,
-                 condition: Optional[E.Expression] = None):
+                 condition: Optional[E.Expression] = None,
+                 max_candidate_rows: Optional[int] = None):
         super().__init__(left, right)
         assert join_type in JOIN_TYPES, join_type
+        from spark_rapids_tpu.config import conf as _C
+        self.max_candidate_rows = (max_candidate_rows
+                                   if max_candidate_rows is not None
+                                   else _C.JOIN_MAX_OUTPUT_ROWS.default)
         self.join_type = join_type
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
@@ -210,6 +215,16 @@ class HashJoinExec(BinaryExec):
             probe, build, jh, lkeys, pstr, bstr)
         total = int(total_dev)
         self.metrics["numCandidatePairs"].add(total)
+        cap_rows = self.max_candidate_rows
+        if total > cap_rows:
+            # explosion guard (JoinGatherer chunking analog; round-2 q72
+            # hang): degrade loudly instead of hanging/OOMing
+            raise RuntimeError(
+                f"join candidate explosion: one probe batch produced "
+                f"{total} candidate pairs (> "
+                f"spark.rapids.tpu.sql.join.maxCandidateRowsPerBatch="
+                f"{cap_rows}); check the join keys "
+                f"({self.node_description()})")
         # left/full append unmatched probe rows after the pairs; only they
         # need the extra probe-capacity headroom
         extra = probe.capacity if self.join_type in ("left", "full") else 0

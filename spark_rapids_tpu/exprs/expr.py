@@ -991,6 +991,36 @@ class CountDistinct(AggregateExpression, _Unary):
         return False
 
 
+class _VarianceBase(AggregateExpression, _Unary):
+    """Moment aggregates (reference: GpuStddevSamp etc. via cudf
+    VARIANCE/STD groupby aggregations; here: (n, sum, sum_sq) buffers with
+    the final division done Spark-style in f64)."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+
+class VarianceSamp(_VarianceBase):
+    pass
+
+
+class VariancePop(_VarianceBase):
+    pass
+
+
+class StddevSamp(_VarianceBase):
+    pass
+
+
+class StddevPop(_VarianceBase):
+    pass
+
+
 def resolve(expr: Expression, schema: T.Schema) -> Expression:
     """Replace UnresolvedColumn with typed ColumnRef against a schema."""
     if isinstance(expr, UnresolvedColumn):

@@ -152,6 +152,24 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                                    if cnt else None)
                     else:
                         out.append(float(vals[sel].mean()))
+                elif isinstance(bound, E._VarianceBase):
+                    if not sel.any():
+                        out.append(None)
+                    else:
+                        x = vals[sel].astype(np.float64)
+                        if dec_in:
+                            x = x / (10.0 ** in_dt.scale)
+                        nn = len(x)
+                        mean = x.mean()
+                        m2 = max(float((x * x).sum() - nn * mean * mean), 0.0)
+                        samp = isinstance(bound, (E.VarianceSamp,
+                                                  E.StddevSamp))
+                        if samp and nn == 1:
+                            out.append(None)  # modern Spark: NULL
+                        else:
+                            var = m2 / ((nn - 1) if samp else nn)
+                            out.append(np.sqrt(var) if isinstance(
+                                bound, (E.StddevSamp, E.StddevPop)) else var)
                 elif isinstance(bound, E.CountDistinct):
                     out.append(int(len(set(
                         v.item() if hasattr(v, "item") else v
@@ -603,6 +621,18 @@ def _bounds_agg(gs, kind, los, his, g):
             out.append(window[0])
         elif kind == "Last":
             out.append(window[-1])
+        elif kind in ("VarianceSamp", "VariancePop", "StddevSamp",
+                      "StddevPop"):
+            x = window.astype(np.float64)
+            nn = len(x)
+            samp = kind in ("VarianceSamp", "StddevSamp")
+            if samp and nn == 1:
+                out.append(np.nan)
+            else:
+                mean = x.mean()
+                m2 = max(float((x * x).sum() - nn * mean * mean), 0.0)
+                var = m2 / ((nn - 1) if samp else nn)
+                out.append(np.sqrt(var) if kind.startswith("Stddev") else var)
         else:
             raise NotImplementedError(kind)
     return pd.Series(out, g.index)
@@ -712,6 +742,10 @@ def _full_agg(gs, kind, g):
     elif kind == "Last":
         nn = gs.dropna()
         v = nn.iloc[-1] if len(nn) else np.nan
+    elif kind in ("VarianceSamp", "VariancePop"):
+        v = gs.var(ddof=1 if kind == "VarianceSamp" else 0)
+    elif kind in ("StddevSamp", "StddevPop"):
+        v = gs.std(ddof=1 if kind == "StddevSamp" else 0)
     else:
         raise NotImplementedError(kind)
     return pd.Series(v, g.index)
@@ -736,6 +770,10 @@ def _running_agg(gs, kind, g):
         return pd.Series(np.where(seen, first_val, np.nan), gs.index)
     if kind == "Last":
         return gs.ffill()
+    if kind in ("VarianceSamp", "VariancePop"):
+        return gs.expanding().var(ddof=1 if kind == "VarianceSamp" else 0)
+    if kind in ("StddevSamp", "StddevPop"):
+        return gs.expanding().std(ddof=1 if kind == "StddevSamp" else 0)
     raise NotImplementedError(kind)
 
 

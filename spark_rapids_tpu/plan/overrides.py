@@ -62,6 +62,7 @@ _DEVICE_EXPRS = (
     E.StringReverse, E.StringTranslate, E.InitCap, E.SubstringIndex,
     E.Ascii, E.Chr,
     E.Sum, E.Count, E.Min, E.Max, E.Average, E.First, E.Last,
+    E.VarianceSamp, E.VariancePop, E.StddevSamp, E.StddevPop,
 )
 
 
@@ -313,6 +314,9 @@ class Overrides:
                 if isinstance(fn, (E.First, E.Last)):
                     meta.will_not_work(
                         "first/last window functions not on device")
+                if isinstance(fn, E._VarianceBase):
+                    meta.will_not_work(
+                        "variance/stddev window functions not on device")
         elif isinstance(node, L.Join):
             for e, s in ([(k, node.left.schema) for k in node.left_keys]
                          + [(k, node.right.schema) for k in node.right_keys]):
@@ -431,8 +435,10 @@ class Overrides:
                 from spark_rapids_tpu.plan.cpu import CpuParquetScanExec
 
                 return CpuParquetScanExec(node.paths, node.columns)
-            return ParquetScanExec(node.paths, columns=node.columns,
-                                   predicate=node.predicate)
+            return ParquetScanExec(
+                node.paths, columns=node.columns, predicate=node.predicate,
+                n_partitions=max(1, min(len(node.paths),
+                                        self.shuffle_partitions)))
         if isinstance(node, L.InMemoryScan):
             if not on_dev:
                 from spark_rapids_tpu.plan.cpu import CpuInMemoryScanExec
@@ -602,6 +608,24 @@ class Overrides:
             return CpuJoinExec(node.left_keys, node.right_keys,
                                node.join_type, left, right, node.condition)
         probe = left  # pre-exchange subtree the DPP scan walk descends
+        # size-based strategy (GpuShuffledSizedHashJoinExec analog): a
+        # small estimated build side broadcasts — neither side is
+        # exchanged, the build executes once and is shared by every probe
+        # partition (GpuBroadcastHashJoinExecBase)
+        from spark_rapids_tpu.exec.join_bcast import BroadcastHashJoinExec
+        from spark_rapids_tpu.plan import cbo as CBO
+
+        if (self._planned_parts(left) > 1
+                and node.join_type in BroadcastHashJoinExec.BROADCAST_TYPES
+                and CBO.estimate_rows(node.right)
+                <= C.JOIN_BROADCAST_ROWS.get(self.conf)):
+            from spark_rapids_tpu.exec.dpp import ReplayExec
+
+            cached = ReplayExec(right)
+            self._try_dynamic_pruning(node, probe, cached)
+            return BroadcastHashJoinExec(
+                node.left_keys, node.right_keys, node.join_type,
+                left, cached, condition=node.condition)
         if self._planned_parts(left) > 1:
             # shuffled join: co-partition both sides by key hash
             lk = [self._key_index(k, node.left.schema) for k in node.left_keys]
@@ -637,7 +661,9 @@ class Overrides:
             if self._try_dynamic_pruning(node, probe, cached):
                 right = cached
         return HashJoinExec(node.left_keys, node.right_keys, node.join_type,
-                            left, right, condition=node.condition)
+                            left, right, condition=node.condition,
+                            max_candidate_rows=C.JOIN_MAX_OUTPUT_ROWS.get(
+                                self.conf))
 
     def _try_dynamic_pruning(self, node: L.Join, probe: TpuExec,
                              build: TpuExec) -> bool:

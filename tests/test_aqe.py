@@ -130,12 +130,16 @@ def test_aqe_skew_join_matches_non_aqe(how):
                      "lv": pa.array(np.arange(3000), pa.int64())})
     right = pa.table({"k2": pa.array(np.arange(50), pa.int64()),
                       "rv": pa.array(np.arange(50) * 10, pa.int64())})
-    base = _join_dfs(left, right, RapidsConf({C.AQE_ENABLED.key: False}),
-                     how).collect()
+    # pin the shuffled-join strategy: these tests exercise the skew-split
+    # reader pair, which a broadcast build side would bypass
+    base = _join_dfs(left, right, RapidsConf({
+        C.AQE_ENABLED.key: False,
+        C.JOIN_BROADCAST_ROWS.key: 0}), how).collect()
     conf = RapidsConf({
         C.AQE_TARGET_PARTITION_BYTES.key: 4096,
         C.AQE_SKEW_THRESHOLD_BYTES.key: 4096,
         C.AQE_SKEW_FACTOR.key: 1.5,
+        C.JOIN_BROADCAST_ROWS.key: 0,
     })
     df = _join_dfs(left, right, conf, how)
     node = df.physical_plan()
@@ -171,6 +175,7 @@ def test_aqe_skew_split_pairs_line_up():
         C.AQE_TARGET_PARTITION_BYTES.key: 2048,
         C.AQE_SKEW_THRESHOLD_BYTES.key: 2048,
         C.AQE_SKEW_FACTOR.key: 1.0,
+        C.JOIN_BROADCAST_ROWS.key: 0,
     })
     df = _join_dfs(left, right, conf)
     node = df.physical_plan()

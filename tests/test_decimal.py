@@ -53,8 +53,22 @@ def both(build):
 
 
 def assert_same(build):
+    import math
+
     dev, cpu = both(build)
-    assert dev == cpu, f"dev={dev}\ncpu={cpu}"
+    assert len(dev) == len(cpu), f"dev={dev}\ncpu={cpu}"
+    for ra, rb in zip(dev, cpu):
+        assert ra.keys() == rb.keys()
+        for kk in ra:
+            va, vb = ra[kk], rb[kk]
+            if isinstance(va, float) and isinstance(vb, float):
+                # the real-TPU f64 is a double-double emulation: ULP-level
+                # float divergence is expected (reference approximate_float)
+                same = (math.isnan(va) and math.isnan(vb)) or \
+                    abs(va - vb) <= 1e-9 * max(1.0, abs(va), abs(vb))
+                assert same, f"{kk}: {va!r} vs {vb!r}"
+            else:
+                assert va == vb, f"{kk}: {va!r} vs {vb!r}\n{ra}\n{rb}"
     return dev
 
 
@@ -244,3 +258,28 @@ def test_device_placement():
     stats_div = (df.select(Divide(col("w"), col("w")).alias("d"))
                  .device_plan_stats())
     assert stats_div["cpu_nodes"], stats_div
+
+
+def test_variance_stddev_aggs():
+    """stddev/variance family, device vs CPU, grouped + global,
+    int/double/decimal inputs."""
+    import math
+
+    def build(df):
+        return df.group_by("k").agg(
+            E.StddevSamp(col("f")).alias("ss"),
+            E.StddevPop(col("f")).alias("sp"),
+            E.VarianceSamp(col("q")).alias("vs"),
+            E.VariancePop(col("m")).alias("vp"),
+        ).sort("k")
+    dev, cpu = both(build)
+    assert len(dev) == len(cpu)
+    for a, b in zip(dev, cpu):
+        for kcol in ("ss", "sp", "vs", "vp"):
+            va, vb = a[kcol], b[kcol]
+            if va is None or vb is None:
+                assert va == vb, (kcol, a, b)
+            elif math.isnan(va) or math.isnan(vb):
+                assert math.isnan(va) and math.isnan(vb), (kcol, a, b)
+            else:
+                assert abs(va - vb) <= 1e-9 * max(1.0, abs(va)), (kcol, a, b)
