@@ -1,0 +1,32 @@
+"""Spark plugin bridge (L0/L1 spike).
+
+The reference IS a Spark plugin: `spark.plugins=com.nvidia.spark.SQLPlugin`
+injects ColumnarOverrideRules whose preColumnarTransitions rewrites Spark's
+physical plan to Gpu* operators (reference SQLPlugin.scala:1,
+Plugin.scala:53-60, GpuOverrides.scala:4746).
+
+This package is the TPU-side half of that architecture:
+
+- ``catalyst.py``  — the wire model of Spark physical-plan nodes the JVM
+  side serializes (a JSON tree of exec nodes + expressions, the shape
+  ``df._jdf.queryExecution().executedPlan()`` exposes).
+- ``rules.py``     — the ColumnarOverrideRules analog: translate the
+  Catalyst tree into this engine's logical plan, let ``plan.overrides``
+  tag/convert with per-node CPU fallback, execute, and return Arrow.
+- The JVM half (not buildable in this image: no Spark/JVM toolchain) is a
+  thin Scala `ColumnarRule` that (1) serializes the plan subtree it wants
+  offloaded, (2) ships Arrow batches over the local socket, (3) replaces
+  the subtree with an exec that reads the returned Arrow stream — the
+  plugin-process split the reference runs in-JVM via JNI, here process-
+  separated like Spark's own Python workers (reference: python/rapids/
+  worker.py preload model).
+
+With pyspark present, ``enable(spark)`` would register the rule via
+``spark.sql.extensions``; in this image `import pyspark` fails and the
+bridge is exercised by tests/test_spark_bridge.py against recorded plan
+trees (BASELINE.md progression 1: `local[*]`, plugin enabled, Q6).
+"""
+
+from spark_rapids_tpu.spark.rules import (  # noqa: F401
+    ColumnarOverrideRules, run_catalyst_plan,
+)

@@ -1,0 +1,82 @@
+"""Wire model of Spark physical plans (the JVM bridge payload).
+
+A Scala `ColumnarRule` serializes the candidate subtree as a JSON tree in
+this shape (node: {"class": simple exec class name, fields..., "children":
+[...]}; expression: {"class": expr class name, fields...}) — the same
+information `GpuOverrides.wrapAndTagPlan` reads from live Catalyst nodes
+(reference GpuOverrides.scala:4541). Only the exec/expression classes the
+engine can translate appear here; anything else stays on Spark untouched
+(whole-subtree fallback, the coarsest form of the reference's per-node
+fallback)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs import expr as E
+
+_TYPES = {
+    "boolean": T.BOOLEAN, "byte": T.BYTE, "short": T.SHORT, "integer": T.INT,
+    "long": T.LONG, "float": T.FLOAT, "double": T.DOUBLE, "string": T.STRING,
+    "date": T.DATE, "timestamp": T.TIMESTAMP,
+}
+
+
+def parse_type(s: str) -> T.DataType:
+    if s.startswith("decimal("):
+        p, sc = s[8:-1].split(",")
+        return T.DecimalType(int(p), int(sc))
+    return _TYPES[s]
+
+
+def parse_expr(node: Dict[str, Any]) -> E.Expression:
+    """Catalyst expression JSON -> engine expression."""
+    cls = node["class"]
+    kids = [parse_expr(c) for c in node.get("children", [])]
+    if cls == "AttributeReference":
+        return E.col(node["name"])
+    if cls == "Literal":
+        dt = parse_type(node["dataType"])
+        v = node["value"]
+        if v is not None:
+            if dt == T.DATE and isinstance(v, str):
+                import datetime
+                v = datetime.date.fromisoformat(v)
+            elif dt == T.TIMESTAMP and isinstance(v, str):
+                import datetime
+                v = datetime.datetime.fromisoformat(v)
+            elif isinstance(dt, T.DecimalType) and isinstance(v, str):
+                import decimal
+                v = decimal.Decimal(v)
+        return E.lit(v, dt)
+    if cls == "Alias":
+        return E.Alias(kids[0], node["name"])
+    if cls == "Cast":
+        return E.Cast(kids[0], parse_type(node["dataType"]))
+    binary = {
+        "Add": E.Add, "Subtract": E.Subtract, "Multiply": E.Multiply,
+        "Divide": E.Divide, "Remainder": E.Remainder, "Pmod": E.Pmod,
+        "EqualTo": E.EqualTo, "LessThan": E.LessThan,
+        "LessThanOrEqual": E.LessThanOrEqual, "GreaterThan": E.GreaterThan,
+        "GreaterThanOrEqual": E.GreaterThanOrEqual, "And": E.And, "Or": E.Or,
+    }
+    if cls in binary:
+        return binary[cls](kids[0], kids[1])
+    unary = {"Not": E.Not, "IsNull": E.IsNull, "IsNotNull": E.IsNotNull,
+             "UnaryMinus": E.UnaryMinus, "Abs": E.Abs,
+             "Year": E.Year, "Month": E.Month, "DayOfMonth": E.DayOfMonth}
+    if cls in unary:
+        return unary[cls](kids[0])
+    aggs = {"Sum": E.Sum, "Min": E.Min, "Max": E.Max, "Average": E.Average,
+            "First": E.First, "Last": E.Last,
+            "StddevSamp": E.StddevSamp, "VarianceSamp": E.VarianceSamp}
+    if cls in aggs:
+        return aggs[cls](kids[0])
+    if cls == "Count":
+        return E.Count(kids[0] if kids else None)
+    raise UnsupportedPlanError(f"expression {cls}")
+
+
+class UnsupportedPlanError(Exception):
+    """Subtree stays on Spark (whole-plan fallback for this candidate)."""

@@ -1,0 +1,115 @@
+"""ColumnarOverrideRules analog: Catalyst plan JSON -> engine plan -> Arrow.
+
+The reference's rule pipeline (Plugin.scala:53-60 registers GpuOverrides as
+preColumnarTransitions; GpuOverrides.applyWithContext tags + converts,
+GpuOverrides.scala:4746). Here the tagging/conversion is the engine's own
+``plan.overrides`` pass, so per-node CPU fallback, decimal128 gating, AQE
+and DPP all apply to plans arriving over the Spark bridge exactly as they
+do to native DataFrame plans."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.plan import dataframe as DF
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.spark.catalyst import (UnsupportedPlanError, parse_expr,
+                                             parse_type)
+
+
+class ColumnarOverrideRules:
+    """Translate + execute Spark physical subtrees on the TPU engine.
+
+    ``tables`` maps relation identifiers (file paths or registered temp
+    views sent by the JVM side) to Arrow tables / parquet paths."""
+
+    def __init__(self, conf: Optional[C.RapidsConf] = None,
+                 tables: Optional[Dict[str, pa.Table]] = None):
+        self.conf = conf or C.RapidsConf({})
+        self.tables = tables or {}
+        self.last_fallback_reason: Optional[str] = None
+
+    # -- plan translation --------------------------------------------------
+    def to_logical(self, node: Dict[str, Any]) -> L.LogicalPlan:
+        cls = node["class"]
+        kids = [self.to_logical(c) for c in node.get("children", [])]
+        if cls in ("FileSourceScanExec", "BatchScanExec"):
+            if node.get("table") in self.tables:
+                return L.InMemoryScan(self.tables[node["table"]])
+            paths = node.get("paths", [])
+            if not paths:
+                raise UnsupportedPlanError(
+                    f"scan relation not registered and no paths: "
+                    f"{node.get('table')!r}")
+            return L.ParquetScan(paths, node.get("columns"))
+        if cls == "ProjectExec":
+            return L.Project([parse_expr(e) for e in node["projectList"]],
+                             kids[0])
+        if cls == "FilterExec":
+            return L.Filter(parse_expr(node["condition"]), kids[0])
+        if cls == "HashAggregateExec":
+            # Spark sends partial+final pairs; the bridge receives the
+            # logical grouping (final side) and replans the two-phase
+            # split itself, like GpuOverrides does for AQE query stages
+            return L.Aggregate(
+                [parse_expr(e) for e in node["groupingExpressions"]],
+                [parse_expr(e) for e in node["aggregateExpressions"]],
+                kids[0])
+        if cls in ("SortMergeJoinExec", "ShuffledHashJoinExec",
+                   "BroadcastHashJoinExec"):
+            jt = {"Inner": "inner", "LeftOuter": "left",
+                  "RightOuter": "right", "FullOuter": "full",
+                  "LeftSemi": "left_semi", "LeftAnti": "left_anti"}[
+                node.get("joinType", "Inner")]
+            return L.Join(kids[0], kids[1],
+                          [parse_expr(e) for e in node["leftKeys"]],
+                          [parse_expr(e) for e in node["rightKeys"]],
+                          jt, None)
+        if cls == "SortExec":
+            from spark_rapids_tpu.exec.sort import SortOrder
+
+            orders = [SortOrder(parse_expr(o["child"]),
+                                ascending=o.get("ascending", True))
+                      for o in node["sortOrder"]]
+            return L.Sort(orders, kids[0], limit=node.get("limit"))
+        if cls in ("GlobalLimitExec", "LocalLimitExec", "CollectLimitExec"):
+            return L.Limit(int(node["limit"]), kids[0])
+        if cls == "UnionExec":
+            return L.Union(kids)
+        raise UnsupportedPlanError(f"exec {cls}")
+
+    # -- entry points ------------------------------------------------------
+    def pre_columnar_transitions(self, plan_json: str):
+        """The rule hook: returns an executable DataFrame for the subtree,
+        or None -> the JVM side keeps the original Spark plan (fallback)."""
+        self.last_fallback_reason = None
+        try:
+            logical = self.to_logical(json.loads(plan_json))
+        except UnsupportedPlanError as ex:
+            # whole-subtree fallback, reported like willNotWorkOnGpu
+            self.last_fallback_reason = str(ex)
+            return None
+        except Exception as ex:  # malformed wire payload: fall back loudly
+            self.last_fallback_reason = (
+                f"malformed plan payload ({type(ex).__name__}: {ex})")
+            return None
+        df = DF.DataFrame(logical, self.conf)
+        return df
+
+    def execute(self, plan_json: str) -> Optional[pa.Table]:
+        df = self.pre_columnar_transitions(plan_json)
+        return None if df is None else df.to_arrow()
+
+
+def run_catalyst_plan(plan_json: str,
+                      tables: Optional[Dict[str, pa.Table]] = None,
+                      conf: Optional[C.RapidsConf] = None
+                      ) -> Optional[pa.Table]:
+    """One-shot: JSON physical plan -> Arrow result (None = fallback)."""
+    return ColumnarOverrideRules(conf, tables).execute(plan_json)

@@ -1,0 +1,131 @@
+"""Spark plugin bridge spike: recorded Catalyst physical-plan JSON (the
+shape the JVM ColumnarRule serializes) runs through the engine with the
+same tag/convert/fallback pipeline as native plans.
+
+BASELINE.md progression 1 is `local[*]` + plugin + TPC-H Q6; pyspark is not
+in this image, so the JVM half is exercised via recorded plans
+(spark_rapids_tpu/spark/__init__.py documents the process split)."""
+
+import json
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.spark import ColumnarOverrideRules, run_catalyst_plan
+
+
+def lineitem(n=10_000, seed=7):
+    rng = np.random.default_rng(seed)
+    ship = rng.integers(8500, 9500, n)
+    return pa.table({
+        "l_quantity": pa.array(rng.integers(1, 51, n).astype(np.float64)),
+        "l_extendedprice": pa.array(np.round(rng.uniform(900, 105000, n), 2)),
+        "l_discount": pa.array(np.round(rng.integers(0, 11, n) * 0.01, 2)),
+        "l_shipdate": pa.array(ship.astype("datetime64[D]")),
+    })
+
+
+def attr(name):
+    return {"class": "AttributeReference", "name": name}
+
+
+def lit(value, dtype):
+    return {"class": "Literal", "value": value, "dataType": dtype}
+
+
+Q6_PLAN = {
+    "class": "HashAggregateExec",
+    "groupingExpressions": [],
+    "aggregateExpressions": [{
+        "class": "Alias", "name": "revenue",
+        "children": [{
+            "class": "Sum",
+            "children": [{
+                "class": "Multiply",
+                "children": [attr("l_extendedprice"), attr("l_discount")],
+            }],
+        }],
+    }],
+    "children": [{
+        "class": "FilterExec",
+        "condition": {
+            "class": "And",
+            "children": [
+                {"class": "And", "children": [
+                    {"class": "GreaterThanOrEqual", "children": [
+                        attr("l_discount"), lit(0.05, "double")]},
+                    {"class": "LessThanOrEqual", "children": [
+                        attr("l_discount"), lit(0.07, "double")]},
+                ]},
+                {"class": "LessThan", "children": [
+                    attr("l_quantity"), lit(24.0, "double")]},
+            ],
+        },
+        "children": [{
+            "class": "FileSourceScanExec", "table": "lineitem",
+            "children": [],
+        }],
+    }],
+}
+
+
+def test_q6_over_bridge_matches_oracle():
+    li = lineitem()
+    out = run_catalyst_plan(json.dumps(Q6_PLAN), tables={"lineitem": li},
+                            conf=RapidsConf({}))
+    assert out is not None
+    got = out.to_pylist()[0]["revenue"]
+    d = li["l_discount"].to_numpy()
+    q = li["l_quantity"].to_numpy()
+    p = li["l_extendedprice"].to_numpy()
+    m = (d >= 0.05) & (d <= 0.07) & (q < 24)
+    assert abs(got - float((p[m] * d[m]).sum())) <= 1e-6 * abs(got)
+
+
+def test_bridge_runs_on_device():
+    li = lineitem(2000)
+    rules = ColumnarOverrideRules(RapidsConf({}), {"lineitem": li})
+    df = rules.pre_columnar_transitions(json.dumps(Q6_PLAN))
+    stats = df.device_plan_stats()
+    assert stats["device_fraction"] == 1.0, stats
+
+
+def test_unsupported_exec_falls_back_whole_subtree():
+    plan = {"class": "FlatMapGroupsInPandasExec", "children": []}
+    rules = ColumnarOverrideRules(RapidsConf({}), {})
+    assert rules.pre_columnar_transitions(json.dumps(plan)) is None
+    assert "FlatMapGroupsInPandasExec" in rules.last_fallback_reason
+
+
+def test_join_and_sort_over_bridge():
+    fact = pa.table({"fk": pa.array(np.arange(300) % 10, pa.int64()),
+                     "v": pa.array(np.arange(300), pa.int64())})
+    dim = pa.table({"dk": pa.array(np.arange(10), pa.int64()),
+                    "nm": pa.array([f"d{i}" for i in range(10)])})
+    plan = {
+        "class": "SortExec",
+        "sortOrder": [{"child": attr("nm"), "ascending": True}],
+        "children": [{
+            "class": "HashAggregateExec",
+            "groupingExpressions": [attr("nm")],
+            "aggregateExpressions": [
+                {"class": "Alias", "name": "s",
+                 "children": [{"class": "Sum", "children": [attr("v")]}]}],
+            "children": [{
+                "class": "BroadcastHashJoinExec", "joinType": "Inner",
+                "leftKeys": [attr("fk")], "rightKeys": [attr("dk")],
+                "children": [
+                    {"class": "FileSourceScanExec", "table": "fact",
+                     "children": []},
+                    {"class": "FileSourceScanExec", "table": "dim",
+                     "children": []},
+                ],
+            }],
+        }],
+    }
+    out = run_catalyst_plan(json.dumps(plan),
+                            tables={"fact": fact, "dim": dim})
+    rows = out.to_pylist()
+    assert len(rows) == 10
+    assert rows[0]["nm"] == "d0" and rows[0]["s"] == sum(range(0, 300, 10))
