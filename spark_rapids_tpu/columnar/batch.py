@@ -146,6 +146,9 @@ def _arrow_fixed_to_numpy(arr: pa.Array, dt: T.DataType):
         )
         values = limbs[0::2].copy()
     elif dt == T.TIMESTAMP:
+        # normalize any timestamp unit (s/ms/us/ns) to microseconds first
+        if arr.type.unit != "us":
+            arr = arr.cast(pa.timestamp("us", tz=arr.type.tz))
         values = np.asarray(arr.fill_null(0).cast(pa.int64()))
     elif dt == T.DATE:
         values = np.asarray(arr.fill_null(0).cast(pa.int32()))

@@ -704,6 +704,170 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
             jnp.power(l.data.astype(jnp.float64), r.data.astype(jnp.float64)),
             l.validity & r.validity,
         )
+    if isinstance(expr, (E.Log10, E.Log2)):
+        c = eval_expr(expr.child, ctx)
+        d = c.data.astype(jnp.float64)
+        ok = d > 0
+        f = jnp.log10 if isinstance(expr, E.Log10) else jnp.log2
+        return ColVal(f(jnp.where(ok, d, 1.0)), c.validity & ok)
+    if isinstance(expr, E.Log1p):
+        c = eval_expr(expr.child, ctx)
+        d = c.data.astype(jnp.float64)
+        ok = d > -1.0
+        return ColVal(jnp.log1p(jnp.where(ok, d, 0.0)), c.validity & ok)
+    if isinstance(expr, E.Expm1):
+        c = eval_expr(expr.child, ctx)
+        return ColVal(jnp.expm1(c.data.astype(jnp.float64)), c.validity)
+    if isinstance(expr, E.Cbrt):
+        c = eval_expr(expr.child, ctx)
+        return ColVal(jnp.cbrt(c.data.astype(jnp.float64)), c.validity)
+    if type(expr) in _TRIG:
+        c = eval_expr(expr.child, ctx)
+        return ColVal(_TRIG[type(expr)](c.data.astype(jnp.float64)),
+                      c.validity)
+    if isinstance(expr, E.Signum):
+        c = eval_expr(expr.child, ctx)
+        return ColVal(jnp.sign(c.data.astype(jnp.float64)), c.validity)
+    if isinstance(expr, E.Atan2):
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+        return ColVal(jnp.arctan2(l.data.astype(jnp.float64),
+                                  r.data.astype(jnp.float64)),
+                      l.validity & r.validity)
+    if isinstance(expr, E.Hypot):
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+        return ColVal(jnp.hypot(l.data.astype(jnp.float64),
+                                r.data.astype(jnp.float64)),
+                      l.validity & r.validity)
+    if isinstance(expr, (E.Greatest, E.Least)):
+        vals = [eval_expr(c, ctx) for c in expr.children]
+        np_t = T.numpy_dtype(expr.dtype)
+        is_max = not isinstance(expr, E.Least)
+
+        def ckey(d):
+            # Spark total order: NaN sorts ABOVE every value
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                return jnp.where(jnp.isnan(d), jnp.inf, d)
+            return d
+
+        acc, av = None, None
+        for v in vals:
+            d = v.data.astype(np_t)
+            if acc is None:
+                acc, av = d, v.validity
+                continue
+            both = av & v.validity
+            newer = ckey(d) > ckey(acc) if is_max else ckey(d) < ckey(acc)
+            acc = jnp.where(both, jnp.where(newer, d, acc),
+                            jnp.where(v.validity, d, acc))
+            av = av | v.validity
+        return ColVal(acc, av)
+    if isinstance(expr, E.NullIf):
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+        if isinstance(l, StringVal):
+            eq = _string_eq(l, r, cap)
+        else:
+            ct = _numeric_common(expr.left.dtype, expr.right.dtype)
+            np_ct = T.numpy_dtype(ct) if ct is not None else l.data.dtype
+            eq = _nan_safe_eq(l.data.astype(np_ct), r.data.astype(np_ct))
+        keep = ~(eq & l.validity & r.validity)
+        if isinstance(l, StringVal):
+            return StringVal(l.data, l.offsets, l.validity & keep)
+        return ColVal(l.data, l.validity & keep)
+    if isinstance(expr, E.Nvl2):
+        ref = eval_expr(expr.children[0], ctx)
+        a = eval_expr(expr.children[1], ctx)
+        b = eval_expr(expr.children[2], ctx)
+        take = ref.validity
+        if isinstance(a, StringVal):
+            return _string_select(take, a, b)
+        return ColVal(jnp.where(take, a.data, b.data),
+                      jnp.where(take, a.validity, b.validity))
+    if isinstance(expr, (E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor)):
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+        np_t = T.numpy_dtype(expr.dtype)
+        a, b = l.data.astype(np_t), r.data.astype(np_t)
+        out = (a & b if isinstance(expr, E.BitwiseAnd)
+               else a | b if isinstance(expr, E.BitwiseOr) else a ^ b)
+        return ColVal(out, l.validity & r.validity)
+    if isinstance(expr, E.BitwiseNot):
+        c = eval_expr(expr.child, ctx)
+        return ColVal(~c.data, c.validity)
+    if isinstance(expr, E.ShiftLeft):  # covers Right/RightUnsigned
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+        bits = 64 if expr.left.dtype == T.LONG else 32
+        sh = (r.data.astype(jnp.int32) & (bits - 1))
+        valid = l.validity & r.validity
+        if isinstance(expr, E.ShiftRightUnsigned):
+            u = l.data.astype(jnp.uint64 if bits == 64 else jnp.uint32)
+            out = (u >> sh.astype(u.dtype)).astype(l.data.dtype)
+        elif isinstance(expr, E.ShiftRight) and not isinstance(
+                expr, E.ShiftRightUnsigned):
+            out = l.data >> sh.astype(l.data.dtype)
+        else:
+            out = l.data << sh.astype(l.data.dtype)
+        return ColVal(out, valid)
+    if isinstance(expr, (E.Hour, E.Minute, E.Second)):
+        c = eval_expr(expr.child, ctx)
+        us = c.data.astype(jnp.int64)
+        # timestamps are negative before the epoch: floor-mod keeps
+        # time-of-day in [0, 24h)
+        day_us = jnp.int64(86_400_000_000)
+        tod = ((us % day_us) + day_us) % day_us
+        if type(expr) is E.Hour:
+            out = tod // 3_600_000_000
+        elif type(expr) is E.Minute:
+            out = (tod // 60_000_000) % 60
+        else:
+            out = (tod // 1_000_000) % 60
+        return ColVal(out.astype(jnp.int32), c.validity)
+    if isinstance(expr, E.WeekOfYear):
+        c = eval_expr(expr.child, ctx)
+        days = (c.data // 86_400_000_000
+                if expr.child.dtype == T.TIMESTAMP else c.data
+                ).astype(jnp.int32)
+        doy = _day_of_year(days)
+        # ISO weekday: Mon=1..Sun=7; 1970-01-01 was a Thursday (=4)
+        wd = ((days.astype(jnp.int32) + 3) % 7 + 7) % 7 + 1
+        w = (doy - wd + 10) // 7
+        y, _, _ = _civil_from_days(days)
+
+        def _weeks_in(yy):
+            jan1 = _days_from_civil(yy, jnp.ones_like(yy), jnp.ones_like(yy))
+            jan1_wd = ((jan1 + 3) % 7 + 7) % 7 + 1
+            leap = ((yy % 4 == 0) & (yy % 100 != 0)) | (yy % 400 == 0)
+            return jnp.where((jan1_wd == 4) | (leap & (jan1_wd == 3)),
+                             53, 52)
+        w = jnp.where(w < 1, _weeks_in(y - 1),
+                      jnp.where(w > _weeks_in(y), 1, w))
+        return ColVal(w.astype(jnp.int32), c.validity)
+    if isinstance(expr, E.LastDay):
+        c = eval_expr(expr.child, ctx)
+        days = c.data.astype(jnp.int32)
+        y, m, _ = _civil_from_days(days)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        out = _days_from_civil(ny, nm, jnp.ones_like(ny)) - 1
+        return ColVal(out.astype(jnp.int32), c.validity)
+    if isinstance(expr, E.AddMonths):
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+        days = l.data.astype(jnp.int32)
+        y, m, d = _civil_from_days(days)
+        tot = (y * 12 + (m - 1)) + r.data.astype(jnp.int32)
+        ny = tot // 12
+        nm = tot % 12 + 1
+        # clamp the day to the target month's length (Spark add_months)
+        ny2 = jnp.where(nm == 12, ny + 1, ny)
+        nm2 = jnp.where(nm == 12, 1, nm + 1)
+        mlen = (_days_from_civil(ny2, nm2, jnp.ones_like(ny))
+                - _days_from_civil(ny, nm, jnp.ones_like(ny)))
+        out = _days_from_civil(ny, nm, jnp.minimum(d, mlen))
+        return ColVal(out.astype(jnp.int32), l.validity & r.validity)
     if isinstance(expr, E.Floor):
         c = eval_expr(expr.child, ctx)
         if isinstance(expr.child.dtype, T.DecimalType):
@@ -795,6 +959,12 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         return out
 
     raise NotImplementedError(f"eval of {type(expr).__name__}")
+
+
+_TRIG = {E.Sin: jnp.sin, E.Cos: jnp.cos, E.Tan: jnp.tan,
+         E.Asin: jnp.arcsin, E.Acos: jnp.arccos, E.Atan: jnp.arctan,
+         E.Sinh: jnp.sinh, E.Cosh: jnp.cosh, E.Tanh: jnp.tanh,
+         E.ToDegrees: jnp.degrees, E.ToRadians: jnp.radians}
 
 
 def _eval_string_fns(expr: E.Expression, ctx: EvalContext):

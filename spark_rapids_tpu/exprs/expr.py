@@ -579,6 +579,203 @@ class Log(_UnaryMath):
         return True
 
 
+class Log10(_UnaryMath):
+    @property
+    def nullable(self):
+        return True
+
+
+class Log2(_UnaryMath):
+    @property
+    def nullable(self):
+        return True
+
+
+class Log1p(_UnaryMath):
+    @property
+    def nullable(self):
+        return True
+
+
+class Expm1(_UnaryMath):
+    pass
+
+
+class Cbrt(_UnaryMath):
+    pass
+
+
+class Sin(_UnaryMath):
+    pass
+
+
+class Cos(_UnaryMath):
+    pass
+
+
+class Tan(_UnaryMath):
+    pass
+
+
+class Asin(_UnaryMath):
+    pass
+
+
+class Acos(_UnaryMath):
+    pass
+
+
+class Atan(_UnaryMath):
+    pass
+
+
+class Sinh(_UnaryMath):
+    pass
+
+
+class Cosh(_UnaryMath):
+    pass
+
+
+class Tanh(_UnaryMath):
+    pass
+
+
+class ToDegrees(_UnaryMath):
+    pass
+
+
+class ToRadians(_UnaryMath):
+    pass
+
+
+class Signum(_Unary):
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+class Atan2(_Binary):
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+class Hypot(_Binary):
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+class Greatest(Expression):
+    """greatest(...): NULLs ignored; NULL only if all inputs NULL."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self):
+        import functools
+        return functools.reduce(_numeric_widen,
+                                [c.dtype for c in self.children])
+
+
+class Least(Greatest):
+    pass
+
+
+class NullIf(_Binary):
+    """nullif(a, b): NULL when a == b else a."""
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Nvl2(Expression):
+    """nvl2(x, a, b): a when x is not null else b."""
+
+    def __init__(self, ref: Expression, a: Expression, b: Expression):
+        self.children = (ref, a, b)
+
+    @property
+    def dtype(self):
+        a, b = self.children[1].dtype, self.children[2].dtype
+        if a == b or a in (T.STRING, T.BINARY):
+            return a
+        return _numeric_widen(a, b)
+
+
+class BitwiseAnd(_Binary):
+    @property
+    def dtype(self):
+        return _numeric_widen(self.left.dtype, self.right.dtype)
+
+
+class BitwiseOr(BitwiseAnd):
+    pass
+
+
+class BitwiseXor(BitwiseAnd):
+    pass
+
+
+class BitwiseNot(_Unary):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class ShiftLeft(_Binary):
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+
+class ShiftRight(ShiftLeft):
+    pass
+
+
+class ShiftRightUnsigned(ShiftLeft):
+    pass
+
+
+class Hour(_Unary):
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class Minute(Hour):
+    pass
+
+
+class Second(Hour):
+    pass
+
+
+class WeekOfYear(_Unary):
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class LastDay(_Unary):
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+class AddMonths(_Binary):
+    @property
+    def dtype(self):
+        return T.DATE
+
+
 class Pow(_Binary):
     @property
     def dtype(self):

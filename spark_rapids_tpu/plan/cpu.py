@@ -39,6 +39,8 @@ def _col_np(table: pa.Table, i: int) -> Tuple[np.ndarray, np.ndarray]:
     if dt == T.DATE:
         vals = np.asarray(arr.fill_null(0).cast(pa.int32()))
     elif dt == T.TIMESTAMP:
+        if arr.type.unit != "us":  # normalize s/ms/ns units to micros
+            arr = arr.cast(pa.timestamp("us", tz=arr.type.tz))
         vals = np.asarray(arr.fill_null(0).cast(pa.int64()))
     elif dt in (T.STRING, T.BINARY):
         vals = np.array(arr.fill_null("").to_pylist(), dtype=object)
@@ -379,6 +381,135 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         if dt in T.FRACTIONAL_TYPES:
             rounded = rounded.astype(T.numpy_dtype(dt))
         return rounded, m
+    if isinstance(expr, (E.Log10, E.Log2)):
+        d, m = ev(expr.child)
+        d = d.astype(np.float64)
+        ok = d > 0
+        f = np.log10 if isinstance(expr, E.Log10) else np.log2
+        return f(np.where(ok, d, 1.0)), m & ok
+    if isinstance(expr, E.Log1p):
+        d, m = ev(expr.child)
+        d = d.astype(np.float64)
+        ok = d > -1.0
+        return np.log1p(np.where(ok, d, 0.0)), m & ok
+    if isinstance(expr, E.Expm1):
+        d, m = ev(expr.child)
+        return np.expm1(d.astype(np.float64)), m
+    if isinstance(expr, E.Cbrt):
+        d, m = ev(expr.child)
+        return np.cbrt(d.astype(np.float64)), m
+    if type(expr) in _TRIG_NP:
+        d, m = ev(expr.child)
+        with np.errstate(invalid="ignore"):
+            return _TRIG_NP[type(expr)](d.astype(np.float64)), m
+    if isinstance(expr, E.Signum):
+        d, m = ev(expr.child)
+        return np.sign(d.astype(np.float64)), m
+    if isinstance(expr, E.Atan2):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        return np.arctan2(a.astype(np.float64),
+                          b.astype(np.float64)), ma & mb
+    if isinstance(expr, E.Hypot):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        return np.hypot(a.astype(np.float64),
+                        b.astype(np.float64)), ma & mb
+    if isinstance(expr, (E.Greatest, E.Least)):
+        np_t = T.numpy_dtype(expr.dtype)
+        is_max = not isinstance(expr, E.Least)
+
+        def ckey(d):
+            if d.dtype.kind == "f":
+                return np.where(np.isnan(d), np.inf, d)  # NaN sorts above
+            return d
+
+        acc = am = None
+        for c in expr.children:
+            d, mv = ev(c)
+            d = d.astype(np_t)
+            if acc is None:
+                acc, am = d, mv
+                continue
+            both = am & mv
+            newer = ckey(d) > ckey(acc) if is_max else ckey(d) < ckey(acc)
+            acc = np.where(both, np.where(newer, d, acc),
+                           np.where(mv, d, acc))
+            am = am | mv
+        return acc, am
+    if isinstance(expr, E.NullIf):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        if expr.left.dtype in (T.STRING, T.BINARY):
+            eq = _obj_eq(a, b)
+        else:
+            from spark_rapids_tpu.exprs.eval import _numeric_common
+            ct = _numeric_common(expr.left.dtype, expr.right.dtype)
+            np_ct = T.numpy_dtype(ct) if ct is not None else a.dtype
+            ac, bc = a.astype(np_ct), b.astype(np_ct)
+            eq = (ac == bc) | (_isnan(ac) & _isnan(bc))
+        return a, ma & ~(eq & ma & mb)
+    if isinstance(expr, E.Nvl2):
+        _, mr = ev(expr.children[0])
+        a, ma = ev(expr.children[1])
+        b, mb = ev(expr.children[2])
+        return np.where(mr, a, b), np.where(mr, ma, mb)
+    if isinstance(expr, (E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor)):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        np_t = T.numpy_dtype(expr.dtype)
+        a, b = a.astype(np_t), b.astype(np_t)
+        out = (a & b if isinstance(expr, E.BitwiseAnd)
+               else a | b if isinstance(expr, E.BitwiseOr) else a ^ b)
+        return out, ma & mb
+    if isinstance(expr, E.BitwiseNot):
+        d, m = ev(expr.child)
+        return ~d, m
+    if isinstance(expr, E.ShiftLeft):  # covers Right/RightUnsigned
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        bits = 64 if expr.left.dtype == T.LONG else 32
+        sh = b.astype(np.int64) & (bits - 1)
+        if isinstance(expr, E.ShiftRightUnsigned):
+            u = a.astype(np.uint64 if bits == 64 else np.uint32)
+            out = (u >> sh.astype(u.dtype)).astype(a.dtype)
+        elif isinstance(expr, E.ShiftRight) and not isinstance(
+                expr, E.ShiftRightUnsigned):
+            out = a >> sh.astype(a.dtype)
+        else:
+            out = a << sh.astype(a.dtype)
+        return out, ma & mb
+    if isinstance(expr, (E.Hour, E.Minute, E.Second)):
+        d, m = ev(expr.child)
+        day_us = 86_400_000_000
+        tod = ((d.astype(np.int64) % day_us) + day_us) % day_us
+        if type(expr) is E.Hour:
+            out = tod // 3_600_000_000
+        elif type(expr) is E.Minute:
+            out = (tod // 60_000_000) % 60
+        else:
+            out = (tod // 1_000_000) % 60
+        return out.astype(np.int32), m
+    if isinstance(expr, E.WeekOfYear):
+        d, m = ev(expr.child)
+        days = (d // 86_400_000_000 if expr.child.dtype == T.TIMESTAMP
+                else d).astype("datetime64[D]")
+        iso = np.array([int(x.astype("datetime64[D]").item()
+                            .isocalendar()[1]) for x in days], np.int32)
+        return iso, m
+    if isinstance(expr, E.LastDay):
+        d, m = ev(expr.child)
+        M = d.astype("datetime64[D]").astype("datetime64[M]")
+        out = ((M + 1).astype("datetime64[D]") - 1).astype(np.int32)
+        return out, m
+    if isinstance(expr, E.AddMonths):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        out = []
+        for di, ni in zip(a.astype(np.int64), b.astype(np.int64)):
+            dt0 = np.datetime64(int(di), "D").item()
+            tot = dt0.year * 12 + (dt0.month - 1) + int(ni)
+            y, mth = tot // 12, tot % 12 + 1
+            import calendar
+            dd = min(dt0.day, calendar.monthrange(y, mth)[1])
+            import datetime
+            out.append((datetime.date(y, mth, dd)
+                        - datetime.date(1970, 1, 1)).days)
+        return np.array(out, np.int32), ma & mb
     if isinstance(expr, E.CaseWhen):
         if expr.else_value is not None:
             data, mask = ev(expr.else_value)
@@ -533,6 +664,12 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         out = [chr(int(v) % 256) if v >= 0 else "" for v in d]
         return np.array(out, dtype=object), m
     raise NotImplementedError(f"cpu eval {type(expr).__name__}")
+
+
+_TRIG_NP = {E.Sin: np.sin, E.Cos: np.cos, E.Tan: np.tan,
+            E.Asin: np.arcsin, E.Acos: np.arccos, E.Atan: np.arctan,
+            E.Sinh: np.sinh, E.Cosh: np.cosh, E.Tanh: np.tanh,
+            E.ToDegrees: np.degrees, E.ToRadians: np.radians}
 
 
 def _dec_scale(dt: T.DataType) -> int:

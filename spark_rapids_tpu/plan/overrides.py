@@ -53,7 +53,14 @@ _DEVICE_EXPRS = (
     E.GreaterThanOrEqual, E.And, E.Or, E.Not, E.IsNull, E.IsNotNull, E.IsNaN,
     E.Coalesce, E.If, E.CaseWhen, E.In,
     E.Sqrt, E.Floor, E.Ceil, E.Round, E.Exp, E.Log, E.Pow,
+    E.Log10, E.Log2, E.Log1p, E.Expm1, E.Cbrt, E.Signum,
+    E.Sin, E.Cos, E.Tan, E.Asin, E.Acos, E.Atan, E.Sinh, E.Cosh, E.Tanh,
+    E.ToDegrees, E.ToRadians, E.Atan2, E.Hypot,
+    E.Greatest, E.Least, E.NullIf, E.Nvl2,
+    E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor, E.BitwiseNot,
+    E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned,
     E.Year, E.Month, E.DayOfMonth, E.DayOfWeek, E.DayOfYear, E.Quarter,
+    E.Hour, E.Minute, E.Second, E.WeekOfYear, E.LastDay, E.AddMonths,
     E.DateAdd, E.DateSub, E.DateDiff,
     E.Length, E.Upper, E.Lower, E.StartsWith, E.EndsWith, E.Contains,
     E.Substring,

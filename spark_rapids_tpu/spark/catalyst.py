@@ -27,6 +27,8 @@ def parse_type(s: str) -> T.DataType:
     if s.startswith("decimal("):
         p, sc = s[8:-1].split(",")
         return T.DecimalType(int(p), int(sc))
+    if s not in _TYPES:
+        raise UnsupportedPlanError(f"data type {s}")
     return _TYPES[s]
 
 

@@ -63,10 +63,13 @@ class ColumnarOverrideRules:
                 kids[0])
         if cls in ("SortMergeJoinExec", "ShuffledHashJoinExec",
                    "BroadcastHashJoinExec"):
-            jt = {"Inner": "inner", "LeftOuter": "left",
-                  "RightOuter": "right", "FullOuter": "full",
-                  "LeftSemi": "left_semi", "LeftAnti": "left_anti"}[
-                node.get("joinType", "Inner")]
+            jt_map = {"Inner": "inner", "LeftOuter": "left",
+                      "RightOuter": "right", "FullOuter": "full",
+                      "LeftSemi": "left_semi", "LeftAnti": "left_anti"}
+            jt_name = node.get("joinType", "Inner")
+            if jt_name not in jt_map:
+                raise UnsupportedPlanError(f"join type {jt_name}")
+            jt = jt_map[jt_name]
             return L.Join(kids[0], kids[1],
                           [parse_expr(e) for e in node["leftKeys"]],
                           [parse_expr(e) for e in node["rightKeys"]],

@@ -156,6 +156,31 @@ CASES = {
                        E.DayOfWeek(col("d")), E.DayOfYear(col("d"))],
     "cast": [E.Cast(col("f"), T.INT), E.Cast(col("i"), T.DOUBLE),
              E.Cast(col("i"), T.LONG)],
+    "math2": [E.Log10(col("f")), E.Log2(col("f")), E.Log1p(col("g")),
+              E.Expm1(col("g")), E.Cbrt(col("f")), E.Signum(col("g"))],
+    "trig": [E.Sin(col("g")), E.Cos(col("g")), E.Tan(col("g")),
+             E.Atan(col("g")), E.Sinh(col("g")), E.Cosh(col("g")),
+             E.Tanh(col("g")), E.ToDegrees(col("g")),
+             E.ToRadians(col("g")), E.Atan2(col("f"), col("g")),
+             E.Hypot(col("f"), col("g"))],
+    "trig_domain": [E.Asin(E.Divide(col("g"), lit(10.0))),
+                    E.Acos(E.Divide(col("g"), lit(10.0)))],
+    "greatest_least": [E.Greatest(col("i"), col("j"), col("e")),
+                       E.Least(col("i"), col("j"), col("e"))],
+    "nullif_nvl2": [E.NullIf(col("i"), col("e")),
+                    E.NullIf(col("s"), col("p")),
+                    E.Nvl2(col("i"), col("j"), col("e"))],
+    "bitwise": [E.BitwiseAnd(col("i"), col("j")),
+                E.BitwiseOr(col("i"), col("j")),
+                E.BitwiseXor(col("i"), col("j")), E.BitwiseNot(col("i"))],
+    "shifts": [E.ShiftLeft(col("j"), col("i")),
+               E.ShiftRight(col("j"), col("i")),
+               E.ShiftRightUnsigned(col("big"), col("i"))],
+    "time_parts": [E.Hour(E.Cast(col("d"), T.TIMESTAMP)),
+                   E.Minute(E.Cast(col("d"), T.TIMESTAMP)),
+                   E.Second(E.Cast(col("d"), T.TIMESTAMP))],
+    "week_lastday": [E.WeekOfYear(col("d")), E.LastDay(col("d")),
+                     E.AddMonths(col("d"), col("e"))],
 }
 
 
