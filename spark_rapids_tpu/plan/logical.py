@@ -236,4 +236,28 @@ class Union(LogicalPlan):
 
     @property
     def schema(self) -> T.Schema:
-        return self.inputs[0].schema
+        # Spark widens union branch types to a common type (WidenSetOperationTypes)
+        first = self.inputs[0].schema
+        fields = []
+        for i, f in enumerate(first):
+            dt = f.dtype
+            nullable = f.nullable
+            for other in self.inputs[1:]:
+                of = other.schema[i]
+                nullable = nullable or of.nullable
+                if of.dtype != dt:
+                    dt = _union_widen(dt, of.dtype)
+            fields.append(T.Field(f.name, dt, nullable))
+        return T.Schema(fields)
+
+
+def _union_widen(a: T.DataType, b: T.DataType) -> T.DataType:
+    if a == b:
+        return a
+    if isinstance(a, T.DecimalType) and isinstance(b, T.DecimalType):
+        s = max(a.scale, b.scale)
+        p = max(a.precision - a.scale, b.precision - b.scale) + s
+        return T.DecimalType(min(p, 38), s)
+    from spark_rapids_tpu.exprs.expr import _numeric_widen
+
+    return _numeric_widen(a, b)

@@ -478,6 +478,20 @@ class Overrides:
             return (GlobalLimitExec(node.n, kids[0], offset=node.offset)
                     if on_dev else CpuLimitExec(node.n, kids[0], node.offset))
         if isinstance(node, L.Union):
+            # widen mismatched branch types to the union schema (Spark
+            # WidenSetOperationTypes inserts the same casts)
+            target = node.schema
+            cast_kids = []
+            for ch, ex in zip(node.children, kids):
+                if [f.dtype for f in ch.schema] != [f.dtype for f in target]:
+                    exprs = [
+                        E.Alias(E.Cast(E.col(cf.name), tf.dtype), tf.name)
+                        if cf.dtype != tf.dtype else E.col(cf.name)
+                        for cf, tf in zip(ch.schema, target)]
+                    ex = (ProjectExec(exprs, ex) if not isinstance(
+                        ex, CpuExec) else CpuProjectExec(exprs, ex))
+                cast_kids.append(ex)
+            kids = cast_kids
             if not on_dev:
                 from spark_rapids_tpu.plan.cpu import CpuUnionExec
 

@@ -152,6 +152,12 @@ def main():
               f"{entry.get('rows', '')} rows {entry['seconds']}s "
               f"{entry.get('dev_err', '') or entry.get('cpu_err', '') or entry.get('diff', '')}"[:140],
               flush=True)
+        # crash-safe: persist progress after every query
+        os.makedirs(args.out, exist_ok=True)
+        with open(os.path.join(args.out, "tpcds_status.partial.json"),
+                  "w") as f:
+            json.dump({"sf": args.sf, "results": results}, f, indent=1,
+                      default=str)
 
     counts = {}
     for e in results.values():
