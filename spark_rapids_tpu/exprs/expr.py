@@ -1188,6 +1188,26 @@ class CountDistinct(AggregateExpression, _Unary):
         return False
 
 
+class CollectList(AggregateExpression, _Unary):
+    """collect_list: CPU-engine aggregate (array results; reference
+    GpuCollectList via cudf collect_list — device path future work)."""
+
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return T.ArrayType(self.child.dtype)
+
+    @property
+    def nullable(self):
+        return False
+
+
+class CollectSet(CollectList):
+    """collect_set: distinct collect (order undefined; we sort for
+    determinism like the reference's tests do)."""
+
+
 class _VarianceBase(AggregateExpression, _Unary):
     """Moment aggregates (reference: GpuStddevSamp etc. via cudf
     VARIANCE/STD groupby aggregations; here: (n, sum, sum_sq) buffers with

@@ -170,6 +170,12 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                             var = m2 / ((nn - 1) if samp else nn)
                             out.append(np.sqrt(var) if isinstance(
                                 bound, (E.StddevSamp, E.StddevPop)) else var)
+                elif isinstance(bound, E.CollectList):
+                    py = [v.item() if hasattr(v, "item") else v
+                          for v in vals[sel]]
+                    if isinstance(bound, E.CollectSet):
+                        py = sorted(set(py))
+                    out.append(py)
                 elif isinstance(bound, E.CountDistinct):
                     out.append(int(len(set(
                         v.item() if hasattr(v, "item") else v

@@ -283,3 +283,22 @@ def test_variance_stddev_aggs():
                 assert math.isnan(va) and math.isnan(vb), (kcol, a, b)
             else:
                 assert abs(va - vb) <= 1e-9 * max(1.0, abs(va)), (kcol, a, b)
+
+
+def test_collect_list_set():
+    """collect_list/collect_set run on the CPU engine (array results),
+    tagged off-device like the reference pre-GpuCollectList versions."""
+    t = pa.table({
+        "k": pa.array([1, 1, 2, 1, 2], type=pa.int64()),
+        "v": pa.array([3, 1, 5, 3, 5], type=pa.int64()),
+    })
+    df = from_arrow(t, RapidsConf({}))
+    rows = (df.group_by("k")
+            .agg(E.CollectList(col("v")).alias("cl"),
+                 E.CollectSet(col("v")).alias("cs"))
+            .sort("k")).collect()
+    assert rows[0]["cl"] == [3, 1, 3] and rows[0]["cs"] == [1, 3]
+    assert rows[1]["cl"] == [5, 5] and rows[1]["cs"] == [5]
+    stats = (df.group_by("k").agg(E.CollectList(col("v")).alias("cl"))
+             .device_plan_stats())
+    assert stats["cpu_nodes"], stats
