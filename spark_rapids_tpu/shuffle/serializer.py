@@ -42,7 +42,9 @@ _NAME_TO_TYPE = {
     "date": T.DATE, "timestamp": T.TIMESTAMP, "string": T.STRING,
     "binary": T.BINARY,
 }
-_CODECS = {"none": 0, "zlib": 1}
+# wire codecs (reference: nvcomp LZ4/ZSTD batch codecs for shuffle/spill,
+# NvcompLZ4CompressionCodec.scala) — lz4/zstd via arrow's native codecs
+_CODECS = {"none": 0, "zlib": 1, "lz4": 2, "zstd": 3}
 _CODEC_NAMES = {v: k for k, v in _CODECS.items()}
 
 
@@ -79,6 +81,8 @@ def serialize_table(table: pa.Table, codec: str = "none") -> bytes:
             if dt == T.DATE:
                 vals = np.asarray(arr.fill_null(0).cast(pa.int32()))
             elif dt == T.TIMESTAMP:
+                if arr.type.unit != "us":
+                    arr = arr.cast(pa.timestamp("us", tz=arr.type.tz))
                 vals = np.asarray(arr.fill_null(0).cast(pa.int64()))
             else:
                 vals = np.asarray(arr.fill_null(0)).astype(np_t, copy=False)
@@ -109,6 +113,10 @@ def serialize_table(table: pa.Table, codec: str = "none") -> bytes:
     body = b"".join(bufs)
     if codec == "zlib":
         body = zlib.compress(body, level=1)
+    elif codec in ("lz4", "zstd"):
+        raw_len = len(body)
+        body = (struct.pack("<Q", raw_len)
+                + pa.Codec(codec).compress(body, asbytes=True))
     return b"".join(header) + struct.pack("<I", len(body)) + body
 
 
@@ -144,8 +152,12 @@ def deserialize_table(buf: bytes, schema: T.Schema,
     pos += 4
     body = buf[pos: pos + body_len]
     end = pos + body_len
-    if _CODEC_NAMES[codec] == "zlib":
+    cname = _CODEC_NAMES[codec]
+    if cname == "zlib":
         body = zlib.decompress(body)
+    elif cname in ("lz4", "zstd"):
+        (raw_len,) = struct.unpack_from("<Q", body, 0)
+        body = pa.Codec(cname).decompress(body[8:], raw_len, asbytes=True)
     arrays = []
     bpos = 0
     for (tcode, has_off, dlen, vlen, olen), field in zip(cols_meta, schema):
