@@ -175,7 +175,8 @@ class SpillFramework:
                          np.asarray(c.dictionary.data),
                          np.asarray(c.dictionary.validity),
                          np.asarray(c.dictionary.offsets),
-                         c.dict_size, c.dict_max_len))
+                         c.dict_size, c.dict_max_len),
+                     None if c.data2 is None else np.asarray(c.data2))
                     for c in hcols
                 ],
             }
@@ -213,11 +214,13 @@ class SpillFramework:
             cols = h._host["cols"]
             arrays = {"num_rows": np.int64(h._host["num_rows"]),
                       "ncols": np.int64(len(cols))}
-            for i, (data, valid, offsets, dinfo) in enumerate(cols):
+            for i, (data, valid, offsets, dinfo, data2) in enumerate(cols):
                 arrays[f"d{i}"] = data
                 arrays[f"v{i}"] = valid
                 if offsets is not None:
                     arrays[f"o{i}"] = offsets
+                if data2 is not None:
+                    arrays[f"h{i}"] = data2  # DECIMAL128 hi limbs
                 if dinfo is not None:
                     dd, dv, do, dsize, dmax = dinfo
                     arrays[f"dd{i}"] = dd
@@ -250,11 +253,12 @@ class SpillFramework:
             # others; the handle is pinned so it cannot become its own victim)
             self.pool.allocate(h.nbytes)
             cols = []
-            for dt, (d, v, o, dinfo) in zip(h._dtypes, host["cols"]):
+            for dt, (d, v, o, dinfo, d2) in zip(h._dtypes, host["cols"]):
                 if dinfo is None:
                     cols.append(DeviceColumn(
                         dt, jnp.asarray(d), jnp.asarray(v),
-                        None if o is None else jnp.asarray(o)))
+                        None if o is None else jnp.asarray(o),
+                        data2=None if d2 is None else jnp.asarray(d2)))
                     continue
                 dd, dv, do, dsize, dmax = dinfo
                 dict_col = DeviceColumn(dt, jnp.asarray(dd), jnp.asarray(dv),
@@ -279,7 +283,8 @@ class SpillFramework:
                  z[f"o{i}"] if f"o{i}" in z.files else None,
                  (z[f"dd{i}"], z[f"dv{i}"], z[f"do{i}"],
                   int(z[f"dm{i}"][0]), int(z[f"dm{i}"][1]))
-                 if f"dd{i}" in z.files else None)
+                 if f"dd{i}" in z.files else None,
+                 z[f"h{i}"] if f"h{i}" in z.files else None)
                 for i in range(ncols)
             ]
         os.unlink(h._disk_path)

@@ -172,3 +172,38 @@ def test_semaphore_limits_and_priority():
     # arrival order preserved (longest-waiting first)
     assert order == sorted(order)
     assert sem.max_waiters >= 1
+
+
+def test_spill_roundtrip_wide_decimal():
+    """DECIMAL128 (hi, lo) columns survive device->host->disk->device
+    spill with both limbs intact."""
+    import decimal
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.mem.pool import HbmPool
+    from spark_rapids_tpu.mem.spill import SpillFramework, SpillableBatch
+
+    D = decimal.Decimal
+    vals = [D("12345678901234567890.123456789012345678"),
+            D("-99999999999999999999.999999999999999999"), None]
+    t = pa.table({"w": pa.array(vals, pa.decimal128(38, 18)),
+                  "i": pa.array([1, 2, 3], pa.int64())})
+    b = batch_from_arrow(t)
+    import tempfile
+    nb = b.nbytes()
+    # device budget fits ~1.5 batches, host budget ~0 -> registering two
+    # more batches pushes the first through HOST to DISK
+    fw = SpillFramework(HbmPool(nb + nb // 2), host_limit_bytes=16,
+                        spill_dir=tempfile.mkdtemp())
+    h = SpillableBatch(b, fw)
+    extra = [SpillableBatch(batch_from_arrow(t), fw) for _ in range(2)]
+    assert h.state == "DISK", h.state
+    with h as back:
+        schema = T.Schema.from_arrow(t.schema)
+        got = batch_to_arrow(back, schema).to_pylist()
+        assert [r["w"] for r in got] == vals
+    for x in [h] + extra:
+        x.close()
