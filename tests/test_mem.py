@@ -174,7 +174,7 @@ def test_semaphore_limits_and_priority():
     assert sem.max_waiters >= 1
 
 
-def test_spill_roundtrip_wide_decimal():
+def test_spill_roundtrip_wide_decimal(tmp_path):
     """DECIMAL128 (hi, lo) columns survive device->host->disk->device
     spill with both limbs intact."""
     import decimal
@@ -192,12 +192,11 @@ def test_spill_roundtrip_wide_decimal():
     t = pa.table({"w": pa.array(vals, pa.decimal128(38, 18)),
                   "i": pa.array([1, 2, 3], pa.int64())})
     b = batch_from_arrow(t)
-    import tempfile
     nb = b.nbytes()
     # device budget fits ~1.5 batches, host budget ~0 -> registering two
     # more batches pushes the first through HOST to DISK
     fw = SpillFramework(HbmPool(nb + nb // 2), host_limit_bytes=16,
-                        spill_dir=tempfile.mkdtemp())
+                        spill_dir=str(tmp_path))
     h = SpillableBatch(b, fw)
     extra = [SpillableBatch(batch_from_arrow(t), fw) for _ in range(2)]
     assert h.state == "DISK", h.state
