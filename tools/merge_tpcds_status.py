@@ -1,0 +1,66 @@
+"""Merge tracker result files (full/partial) into docs/tpcds_status.{json,md}.
+
+Used when a long differential run is assembled from a crash-recovered
+partial file plus a completion run (the tracker checkpoints after every
+query since round 3). Later files win per query.
+
+Usage: python tools/merge_tpcds_status.py OUT_DIR FILE1 [FILE2 ...]
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    out_dir = sys.argv[1]
+    merged = {}
+    sf = None
+    for path in sys.argv[2:]:
+        with open(path) as f:
+            d = json.load(f)
+        sf = d.get("sf", sf)
+        merged.update(d.get("results", {}))
+    names = [f"q{i}" for i in range(1, 100)]
+    results = {n: merged.get(n, {"status": "missing"}) for n in names}
+    counts = {}
+    for e in results.values():
+        counts[e["status"]] = counts.get(e["status"], 0) + 1
+    fracs = [e["device_fraction"] for e in results.values()
+             if e.get("device_fraction") is not None]
+    summary = {"sf": sf, "counts": counts,
+               "avg_device_fraction": round(sum(fracs) / len(fracs), 4)
+               if fracs else None,
+               "results": results}
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "tpcds_status.json"), "w") as f:
+        json.dump(summary, f, indent=1, default=str)
+    with open(os.path.join(out_dir, "tpcds_status.md"), "w") as f:
+        f.write("# TPC-DS 99-query differential status\n\n")
+        f.write(f"Scale factor {sf}; device engine vs CPU-fallback oracle "
+                "(same plans, disjoint execution paths). device% = share "
+                "of physical plan nodes executing on the device engine "
+                "(assert_gpu_fallback_collect analog).\n\n")
+        f.write("| status | count |\n|---|---|\n")
+        for k in sorted(counts):
+            f.write(f"| {k} | {counts[k]} |\n")
+        if fracs:
+            f.write(f"\nAverage device-node fraction: "
+                    f"**{sum(fracs) / len(fracs):.3f}**\n")
+        f.write("\n| query | status | rows | seconds | device% | note |\n"
+                "|---|---|---|---|---|---|\n")
+        for n in names:
+            e = results[n]
+            note = (e.get("dev_err") or e.get("cpu_err")
+                    or e.get("diff") or "")
+            if e.get("cpu_nodes"):
+                note = f"cpu: {','.join(e['cpu_nodes'])} {note}"
+            fr = e.get("device_fraction")
+            f.write(f"| {n} | {e.get('status')} | {e.get('rows', '')} | "
+                    f"{e.get('seconds', '')} | "
+                    f"{'' if fr is None else fr} | {str(note)[:90]} |\n")
+    print("merged", len(merged), "->", counts)
+
+
+if __name__ == "__main__":
+    main()
