@@ -53,7 +53,8 @@ class _AggSpec:
 
 
 _MERGE_OP = {"sum": "sum", "count": "sum", "count_all": "sum", "min": "min",
-             "max": "max", "first": "first", "last": "last", "sumsq": "sum"}
+             "max": "max", "first": "first", "last": "last", "sumsq": "sum",
+             "sum3": "sum", "sum4": "sum"}
 
 
 def _lower_agg(func: E.AggregateExpression, name: str,
@@ -72,6 +73,11 @@ def _lower_agg(func: E.AggregateExpression, name: str,
         sum_t = T.DecimalType(min(38, c.precision + 10), c.scale) if isinstance(
             c, T.DecimalType) else T.DOUBLE if c in T.FRACTIONAL_TYPES else T.LONG
         return _AggSpec(func, name, input_index, ["sum", "count"], [sum_t, T.LONG])
+    if isinstance(func, (E.Skewness, E.Kurtosis)):
+        # raw power-sum buffers up to the 4th moment
+        return _AggSpec(func, name, input_index,
+                        ["sum", "sumsq", "sum3", "sum4", "count"],
+                        [T.DOUBLE] * 4 + [T.LONG])
     if isinstance(func, E._VarianceBase):
         # (sum, sum_sq, n) moment buffers; the final division happens in
         # _final_project (reference: cudf VARIANCE/STD groupby aggs)
@@ -392,19 +398,20 @@ class HashAggregateExec(UnaryExec):
                     r = flag_row(("live", ii), active & v.validity)
                     plans.append(("count", r, bt))
                     continue
-                if op == "sumsq":
+                if op in ("sumsq", "sum3", "sum4"):
+                    power = {"sumsq": 2, "sum3": 3, "sum4": 4}[op]
                     live = active & v.validity
-                    key = ("sumsq", ii)
+                    key = (op, ii)
                     if key not in row_cache:
                         row_cache[key] = len(f64_rows)
                         d, is_nan = K._float_canonical(v.data)
-                        f64_rows.append(jnp.where(live, d * d, 0.0))
-                        row_cache[("sqnan", ii)] = flag_row(
+                        f64_rows.append(jnp.where(live, d ** power, 0.0))
+                        row_cache[("pnan", ii)] = flag_row(
                             ("nan", ii), live & is_nan)
                     vrow = flag_row(("live", ii), live) \
                         if nullable(ii) else 0
                     plans.append(("fsum", row_cache[key],
-                                  row_cache[("sqnan", ii)], vrow, bt))
+                                  row_cache[("pnan", ii)], vrow, bt))
                     continue
                 if op == "sum":
                     live = active & v.validity
@@ -661,8 +668,9 @@ class HashAggregateExec(UnaryExec):
                         src, gi, contributing, op, bt, cap, out_row_valid))
                     continue
                 seg_op = op
-                if op == "sumsq":
-                    vals = vals.astype(jnp.float64) ** 2
+                if op in ("sumsq", "sum3", "sum4"):
+                    power = {"sumsq": 2, "sum3": 3, "sum4": 4}[op]
+                    vals = vals.astype(jnp.float64) ** power
                     seg_op = "sum"
                 data, avalid = K.segment_agg(vals, valid, contributing, gi.segment_ids,
                                              cap, seg_op, ends=seg_ends,
@@ -838,6 +846,24 @@ class HashAggregateExec(UnaryExec):
                     ).astype(jnp.float64)
                 valid = ssum.validity & nz
                 out_cols.append(DeviceColumn(rt, jnp.where(valid, data, 0), valid))
+            elif isinstance(s.func, (E.Skewness, E.Kurtosis)):
+                s1, s2, s3, s4, cnt = bufs
+                n = jnp.maximum(cnt.data, 1).astype(jnp.float64)
+                mu = s1.data.astype(jnp.float64) / n
+                S2 = s2.data - n * mu ** 2
+                S2 = jnp.maximum(S2, 0.0)
+                if isinstance(s.func, E.Skewness):
+                    S3 = s3.data - 3 * mu * s2.data + 2 * n * mu ** 3
+                    data = jnp.sqrt(n) * S3 / jnp.maximum(S2, 1e-300) ** 1.5
+                    data = jnp.where(S2 <= 0, jnp.float64(jnp.nan), data)
+                else:
+                    S4 = (s4.data - 4 * mu * s3.data + 6 * mu ** 2 * s2.data
+                          - 3 * n * mu ** 4)
+                    data = n * S4 / jnp.maximum(S2, 1e-300) ** 2 - 3.0
+                    data = jnp.where(S2 <= 0, jnp.float64(jnp.nan), data)
+                valid = cnt.data > 0
+                out_cols.append(DeviceColumn(
+                    rt, jnp.where(valid, data, 0.0), valid))
             elif isinstance(s.func, E._VarianceBase):
                 ssum, ssq, cnt = bufs
                 n = jnp.maximum(cnt.data, 1).astype(jnp.float64)

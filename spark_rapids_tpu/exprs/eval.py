@@ -853,6 +853,82 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         nm = jnp.where(m == 12, 1, m + 1)
         out = _days_from_civil(ny, nm, jnp.ones_like(ny)) - 1
         return ColVal(out.astype(jnp.int32), c.validity)
+    if isinstance(expr, E.MonthsBetween):
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+
+        def ymd(v, dt):
+            days = (v.data // 86_400_000_000 if dt == T.TIMESTAMP
+                    else v.data).astype(jnp.int32)
+            return _civil_from_days(days)
+        y1, m1, d1 = ymd(l, expr.left.dtype)
+        y2, m2, d2 = ymd(r, expr.right.dtype)
+        months = (y1 - y2) * 12 + (m1 - m2)
+        # Spark: same day-of-month (or both month ends) -> whole months,
+        # else add (d1 - d2)/31
+        frac = (d1 - d2).astype(jnp.float64) / 31.0
+        out = months.astype(jnp.float64) + jnp.where(d1 == d2, 0.0, frac)
+        return ColVal(out, l.validity & r.validity)
+    if isinstance(expr, E.TruncDate):
+        c = eval_expr(expr.children[0], ctx)
+        days = c.data.astype(jnp.int32)
+        y, m, d = _civil_from_days(days)
+        fmt = expr.fmt
+        if fmt in ("year", "yyyy", "yy"):
+            out = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        elif fmt in ("quarter",):
+            qm = ((m - 1) // 3) * 3 + 1
+            out = _days_from_civil(y, qm, jnp.ones_like(d))
+        elif fmt in ("month", "mon", "mm"):
+            out = _days_from_civil(y, m, jnp.ones_like(d))
+        elif fmt in ("week",):
+            wd = ((days + 3) % 7 + 7) % 7  # 0 = Monday
+            out = days - wd
+        else:
+            raise NotImplementedError(f"trunc format {fmt}")
+        return ColVal(out.astype(jnp.int32), c.validity)
+    if isinstance(expr, E.NextDay):
+        c = eval_expr(expr.children[0], ctx)
+        days = c.data.astype(jnp.int32)
+        target = E.NextDay._DOW[expr.day.lower()[:3]]  # 1=Sun..7=Sat
+        dow = ((days + 4) % 7 + 7) % 7 + 1  # Spark dayofweek
+        delta = ((target - dow) % 7 + 7) % 7
+        delta = jnp.where(delta == 0, 7, delta)
+        return ColVal((days + delta).astype(jnp.int32), c.validity)
+    if isinstance(expr, E.UnixTimestampOf):
+        c = eval_expr(expr.child, ctx)
+        us = (c.data.astype(jnp.int64) * 86_400_000_000
+              if expr.child.dtype == T.DATE else c.data.astype(jnp.int64))
+        return ColVal(us // 1_000_000, c.validity)  # // floors (pre-epoch)
+    if isinstance(expr, E.FromUnixTime):
+        c = eval_expr(expr.child, ctx)
+        return ColVal(c.data.astype(jnp.int64) * 1_000_000, c.validity)
+    if isinstance(expr, E.OctetLength):  # covers BitLength
+        s = eval_expr(expr.child, ctx)
+        assert isinstance(s, StringVal)
+        lens = (s.offsets[1:] - s.offsets[:-1]).astype(jnp.int32)
+        mul = 8 if isinstance(expr, E.BitLength) else 1
+        return ColVal(lens * mul, s.validity)
+    if isinstance(expr, (E.StringLeft, E.StringRight)):
+        # left/right are substring sugar (Spark rewrites them the same way)
+        n_chars = max(int(expr.n), 0)
+        sub = (E.Substring(expr.children[0], 1, n_chars)
+               if type(expr) is E.StringLeft
+               else E.Substring(expr.children[0],
+                                -n_chars if n_chars else 1, n_chars))
+        return eval_expr(sub, ctx)
+    if isinstance(expr, E.Nanvl):
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+        a = l.data.astype(jnp.float64)
+        b = r.data.astype(jnp.float64)
+        take_b = jnp.isnan(a)
+        return ColVal(jnp.where(take_b, b, a),
+                      jnp.where(take_b, r.validity, l.validity))
+    if isinstance(expr, E.Rint):
+        c = eval_expr(expr.child, ctx)
+        # round half to even (java.lang.Math.rint)
+        return ColVal(jnp.round(c.data.astype(jnp.float64)), c.validity)
     if isinstance(expr, E.AddMonths):
         l = eval_expr(expr.left, ctx)
         r = eval_expr(expr.right, ctx)

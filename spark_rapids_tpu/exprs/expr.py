@@ -776,6 +776,99 @@ class AddMonths(_Binary):
         return T.DATE
 
 
+class MonthsBetween(_Binary):
+    """months_between(end, start): whole months + day fraction /31."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt): year/month/quarter/week floor."""
+
+    def __init__(self, child: Expression, fmt: str):
+        self.children = (child,)
+        self.fmt = fmt.lower()
+        self._params = (fmt,)
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+class NextDay(Expression):
+    """next_day(date, dayOfWeek-literal)."""
+
+    _DOW = {"sun": 1, "mon": 2, "tue": 3, "wed": 4, "thu": 5, "fri": 6,
+            "sat": 7}
+
+    def __init__(self, child: Expression, day: str):
+        self.children = (child,)
+        self.day = day
+        self._params = (day,)
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+class UnixTimestampOf(_Unary):
+    """to_unix_timestamp(ts): seconds since epoch (floor)."""
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+
+class FromUnixTime(_Unary):
+    """from_unixtime seconds -> timestamp (string formatting is a
+    downstream cast in this engine)."""
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+
+class OctetLength(_Unary):
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class BitLength(OctetLength):
+    pass
+
+
+class StringLeft(Expression):
+    """left(str, n-literal)."""
+
+    def __init__(self, child: Expression, n: int):
+        self.children = (child,)
+        self.n = n
+        self._params = (n,)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class StringRight(StringLeft):
+    pass
+
+
+class Nanvl(_Binary):
+    """nanvl(a, b): b when a is NaN else a."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+
+class Rint(_UnaryMath):
+    """java.lang.Math.rint: round half to even, returns double."""
+
+
 class Pow(_Binary):
     @property
     def dtype(self):
@@ -1236,6 +1329,14 @@ class StddevSamp(_VarianceBase):
 
 class StddevPop(_VarianceBase):
     pass
+
+
+class Skewness(_VarianceBase):
+    """3rd standardized moment (cudf groupby skew analog)."""
+
+
+class Kurtosis(_VarianceBase):
+    """Spark kurtosis: excess kurtosis m4/m2^2 - 3."""
 
 
 def resolve(expr: Expression, schema: T.Schema) -> Expression:

@@ -497,6 +497,78 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         M = d.astype("datetime64[D]").astype("datetime64[M]")
         out = ((M + 1).astype("datetime64[D]") - 1).astype(np.int32)
         return out, m
+    if isinstance(expr, E.MonthsBetween):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+
+        def ymd(v, dt):
+            days = (v // 86_400_000_000 if dt == T.TIMESTAMP else v)
+            M = days.astype("datetime64[D]").astype("datetime64[M]")
+            y = M.astype("datetime64[Y]").astype(int) + 1970
+            m = M.astype(int) % 12 + 1
+            d = (days.astype("datetime64[D]") - M).astype(int) + 1
+            return y, m, d
+        y1, m1, d1 = ymd(a, expr.left.dtype)
+        y2, m2, d2 = ymd(b, expr.right.dtype)
+        months = (y1 - y2) * 12 + (m1 - m2)
+        frac = (d1 - d2).astype(np.float64) / 31.0
+        return months.astype(np.float64) + np.where(d1 == d2, 0.0, frac), \
+            ma & mb
+    if isinstance(expr, E.TruncDate):
+        d, m = ev(expr.children[0])
+        days = d.astype("datetime64[D]")
+        fmt = expr.fmt
+        if fmt in ("year", "yyyy", "yy"):
+            out = days.astype("datetime64[Y]").astype("datetime64[D]")
+        elif fmt == "quarter":
+            M = days.astype("datetime64[M]").astype(int)
+            out = ((M // 3) * 3).astype("datetime64[M]").astype(
+                "datetime64[D]")
+        elif fmt in ("month", "mon", "mm"):
+            out = days.astype("datetime64[M]").astype("datetime64[D]")
+        elif fmt == "week":
+            di = d.astype(np.int64)
+            wd = ((di + 3) % 7 + 7) % 7  # 0 = Monday
+            out = (di - wd).astype("datetime64[D]")
+        else:
+            raise NotImplementedError(f"trunc format {fmt}")
+        return out.astype(np.int32), m
+    if isinstance(expr, E.NextDay):
+        d, m = ev(expr.children[0])
+        di = d.astype(np.int64)
+        target = E.NextDay._DOW[expr.day.lower()[:3]]
+        dow = ((di + 4) % 7 + 7) % 7 + 1
+        delta = ((target - dow) % 7 + 7) % 7
+        delta = np.where(delta == 0, 7, delta)
+        return (di + delta).astype(np.int32), m
+    if isinstance(expr, E.UnixTimestampOf):
+        d, m = ev(expr.child)
+        us = (d.astype(np.int64) * 86_400_000_000
+              if expr.child.dtype == T.DATE else d.astype(np.int64))
+        return us // 1_000_000, m
+    if isinstance(expr, E.FromUnixTime):
+        d, m = ev(expr.child)
+        return d.astype(np.int64) * 1_000_000, m
+    if isinstance(expr, E.OctetLength):  # covers BitLength
+        s_, m = ev(expr.child)
+        mul = 8 if isinstance(expr, E.BitLength) else 1
+        return np.array([len(x.encode("utf-8")) * mul for x in s_],
+                        np.int32), m
+    if isinstance(expr, (E.StringLeft, E.StringRight)):
+        n_chars = max(int(expr.n), 0)
+        sub = (E.Substring(expr.children[0], 1, n_chars)
+               if type(expr) is E.StringLeft
+               else E.Substring(expr.children[0],
+                                -n_chars if n_chars else 1, n_chars))
+        return ev(sub)
+    if isinstance(expr, E.Nanvl):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        a = a.astype(np.float64)
+        b = b.astype(np.float64)
+        take_b = np.isnan(a)
+        return np.where(take_b, b, a), np.where(take_b, mb, ma)
+    if isinstance(expr, E.Rint):
+        d, m = ev(expr.child)
+        return np.round(d.astype(np.float64)), m  # half-to-even like rint
     if isinstance(expr, E.AddMonths):
         (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
         out = []

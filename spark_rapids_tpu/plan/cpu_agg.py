@@ -152,6 +152,24 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                                    if cnt else None)
                     else:
                         out.append(float(vals[sel].mean()))
+                elif isinstance(bound, (E.Skewness, E.Kurtosis)):
+                    if not sel.any():
+                        out.append(None)
+                    else:
+                        x = vals[sel].astype(np.float64)
+                        if dec_in:
+                            x = x / (10.0 ** in_dt.scale)
+                        nn = len(x)
+                        mu = x.mean()
+                        S2 = max(float(((x - mu) ** 2).sum()), 0.0)
+                        if S2 <= 0:
+                            out.append(float("nan"))
+                        elif isinstance(bound, E.Skewness):
+                            S3 = float(((x - mu) ** 3).sum())
+                            out.append(np.sqrt(nn) * S3 / S2 ** 1.5)
+                        else:
+                            S4 = float(((x - mu) ** 4).sum())
+                            out.append(nn * S4 / S2 ** 2 - 3.0)
                 elif isinstance(bound, E._VarianceBase):
                     if not sel.any():
                         out.append(None)

@@ -61,6 +61,9 @@ _DEVICE_EXPRS = (
     E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned,
     E.Year, E.Month, E.DayOfMonth, E.DayOfWeek, E.DayOfYear, E.Quarter,
     E.Hour, E.Minute, E.Second, E.WeekOfYear, E.LastDay, E.AddMonths,
+    E.MonthsBetween, E.TruncDate, E.NextDay, E.UnixTimestampOf,
+    E.FromUnixTime, E.Nanvl, E.Rint,
+    E.OctetLength, E.BitLength, E.StringLeft, E.StringRight,
     E.DateAdd, E.DateSub, E.DateDiff,
     E.Length, E.Upper, E.Lower, E.StartsWith, E.EndsWith, E.Contains,
     E.Substring,
@@ -70,6 +73,7 @@ _DEVICE_EXPRS = (
     E.Ascii, E.Chr,
     E.Sum, E.Count, E.Min, E.Max, E.Average, E.First, E.Last,
     E.VarianceSamp, E.VariancePop, E.StddevSamp, E.StddevPop,
+    E.Skewness, E.Kurtosis,
 )
 
 

@@ -65,6 +65,10 @@ def cpu_run(exprs):
             elif ex.dtype == T.DATE:
                 out.append(datetime.date(1970, 1, 1)
                            + datetime.timedelta(days=int(vals[i])))
+            elif ex.dtype == T.TIMESTAMP:
+                out.append(datetime.datetime(
+                    1970, 1, 1, tzinfo=datetime.timezone.utc)
+                    + datetime.timedelta(microseconds=int(vals[i])))
             else:
                 out.append(vals[i])
         cols.append(out)
@@ -181,6 +185,20 @@ CASES = {
                    E.Second(E.Cast(col("d"), T.TIMESTAMP))],
     "week_lastday": [E.WeekOfYear(col("d")), E.LastDay(col("d")),
                      E.AddMonths(col("d"), col("e"))],
+    "months_trunc": [E.MonthsBetween(col("d"), E.DateAdd(col("d"), col("e"))),
+                     E.TruncDate(col("d"), "year"),
+                     E.TruncDate(col("d"), "month"),
+                     E.TruncDate(col("d"), "quarter"),
+                     E.TruncDate(col("d"), "week"),
+                     E.NextDay(col("d"), "Mon")],
+    "unix_ts": [E.UnixTimestampOf(E.Cast(col("d"), T.TIMESTAMP)),
+                E.UnixTimestampOf(col("d")),
+                E.FromUnixTime(col("i"))],
+    "str_len2": [E.OctetLength(col("s")), E.BitLength(col("s")),
+                 E.StringLeft(col("s"), 3), E.StringRight(col("s"), 4),
+                 E.StringLeft(col("s"), 0)],
+    "nanvl_rint": [E.Nanvl(col("f"), col("g")), E.Rint(col("f")),
+                   E.Rint(col("g"))],
 }
 
 

@@ -302,3 +302,24 @@ def test_collect_list_set():
     stats = (df.group_by("k").agg(E.CollectList(col("v")).alias("cl"))
              .device_plan_stats())
     assert stats["cpu_nodes"], stats
+
+
+def test_skewness_kurtosis():
+    import math
+
+    def build(df):
+        return df.group_by("k").agg(
+            E.Skewness(col("f")).alias("sk"),
+            E.Kurtosis(col("f")).alias("ku")).sort("k")
+    dev, cpu = both(build)
+    for a, b in zip(dev, cpu):
+        for kk in ("sk", "ku"):
+            va, vb = a[kk], b[kk]
+            if va is None or vb is None:
+                assert va == vb
+            elif math.isnan(va) or math.isnan(vb):
+                assert math.isnan(va) and math.isnan(vb)
+            else:
+                # raw-power-sum (device) vs centered-sum (CPU): same math,
+                # different FP conditioning — tolerance per perf notes
+                assert abs(va - vb) <= 1e-6 * max(1.0, abs(va)), (kk, a, b)
