@@ -80,6 +80,12 @@ def main():
     if args.cpu_mesh:
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                    + " --xla_force_host_platform_device_count=8")
+        # isolated per-run compile cache: the shared persistent cache can
+        # serve CPU AOT kernels compiled under other host-feature flags and
+        # segfault hours into a run (docs/perf_notes_r03.md)
+        import tempfile
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                              tempfile.mkdtemp(prefix="srtpu_xla_run_"))
         import jax
         jax.config.update("jax_platforms", "cpu")
 
