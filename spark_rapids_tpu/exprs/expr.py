@@ -1173,6 +1173,133 @@ class SubstringIndex(_StringParams):
         self._params = (delim, count)
 
 
+class _CpuOnlyUnaryString(_Unary):
+    """String functions running on the CPU engine (plan-tagged fallback,
+    like the reference's pre-GPU-version operators)."""
+
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class Md5(_CpuOnlyUnaryString):
+    pass
+
+
+class Sha1(_CpuOnlyUnaryString):
+    pass
+
+
+class Sha2(Expression):
+    device_supported = False
+
+    def __init__(self, child: Expression, bits: int = 256):
+        self.children = (child,)
+        self.bits = bits
+        self._params = (bits,)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class Crc32(_Unary):
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+
+class Base64(_CpuOnlyUnaryString):
+    pass
+
+
+class UnBase64(_Unary):
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return T.BINARY
+
+
+class Hex(_CpuOnlyUnaryString):
+    pass
+
+
+class Unhex(_Unary):
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return T.BINARY
+
+
+class FormatNumber(Expression):
+    """format_number(x, d): thousands separators + d decimals."""
+
+    device_supported = False
+
+    def __init__(self, child: Expression, d: int):
+        self.children = (child,)
+        self.d = d
+        self._params = (d,)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class StringSpace(_Unary):
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class Levenshtein(_Binary):
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class FindInSet(Expression):
+    """find_in_set(str, comma-list-literal): 1-based index or 0."""
+
+    device_supported = False
+
+    def __init__(self, child: Expression, items: str):
+        self.children = (child,)
+        self.items = items
+        self._params = (items,)
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class Overlay(Expression):
+    """overlay(str PLACING replace FROM pos [FOR len])."""
+
+    device_supported = False
+
+    def __init__(self, child: Expression, replace: Expression, pos: int,
+                 length: int = -1):
+        self.children = (child, replace)
+        self.pos = pos
+        self.length = length
+        self._params = (pos, length)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
 class Ascii(Expression):
     def __init__(self, child: Expression):
         self.children = (child,)

@@ -497,6 +497,95 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         M = d.astype("datetime64[D]").astype("datetime64[M]")
         out = ((M + 1).astype("datetime64[D]") - 1).astype(np.int32)
         return out, m
+    if isinstance(expr, (E.Md5, E.Sha1)):
+        import hashlib
+        s_, m = ev(expr.child)
+        f = hashlib.md5 if isinstance(expr, E.Md5) else hashlib.sha1
+        return np.array([f(x.encode("utf-8")).hexdigest() for x in s_],
+                        dtype=object), m
+    if isinstance(expr, E.Sha2):
+        import hashlib
+        s_, m = ev(expr.children[0])
+        algo = {224: hashlib.sha224, 256: hashlib.sha256,
+                384: hashlib.sha384, 512: hashlib.sha512,
+                0: hashlib.sha256}[expr.bits]
+        return np.array([algo(x.encode("utf-8")).hexdigest() for x in s_],
+                        dtype=object), m
+    if isinstance(expr, E.Crc32):
+        import zlib
+        s_, m = ev(expr.child)
+        return np.array([zlib.crc32(x.encode("utf-8")) for x in s_],
+                        np.int64), m
+    if isinstance(expr, E.Base64):
+        import base64
+        s_, m = ev(expr.child)
+        return np.array([base64.b64encode(x.encode("utf-8")).decode()
+                         for x in s_], dtype=object), m
+    if isinstance(expr, E.UnBase64):
+        import base64
+        s_, m = ev(expr.child)
+        out, mm = [], m.copy()
+        for i, x in enumerate(s_):
+            try:
+                out.append(base64.b64decode(x))
+            except Exception:
+                out.append(b"")
+                mm[i] = False
+        return np.array(out, dtype=object), mm
+    if isinstance(expr, E.Hex):
+        d, m = ev(expr.child)
+        if expr.child.dtype in (T.STRING, T.BINARY):
+            vals = [(x.encode("utf-8") if isinstance(x, str) else x).hex()
+                    .upper() for x in d]
+        else:
+            # Spark hex(long): two's-complement uppercase, no leading zeros
+            vals = [format(int(x) & ((1 << 64) - 1), "X") for x in d]
+        return np.array(vals, dtype=object), m
+    if isinstance(expr, E.Unhex):
+        s_, m = ev(expr.child)
+        out, mm = [], m.copy()
+        for i, x in enumerate(s_):
+            try:
+                out.append(bytes.fromhex(("0" + x) if len(x) % 2 else x))
+            except ValueError:
+                out.append(b"")
+                mm[i] = False
+        return np.array(out, dtype=object), mm
+    if isinstance(expr, E.FormatNumber):
+        d, m = ev(expr.children[0])
+        return np.array([f"{float(x):,.{expr.d}f}" for x in
+                         d.astype(np.float64)], dtype=object), m
+    if isinstance(expr, E.StringSpace):
+        d, m = ev(expr.child)
+        return np.array([" " * max(int(x), 0) for x in d], dtype=object), m
+    if isinstance(expr, E.Levenshtein):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+
+        def lev(x, y):
+            prev = list(range(len(y) + 1))
+            for i, cx in enumerate(x, 1):
+                cur = [i]
+                for j, cy in enumerate(y, 1):
+                    cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                                   prev[j - 1] + (cx != cy)))
+                prev = cur
+            return prev[-1]
+        return np.array([lev(x, y) for x, y in zip(a, b)], np.int32), \
+            ma & mb
+    if isinstance(expr, E.FindInSet):
+        s_, m = ev(expr.children[0])
+        items = expr.items.split(",")
+        return np.array(
+            [0 if "," in x else (items.index(x) + 1 if x in items else 0)
+             for x in s_], np.int32), m
+    if isinstance(expr, E.Overlay):
+        (a, ma), (b, mb) = ev(expr.children[0]), ev(expr.children[1])
+        out = []
+        for x, y in zip(a, b):
+            p = max(expr.pos, 1) - 1
+            ln = len(y) if expr.length < 0 else expr.length
+            out.append(x[:p] + y + x[p + ln:])
+        return np.array(out, dtype=object), ma & mb
     if isinstance(expr, E.MonthsBetween):
         (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
 

@@ -244,3 +244,43 @@ def test_no_device_expr_without_cpu_oracle():
         if not re.search(r"\bE\." + base_handled + r"\b", src):
             missing.append(name)
     assert not missing, f"device exprs without CPU oracle: {missing}"
+
+
+def test_cpu_only_string_fns():
+    """hash/encode/string utilities run on the CPU engine and tag plans
+    off-device (pre-GPU-version operator analog)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.plan import from_arrow
+
+    t = pa.table({"s": pa.array(["abc", "", "hello world"]),
+                  "x": pa.array([1234567.891, -0.5, 0.0]),
+                  "n": pa.array([3, 0, 255], type=pa.int64())})
+    df = from_arrow(t, RapidsConf({}))
+    plan = df.select(
+        E.Md5(col("s")).alias("md5"),
+        E.Sha2(col("s"), 256).alias("sha"),
+        E.Crc32(col("s")).alias("crc"),
+        E.Base64(col("s")).alias("b64"),
+        E.Hex(col("n")).alias("hx"),
+        E.FormatNumber(col("x"), 2).alias("fn"),
+        E.StringSpace(col("n")).alias("sp"),
+        E.Levenshtein(col("s"), lit("abd")).alias("lv"),
+        E.FindInSet(col("s"), "x,abc,y").alias("fis"),
+        E.Overlay(col("s"), lit("ZZ"), 2).alias("ov"),
+    )
+    assert plan.device_plan_stats()["cpu_nodes"], "should tag to CPU"
+    r = plan.collect()
+    import hashlib
+    assert r[0]["md5"] == hashlib.md5(b"abc").hexdigest()
+    assert r[0]["sha"] == hashlib.sha256(b"abc").hexdigest()
+    import zlib as _z
+    assert r[0]["crc"] == _z.crc32(b"abc")
+    assert r[0]["b64"] == "YWJj"
+    assert r[0]["hx"] == "3" and r[2]["hx"] == "FF"
+    assert r[0]["fn"] == "1,234,567.89"
+    assert r[0]["sp"] == "   "
+    assert r[0]["lv"] == 1
+    assert r[0]["fis"] == 2 and r[1]["fis"] == 0
+    assert r[0]["ov"] == "aZZ"
