@@ -742,8 +742,19 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
                       l.validity & r.validity)
     if isinstance(expr, (E.Greatest, E.Least)):
         vals = [eval_expr(c, ctx) for c in expr.children]
-        np_t = T.numpy_dtype(expr.dtype)
+        out_t = expr.dtype
         is_max = not isinstance(expr, E.Least)
+
+        def conv(d, cd):
+            # Operands must be rescaled to the common type before comparing:
+            # raw unscaled int64 values of different scales are not ordered
+            # the same way as the decimals they represent.
+            if isinstance(out_t, T.DecimalType):
+                cs = cd.scale if isinstance(cd, T.DecimalType) else 0
+                return d.astype(jnp.int64) * (10 ** (out_t.scale - cs))
+            if isinstance(cd, T.DecimalType):
+                return d.astype(jnp.float64) / (10 ** cd.scale)
+            return d.astype(T.numpy_dtype(out_t))
 
         def ckey(d):
             # Spark total order: NaN sorts ABOVE every value
@@ -752,8 +763,8 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
             return d
 
         acc, av = None, None
-        for v in vals:
-            d = v.data.astype(np_t)
+        for v, c in zip(vals, expr.children):
+            d = conv(v.data, c.dtype)
             if acc is None:
                 acc, av = d, v.validity
                 continue
@@ -857,17 +868,35 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         l = eval_expr(expr.left, ctx)
         r = eval_expr(expr.right, ctx)
 
-        def ymd(v, dt):
-            days = (v.data // 86_400_000_000 if dt == T.TIMESTAMP
-                    else v.data).astype(jnp.int32)
-            return _civil_from_days(days)
-        y1, m1, d1 = ymd(l, expr.left.dtype)
-        y2, m2, d2 = ymd(r, expr.right.dtype)
+        def ymds(v, dt):
+            if dt == T.TIMESTAMP:
+                days = jnp.floor_divide(v.data, 86_400_000_000)
+                secs = ((v.data - days * 86_400_000_000).astype(jnp.float64)
+                        / 1e6)
+            else:
+                days = v.data
+                secs = jnp.zeros(v.data.shape, jnp.float64)
+            y, m, d = _civil_from_days(days.astype(jnp.int32))
+            return y, m, d, secs
+        y1, m1, d1, s1 = ymds(l, expr.left.dtype)
+        y2, m2, d2, s2 = ymds(r, expr.right.dtype)
         months = (y1 - y2) * 12 + (m1 - m2)
-        # Spark: same day-of-month (or both month ends) -> whole months,
-        # else add (d1 - d2)/31
-        frac = (d1 - d2).astype(jnp.float64) / 31.0
-        out = months.astype(jnp.float64) + jnp.where(d1 == d2, 0.0, frac)
+
+        def month_len(y, m):
+            ny = jnp.where(m == 12, y + 1, y)
+            nm = jnp.where(m == 12, 1, m + 1)
+            first_next = _days_from_civil(ny, nm, jnp.ones_like(ny))
+            return first_next - _days_from_civil(y, m, jnp.ones_like(y))
+
+        # Spark: same day-of-month OR both dates on their month's last day
+        # -> whole months, else add the seconds-precise day fraction over a
+        # 31-day month; result rounds HALF_UP to 8 decimals (roundOff=true)
+        both_ends = (d1 == month_len(y1, m1)) & (d2 == month_len(y2, m2))
+        sec_diff = ((d1 - d2).astype(jnp.float64) * 86400.0 + s1 - s2)
+        frac = sec_diff / (31.0 * 86400.0)
+        out = months.astype(jnp.float64) + jnp.where(
+            (d1 == d2) | both_ends, 0.0, frac)
+        out = jnp.sign(out) * jnp.floor(jnp.abs(out) * 1e8 + 0.5) / 1e8
         return ColVal(out, l.validity & r.validity)
     if isinstance(expr, E.TruncDate):
         c = eval_expr(expr.children[0], ctx)

@@ -676,8 +676,20 @@ class Greatest(Expression):
     @property
     def dtype(self):
         import functools
-        return functools.reduce(_numeric_widen,
-                                [c.dtype for c in self.children])
+
+        def widen(a, b):
+            # Spark's least-common-type for decimals keeps the max integral
+            # digits AND the max scale (not "first decimal wins"); integral
+            # operands join as implicit decimal(d, 0).
+            pair = _decimal_operands(a, b)
+            if pair is not None:
+                lt, rt = pair
+                s = max(lt.scale, rt.scale)
+                p = max(lt.precision - lt.scale, rt.precision - rt.scale) + s
+                return T.DecimalType(min(p, 38), s)
+            return _numeric_widen(a, b)
+
+        return functools.reduce(widen, [c.dtype for c in self.children])
 
 
 class Least(Greatest):

@@ -414,18 +414,31 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         return np.hypot(a.astype(np.float64),
                         b.astype(np.float64)), ma & mb
     if isinstance(expr, (E.Greatest, E.Least)):
-        np_t = T.numpy_dtype(expr.dtype)
+        out_t = expr.dtype
         is_max = not isinstance(expr, E.Least)
 
+        def conv(d, cd):
+            # Rescale to the common decimal type before comparing (raw
+            # unscaled values of different scales are not comparable).
+            if isinstance(out_t, T.DecimalType):
+                cs = cd.scale if isinstance(cd, T.DecimalType) else 0
+                f = 10 ** (out_t.scale - cs)
+                if out_t.precision > 18:
+                    return np.array([int(x) * f for x in d], dtype=object)
+                return d.astype(np.int64) * f
+            if isinstance(cd, T.DecimalType):
+                return d.astype(np.float64) / (10 ** cd.scale)
+            return d.astype(T.numpy_dtype(out_t))
+
         def ckey(d):
-            if d.dtype.kind == "f":
+            if getattr(d.dtype, "kind", None) == "f":
                 return np.where(np.isnan(d), np.inf, d)  # NaN sorts above
             return d
 
         acc = am = None
         for c in expr.children:
             d, mv = ev(c)
-            d = d.astype(np_t)
+            d = conv(d, c.dtype)
             if acc is None:
                 acc, am = d, mv
                 continue
@@ -589,19 +602,37 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
     if isinstance(expr, E.MonthsBetween):
         (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
 
-        def ymd(v, dt):
-            days = (v // 86_400_000_000 if dt == T.TIMESTAMP else v)
+        def ymds(v, dt):
+            if dt == T.TIMESTAMP:
+                days = np.floor_divide(v, 86_400_000_000)
+                secs = (v - days * 86_400_000_000).astype(np.float64) / 1e6
+            else:
+                days = v
+                secs = np.zeros(v.shape, np.float64)
             M = days.astype("datetime64[D]").astype("datetime64[M]")
             y = M.astype("datetime64[Y]").astype(int) + 1970
             m = M.astype(int) % 12 + 1
             d = (days.astype("datetime64[D]") - M).astype(int) + 1
-            return y, m, d
-        y1, m1, d1 = ymd(a, expr.left.dtype)
-        y2, m2, d2 = ymd(b, expr.right.dtype)
+            return y, m, d, secs
+        y1, m1, d1, s1 = ymds(a, expr.left.dtype)
+        y2, m2, d2, s2 = ymds(b, expr.right.dtype)
         months = (y1 - y2) * 12 + (m1 - m2)
-        frac = (d1 - d2).astype(np.float64) / 31.0
-        return months.astype(np.float64) + np.where(d1 == d2, 0.0, frac), \
-            ma & mb
+
+        def month_len(y, m):
+            ym = ((y - 1970) * 12 + m - 1).astype("datetime64[M]")
+            return ((ym + 1).astype("datetime64[D]")
+                    - ym.astype("datetime64[D]")).astype(int)
+
+        # Spark: whole months when same day-of-month OR both month ends;
+        # otherwise seconds-precise fraction over a 31-day month, rounded
+        # HALF_UP to 8 decimals (roundOff=true default)
+        both_ends = (d1 == month_len(y1, m1)) & (d2 == month_len(y2, m2))
+        frac = ((d1 - d2).astype(np.float64) * 86400.0 + s1 - s2) \
+            / (31.0 * 86400.0)
+        out = months.astype(np.float64) + np.where(
+            (d1 == d2) | both_ends, 0.0, frac)
+        out = np.sign(out) * np.floor(np.abs(out) * 1e8 + 0.5) / 1e8
+        return out, ma & mb
     if isinstance(expr, E.TruncDate):
         d, m = ev(expr.children[0])
         days = d.astype("datetime64[D]")

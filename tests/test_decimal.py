@@ -323,3 +323,36 @@ def test_skewness_kurtosis():
                 # raw-power-sum (device) vs centered-sum (CPU): same math,
                 # different FP conditioning — tolerance per perf notes
                 assert abs(va - vb) <= 1e-6 * max(1.0, abs(va)), (kk, a, b)
+
+
+def test_greatest_least_mixed_scale():
+    # ADVICE r3 (medium): operands must be rescaled to the common decimal
+    # type before comparing; greatest(decimal(10,2) 1.50, decimal(10,0) 2)
+    # is 2.00, not 1.50.
+    t = pa.table({
+        "a": pa.array([D("1.50"), D("3.25"), None], type=pa.decimal128(10, 2)),
+        "b": pa.array([D("2"), D("3"), D("7")], type=pa.decimal128(10, 0)),
+        "i": pa.array([2, 1, None], type=pa.int32()),
+    })
+
+    def both_t(build):
+        out = []
+        for enabled in (True, False):
+            conf = RapidsConf({"spark.rapids.tpu.sql.enabled": enabled})
+            df = from_arrow(t, conf)
+            out.append(build(df).collect())
+        return out
+
+    dev, cpu = both_t(lambda df: df.select(
+        E.Greatest(col("a"), col("b")).alias("g"),
+        E.Least(col("a"), col("b")).alias("l"),
+        E.Greatest(col("a"), col("i")).alias("gi"),
+    ))
+    assert dev == cpu, f"{dev}\n{cpu}"
+    assert dev[0]["g"] == D("2.00")
+    assert dev[0]["l"] == D("1.50")
+    assert dev[1]["g"] == D("3.25")
+    assert dev[1]["l"] == D("3.00")
+    assert dev[2]["g"] == D("7.00") and dev[2]["l"] == D("7.00")
+    assert dev[0]["gi"] == D("2.00")
+    assert dev[2]["gi"] is None

@@ -350,3 +350,41 @@ def test_string_equality():
     ])
     assert eq == [True, False, False, None, None]
     assert nse == [True, False, False, False, True]
+
+
+def test_months_between_month_ends():
+    # Spark returns whole months when BOTH dates are their month's last day
+    # (ADVICE r3): months_between('2016-03-31','2016-02-29') == 1.0.
+    # Non-whole results round HALF_UP to 8 decimals (roundOff=true).
+    d1 = [datetime.date(2016, 3, 31), datetime.date(2016, 3, 31),
+          datetime.date(2024, 2, 29), datetime.date(2016, 3, 30)]
+    d2 = [datetime.date(2016, 2, 29), datetime.date(2016, 2, 28),
+          datetime.date(2023, 1, 31), datetime.date(2016, 2, 29)]
+    t = pa.table({"a": pa.array(d1, type=pa.date32()),
+                  "b": pa.array(d2, type=pa.date32())})
+    (mb,) = pylist(t, [E.MonthsBetween(col("a"), col("b"))])
+    assert mb[0] == 1.0            # both month ends
+    assert mb[1] == 1.09677419     # 28th is not Feb end in 2016
+    assert mb[2] == 13.0           # both month ends, leap Feb
+    assert mb[3] == 1.03225806     # 30th is not Mar end
+
+
+def test_months_between_timestamps():
+    # Timestamps contribute their time-of-day to the fraction:
+    # months_between(ts'2016-03-15 12:00', ts'2016-02-14 00:00')
+    #   = 1 + (1*86400 + 43200)/(31*86400) = 1.04838710 (8-dec HALF_UP)
+    us = 1_000_000
+    t1 = [(datetime.datetime(2016, 3, 15, 12) - datetime.datetime(1970, 1, 1))
+          .total_seconds() * us,
+          (datetime.datetime(2016, 3, 14) - datetime.datetime(1970, 1, 1))
+          .total_seconds() * us]
+    t2 = [(datetime.datetime(2016, 2, 14) - datetime.datetime(1970, 1, 1))
+          .total_seconds() * us,
+          (datetime.datetime(2016, 2, 14, 18) - datetime.datetime(1970, 1, 1))
+          .total_seconds() * us]
+    t = pa.table({"a": pa.array([int(x) for x in t1], pa.timestamp("us")),
+                  "b": pa.array([int(x) for x in t2], pa.timestamp("us"))})
+    (mb,) = pylist(t, [E.MonthsBetween(col("a"), col("b"))])
+    assert mb[0] == 1.04838710
+    # 14th == 14th -> whole months even though times differ (Spark rule)
+    assert mb[1] == 1.0
