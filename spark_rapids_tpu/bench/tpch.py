@@ -30,7 +30,7 @@ from spark_rapids_tpu.exec import (
 )
 from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.exprs.expr import (
-    And, Average, Count, GreaterThanOrEqual, LessThan, Literal, Multiply,
+    Add, And, Average, Count, GreaterThanOrEqual, LessThan, Literal, Multiply,
     Subtract, Sum, col, lit,
 )
 
@@ -258,6 +258,105 @@ def tables_for(sf: float, seed: int = 0) -> Dict[str, pa.Table]:
         "nation": gen_nation(seed + 4),
         "region": gen_region(),
     }
+
+
+# ---------------------------------------------------------------------------
+# DataFrame-front-end builders (full plan-rewrite path: tagging, shuffle
+# insertion, CBO broadcast choice). Used by the distributed-execution
+# certification (tests/test_distributed.py, __graft_entry__.dryrun_multichip)
+# so the mesh runs PLANNER-generated plans, not the hand-built exec trees
+# above.
+# ---------------------------------------------------------------------------
+
+
+def df_tables(tables: Dict[str, pa.Table], conf=None,
+              shuffle_partitions: int = 4, partitions: int = 1,
+              batch_rows: int = 1 << 20) -> Dict[str, "object"]:
+    from spark_rapids_tpu.plan import from_arrow
+
+    out = {}
+    for k, v in tables.items():
+        df = from_arrow(v, conf, batch_rows=batch_rows, partitions=partitions)
+        df.shuffle_partitions = shuffle_partitions
+        out[k] = df
+    return out
+
+
+def df_q1(d) -> "object":
+    from spark_rapids_tpu.exprs.expr import Average, Count
+
+    li = d["lineitem"].filter(
+        LessThan(col("l_shipdate"), lit(_date_i(1998, 9, 3), T.DATE)))
+    disc_price = Multiply(col("l_extendedprice"),
+                          Subtract(lit(1.0), col("l_discount")))
+    charge = Multiply(disc_price, Add(lit(1.0), col("l_tax")))
+    return (li.group_by("l_returnflag", "l_linestatus")
+            .agg(Sum(col("l_quantity")).alias("sum_qty"),
+                 Sum(col("l_extendedprice")).alias("sum_base_price"),
+                 Sum(disc_price).alias("sum_disc_price"),
+                 Sum(charge).alias("sum_charge"),
+                 Average(col("l_quantity")).alias("avg_qty"),
+                 Average(col("l_extendedprice")).alias("avg_price"),
+                 Average(col("l_discount")).alias("avg_disc"),
+                 Count().alias("count_order"))
+            .sort("l_returnflag", "l_linestatus"))
+
+
+def df_q3(d) -> "object":
+    cust = d["customer"].filter(col("c_mktsegment").eq("BUILDING"))
+    ords = d["orders"].filter(
+        LessThan(col("o_orderdate"), lit(_date_i(1995, 3, 15), T.DATE)))
+    line = d["lineitem"].filter(
+        GreaterThanOrEqual(col("l_shipdate"), lit(_date_i(1995, 3, 16),
+                                                  T.DATE)))
+    oc = ords.join(cust, left_on="o_custkey", right_on="c_custkey")
+    # fact side probes: lineitem LEFT so the (unique-keyed) oc result is the
+    # broadcast build side — the dense direct-address join path
+    j = line.join(oc, left_on="l_orderkey", right_on="o_orderkey")
+    return (j.group_by("l_orderkey", "o_orderdate", "o_shippriority")
+            .agg(Sum(Multiply(col("l_extendedprice"),
+                              Subtract(lit(1.0), col("l_discount"))))
+                 .alias("revenue"))
+            .sort(SortOrder(col("revenue"), ascending=False),
+                  SortOrder(col("o_orderdate")), limit=10))
+
+
+def df_q5(d) -> "object":
+    reg = d["region"].filter(col("r_name").eq("ASIA"))
+    nat = d["nation"].join(reg, left_on="n_regionkey", right_on="r_regionkey")
+    sup = d["supplier"].join(nat, left_on="s_nationkey",
+                             right_on="n_nationkey")
+    ords = d["orders"].filter(
+        And(GreaterThanOrEqual(col("o_orderdate"),
+                               lit(_date_i(1994, 1, 1), T.DATE)),
+            LessThan(col("o_orderdate"), lit(_date_i(1995, 1, 1), T.DATE))))
+    co = ords.join(d["customer"], left_on="o_custkey", right_on="c_custkey")
+    lco = d["lineitem"].join(co, left_on="l_orderkey", right_on="o_orderkey")
+    ls = lco.join(sup, left_on=["l_suppkey", "c_nationkey"],
+                  right_on=["s_suppkey", "s_nationkey"])
+    return (ls.group_by("n_name")
+            .agg(Sum(Multiply(col("l_extendedprice"),
+                              Subtract(lit(1.0), col("l_discount"))))
+                 .alias("revenue"))
+            .sort(SortOrder(col("revenue"), ascending=False)))
+
+
+def df_q6(d) -> "object":
+    li = d["lineitem"].filter(And(
+        And(
+            And(GreaterThanOrEqual(col("l_shipdate"),
+                                   lit(_date_i(1994, 1, 1), T.DATE)),
+                LessThan(col("l_shipdate"), lit(_date_i(1995, 1, 1),
+                                                T.DATE))),
+            And(GreaterThanOrEqual(col("l_discount"), lit(0.05 - 1e-9)),
+                LessThan(col("l_discount"), lit(0.07 + 1e-9))),
+        ),
+        LessThan(col("l_quantity"), lit(24.0))))
+    return li.agg(Sum(Multiply(col("l_extendedprice"), col("l_discount")))
+                  .alias("revenue"))
+
+
+DF_QUERIES = {"q1": df_q1, "q3": df_q3, "q5": df_q5, "q6": df_q6}
 
 
 def build_query(name: str, tables: Dict[str, pa.Table],

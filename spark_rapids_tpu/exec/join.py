@@ -118,8 +118,15 @@ class HashJoinExec(BinaryExec):
                 from spark_rapids_tpu.columnar.batch import empty_batch
                 build = empty_batch(self.right.output_schema.types(), 16)
             dense = self._prepare_dense(build)
-            jh = None if dense is not None else _prepare_build(
-                build, tuple(self._rkeys))
+            table = jh = None
+            if dense is None:
+                prep = self._prepare_table(build)
+                if isinstance(prep, K.JoinHashes):
+                    jh = prep  # duplicate keys: general path, sort reused
+                elif prep is not None:
+                    table = prep
+                else:
+                    jh = _prepare_build(build, tuple(self._rkeys))
         build_matched = jnp.zeros(build.capacity, jnp.bool_)
 
         for probe in self.left.execute(partition):
@@ -127,6 +134,9 @@ class HashJoinExec(BinaryExec):
                 if dense is not None:
                     out, build_matched = self._join_batch_dense(
                         probe, build, dense, build_matched, partition)
+                elif table is not None:
+                    out, build_matched = self._join_batch_unique(
+                        probe, build, table, build_matched, partition)
                 else:
                     out, build_matched = self._join_batch(probe, build, jh,
                                                           build_matched)
@@ -137,6 +147,83 @@ class HashJoinExec(BinaryExec):
             out = self._unmatched_build(build, build_matched)
             if out is not None:
                 yield out
+
+    # -- bucketed unique-key table path ------------------------------------
+    # Round-4 general-join rebuild (VERDICT r3 item 3): when the build keys
+    # are UNIQUE — dimension tables, distinct subqueries — but the dense
+    # direct-address path can't apply (string/multi/wide-domain keys), the
+    # bucketed table (kernels.build_join_table) gives a fully traced probe
+    # with STATIC output shapes: out_cap = probe capacity, no per-batch
+    # candidate-count host sync, one compile per probe bucket. The ONLY
+    # sync is the (dup_any, max_bucket) pair read once per build side.
+    MAX_UNIQUE_SLOTS = 16  # bucket-scan width cap (2x-load tables stay tiny)
+
+    def _prepare_table(self, build: ColumnarBatch):
+        """Build the bucketed table; returns (tbl, slots) for the unique
+        probe, or a ``JoinHashes`` view of the SAME sorted layout when keys
+        are duplicated (the general path reuses the sort — the speculative
+        build is never thrown away)."""
+        if build.capacity > (1 << 27):
+            return None  # table sort beyond the slot budget: general path
+        tbl, dup_any, max_bucket = K.build_join_table(
+            build, tuple(self._rkeys))
+        dup, mb = jax.device_get((dup_any, max_bucket))
+        slots = 1
+        while slots < max(int(mb), 1):
+            slots *= 2
+        if bool(dup) or slots > self.MAX_UNIQUE_SLOTS:
+            # the (h1,h2)-sorted layout IS a valid JoinHashes (sorted by
+            # hash, invalid rows pushed to the end)
+            return K.JoinHashes(tbl.h1s, tbl.order, tbl.valid)
+        # lg_b comes back as a device scalar from the jitted build; the probe
+        # needs it static — it is a pure function of the build capacity
+        tbl = tbl._replace(lg_b=K._join_lg_b(build.capacity))
+        return tbl, slots
+
+    def _join_batch_unique(self, probe: ColumnarBatch, build: ColumnarBatch,
+                           table, build_matched, partition: int):
+        tbl, slots = table
+        jt = self.join_type
+        out_cap = probe.capacity
+        pcaps = {i: c.byte_capacity
+                 for i, c in enumerate(probe.columns) if c.offsets is not None}
+        cache = getattr(self, "_dense_bcache", None)
+        if cache is None:
+            cache = self._dense_bcache = {}
+        ckey = ("tbl", partition, out_cap)
+        if ckey not in cache:
+            caps = {}
+            for i, c in enumerate(build.columns):
+                if c.offsets is not None:
+                    ml = int(jax.device_get(
+                        jnp.max(c.offsets[1:] - c.offsets[:-1])))
+                    caps[i] = bucket_capacity(max(out_cap * max(ml, 1), 8), 8)
+            cache[ckey] = caps
+        self._pcaps, self._bcaps = pcaps, cache[ckey]
+        bi, hit, new_matched = _unique_probe(
+            probe, build, tbl, build_matched, tuple(self._lkeys),
+            tuple(self._rkeys), slots, tbl.lg_b, self._cond_bound, jt,
+            tuple(sorted(cache[ckey].items())))
+        if jt == "left_semi":
+            idx, n = K.filter_indices(hit, probe.active_mask())
+            return K.gather_batch(probe, idx, n), new_matched
+        if jt == "left_anti":
+            want = ~hit & probe.active_mask()
+            idx, n = K.filter_indices(want, probe.active_mask())
+            return K.gather_batch(probe, idx, n), new_matched
+        if jt in ("left", "full"):
+            pi = jnp.arange(out_cap, dtype=jnp.int32)
+            out = self._gather_pairs(probe, build, pi,
+                                     jnp.where(hit, bi, 0), hit,
+                                     probe.num_rows, out_cap)
+            return out, new_matched
+        # inner: compact hit rows
+        idx, n = K.filter_indices(hit, probe.active_mask())
+        bi_c = jnp.where(idx < out_cap, bi[jnp.clip(idx, 0, out_cap - 1)], 0)
+        out = self._gather_pairs(probe, build, idx, jnp.clip(bi_c, 0, None),
+                                 jnp.arange(out_cap, dtype=jnp.int32) < n,
+                                 n, out_cap)
+        return out, new_matched
 
     # -- dense surrogate-key fast path -------------------------------------
     # TPC-style schemas join facts to dimensions on DENSE INT SURROGATE KEYS
@@ -425,6 +512,29 @@ def _probe_stats(probe, build, jh, lkeys, pstr, bstr):
         hi = lo + cnt
         bbytes.append(jnp.sum(pre[hi] - pre[lo]))
     return lo, cnt, total, pbytes, bbytes
+
+
+@partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _unique_probe(probe, build, tbl, build_matched, lkeys, rkeys, slots,
+                  lg_b, cond_bound, jt, bcap_items):
+    """Unique-build probe: <=1 match per probe row, static shapes, no host
+    sync (kernels.probe_join_table_unique + fused residual condition)."""
+    bi, hit = K.probe_join_table_unique(probe, tbl, lkeys, build, rkeys,
+                                        slots, lg_b)
+    hit = hit & probe.active_mask()
+    if cond_bound is not None:
+        bcaps = dict(bcap_items)
+        bcols = [K.gather_column(c, jnp.where(hit, bi, 0), hit, bcaps.get(i))
+                 for i, c in enumerate(build.columns)]
+        pair = ColumnarBatch(list(probe.columns) + bcols, probe.num_rows)
+        cres = EV.eval_expr(cond_bound, EV.EvalContext(pair))
+        hit = hit & cres.data & cres.validity
+    if jt in ("right", "full"):
+        new_matched = build_matched.at[
+            jnp.where(hit, bi, build.capacity)].set(True, mode="drop")
+    else:
+        new_matched = build_matched
+    return bi, hit, new_matched
 
 
 @partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))

@@ -871,8 +871,10 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         def ymds(v, dt):
             if dt == T.TIMESTAMP:
                 days = jnp.floor_divide(v.data, 86_400_000_000)
-                secs = ((v.data - days * 86_400_000_000).astype(jnp.float64)
-                        / 1e6)
+                # Spark truncates to whole seconds (MICROSECONDS.toSeconds)
+                secs = jnp.floor_divide(
+                    v.data - days * 86_400_000_000,
+                    1_000_000).astype(jnp.float64)
             else:
                 days = v.data
                 secs = jnp.zeros(v.data.shape, jnp.float64)

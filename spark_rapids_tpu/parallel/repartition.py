@@ -1,0 +1,154 @@
+"""Windowed ICI row repartition — the in-program shuffle primitive.
+
+The general building block behind distributed exchanges: inside one
+``shard_map`` program, move every live local row to its destination device
+with ``jax.lax.all_to_all``, streaming count-prefixed windows of W rows per
+peer so receive buffering stays bounded (the SPMD analog of the reference's
+bounce-buffer windowing: BufferSendState / WindowedBlockIterator in
+shuffle/RapidsShuffleServer.scala).
+
+Used by parallel/executor.py to lower planner-produced
+``ShuffleExchangeExec`` nodes onto the mesh: the partitioner's row->partition
+ids become row->device ids, and an optional ``merge_fn`` (e.g. a hash
+aggregate's merge pass) compacts the receive state after every window so an
+exchange feeding a final aggregation never materializes more than
+``out_cap`` rows per device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec import kernels as K
+
+
+def route_by_dest(dest: jax.Array, num_rows, local_cap: int, n_dev: int):
+    """Per-destination compaction maps: row indices + counts per device."""
+    live = jnp.arange(local_cap, dtype=jnp.int32) < num_rows
+    idx_rows, counts = [], []
+    for t in range(n_dev):
+        idx_t, cnt_t = K.filter_indices(dest == t, live)
+        idx_rows.append(idx_t)
+        counts.append(cnt_t)
+    return jnp.stack(idx_rows), jnp.stack(counts)
+
+
+def _zero_state(part: ColumnarBatch, out_cap: int) -> ColumnarBatch:
+    cols = []
+    for c in part.columns:
+        assert c.offsets is None, (
+            "plain string columns cannot ride the ICI exchange; dict-encode "
+            "at the source (codes shard, dictionary replicates)")
+        cols.append(DeviceColumn(
+            c.dtype, jnp.zeros(out_cap, c.data.dtype),
+            jnp.zeros(out_cap, jnp.bool_), None, c.dictionary, c.dict_size,
+            c.dict_max_len,
+            jnp.zeros(out_cap, c.data2.dtype) if c.data2 is not None
+            else None))
+    return ColumnarBatch(cols, jnp.int32(0))
+
+
+def windowed_repartition(
+    part: ColumnarBatch,
+    dest: jax.Array,
+    axis: str,
+    n_dev: int,
+    out_cap: int,
+    window: int = 0,
+    merge_fn: Optional[Callable[[ColumnarBatch], ColumnarBatch]] = None,
+) -> Tuple[ColumnarBatch, jax.Array]:
+    """Move each live local row to device ``dest[row]`` (must run inside a
+    shard_map over ``axis``). Returns (repartitioned local batch with
+    capacity ``out_cap``, overflow flag).
+
+    Rows stream in ``rounds`` windows of W rows per destination; each
+    received window is appended to the state and, when ``merge_fn`` is
+    given, the state is immediately compacted (e.g. merged by group keys)
+    so its live row count stays small. Without a merge_fn the state is a
+    plain append buffer and ``out_cap`` must cover the worst-case receive
+    (callers use 2x local capacity + overflow detection, the same bound the
+    windowed agg exchange uses).
+    """
+    local_cap = part.capacity
+    W = window or max(2 * local_cap // n_dev, 8)
+    rounds = -(-local_cap // W)
+    ncols = len(part.columns)
+
+    idx, cnt = route_by_dest(dest, part.num_rows, local_cap, n_dev)
+    idx_pad = (jnp.pad(idx, ((0, 0), (0, rounds * W - idx.shape[1])))
+               if idx.shape[1] < rounds * W else idx)
+
+    init = _zero_state(part, out_cap)
+    if merge_fn is not None:
+        # dry merge establishes post-merge dtypes for a stable carry
+        init = merge_fn(init)
+        init = ColumnarBatch(init.columns, jnp.int32(0))
+    assert len(init.columns) == ncols, "merge_fn must preserve column count"
+    has2 = tuple(c.data2 is not None for c in init.columns)
+    assert has2 == tuple(c.data2 is not None for c in part.columns), (
+        "merge_fn must preserve wide-decimal limb layout")
+
+    def round_body(r, carry):
+        state_d, state_v, state_d2, state_n, ovf = carry
+        sl = jax.lax.dynamic_slice_in_dim(idx_pad, r * W, W, axis=1)
+        cnt_r = jnp.clip(cnt - r * W, 0, W)
+        slot_live = jnp.arange(W, dtype=jnp.int32)[None, :] < cnt_r[:, None]
+        recv_cnt = jax.lax.all_to_all(cnt_r, axis, 0, 0, tiled=True)
+        flat_live = (jnp.arange(W, dtype=jnp.int32)[None, :]
+                     < recv_cnt[:, None]).reshape(-1)
+        crank = jnp.cumsum(flat_live.astype(jnp.int32)) - 1
+        n_recv = jnp.sum(recv_cnt).astype(jnp.int32)
+        dst = jnp.where(flat_live, state_n + crank, out_cap)
+        ovf = ovf | (state_n + n_recv > out_cap)
+        new_d, new_v, new_d2 = [], [], []
+        for ci in range(ncols):
+            c = part.columns[ci]
+            send = jnp.where(slot_live, c.data[sl],
+                             jnp.zeros_like(c.data)[:1])
+            send_v = jnp.where(slot_live, c.validity[sl], False)
+            recv = jax.lax.all_to_all(send, axis, 0, 0).reshape(-1)
+            recv_v = jax.lax.all_to_all(send_v, axis, 0, 0).reshape(-1)
+            new_d.append(state_d[ci].at[dst].set(
+                recv.astype(state_d[ci].dtype), mode="drop"))
+            new_v.append(state_v[ci].at[dst].set(recv_v, mode="drop"))
+            if c.data2 is not None:
+                send2 = jnp.where(slot_live, c.data2[sl],
+                                  jnp.zeros_like(c.data2)[:1])
+                recv2 = jax.lax.all_to_all(send2, axis, 0, 0).reshape(-1)
+                new_d2.append(state_d2[ci].at[dst].set(recv2, mode="drop"))
+            else:
+                new_d2.append(state_d2[ci])
+        state_n = jnp.minimum(state_n + n_recv, out_cap)
+        if merge_fn is None:
+            return tuple(new_d), tuple(new_v), tuple(new_d2), state_n, ovf
+        sbatch = ColumnarBatch(
+            [DeviceColumn(c.dtype, d, v, None, c.dictionary, c.dict_size,
+                          c.dict_max_len, d2 if h2 else None)
+             for c, h2, d, v, d2 in zip(init.columns, has2, new_d,
+                                        new_v, new_d2)], state_n)
+        merged = merge_fn(sbatch)
+        return (tuple(c.data for c in merged.columns),
+                tuple(c.validity for c in merged.columns),
+                tuple(c.data2 if c.data2 is not None else z
+                      for c, z in zip(merged.columns, new_d2)),
+                merged.num_rows.astype(jnp.int32), ovf)
+
+    zero2 = tuple(c.data2 if c.data2 is not None
+                  else jnp.zeros((), jnp.int64) for c in init.columns)
+    state_d, state_v, state_d2, state_n, ovf = jax.lax.fori_loop(
+        0, rounds, round_body,
+        (tuple(c.data for c in init.columns),
+         tuple(c.validity for c in init.columns),
+         zero2, jnp.int32(0), jnp.bool_(False)))
+    cols = []
+    for i, c in enumerate(init.columns):
+        cols.append(DeviceColumn(
+            c.dtype, state_d[i], state_v[i], None,
+            c.dictionary, c.dict_size, c.dict_max_len,
+            state_d2[i] if has2[i] else None))
+    return ColumnarBatch(cols, state_n), ovf

@@ -605,7 +605,9 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         def ymds(v, dt):
             if dt == T.TIMESTAMP:
                 days = np.floor_divide(v, 86_400_000_000)
-                secs = (v - days * 86_400_000_000).astype(np.float64) / 1e6
+                # Spark truncates to whole seconds (MICROSECONDS.toSeconds)
+                secs = np.floor_divide(
+                    v - days * 86_400_000_000, 1_000_000).astype(np.float64)
             else:
                 days = v
                 secs = np.zeros(v.shape, np.float64)

@@ -209,5 +209,5 @@ def read_parquet(paths, columns=None, predicate=None,
 
 
 def from_arrow(table: pa.Table, conf: Optional[C.RapidsConf] = None,
-               batch_rows: int = 1 << 20) -> DataFrame:
-    return DataFrame(L.InMemoryScan(table, batch_rows), conf)
+               batch_rows: int = 1 << 20, partitions: int = 1) -> DataFrame:
+    return DataFrame(L.InMemoryScan(table, batch_rows, partitions), conf)

@@ -57,6 +57,7 @@ class ParquetScan(LogicalPlan):
 class InMemoryScan(LogicalPlan):
     table: pa.Table
     batch_rows: int = 1 << 20
+    partitions: int = 1  # source splits (Spark: one task per input split)
 
     @property
     def schema(self) -> T.Schema:

@@ -463,7 +463,9 @@ class Overrides:
             batches = [batch_from_arrow(t.slice(i, node.batch_rows),
                                         dict_cache=cache)
                        for i in range(0, max(t.num_rows, 1), node.batch_rows)]
-            return BatchSourceExec([batches], node.schema)
+            n_parts = max(1, min(node.partitions, len(batches)))
+            parts = [batches[p::n_parts] for p in range(n_parts)]
+            return BatchSourceExec(parts, node.schema)
         if isinstance(node, L.Project):
             return (ProjectExec(node.exprs, kids[0]) if on_dev
                     else CpuProjectExec(node.exprs, kids[0]))

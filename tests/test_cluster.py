@@ -1,0 +1,106 @@
+"""Multi-process TCP-shuffle execution (shuffle/cluster.py).
+
+VERDICT r3 item 1(a): a planned TPC-H query must run end-to-end across
+executor PROCESSES with the reduce side fetching map outputs over the TCP
+transport — not the in-process shuffle manager. Differential-checked
+against the single-process engine.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import conftest
+
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs.expr import col, lit
+from spark_rapids_tpu.plan import from_arrow
+from spark_rapids_tpu.shuffle.cluster import TcpShuffleCluster
+
+pytestmark = pytest.mark.skipif(
+    conftest.TPU_LANE, reason="multi-process workers run the host platform")
+
+
+def _conf():
+    return RapidsConf({"spark.rapids.tpu.sql.enabled": True})
+
+
+def _rows(table: pa.Table):
+    cols = [c.to_pylist() for c in table.columns]
+    return [tuple(r) for r in zip(*cols)] if cols else []
+
+
+def _canon(rows):
+    return sorted(
+        [tuple(round(v, 6) if isinstance(v, float) else v for v in r)
+         for r in rows], key=repr)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with TcpShuffleCluster(n_workers=2) as c:
+        yield c
+
+
+def test_cluster_groupby(cluster, rng):
+    n = 4000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 23, n), pa.int64()),
+        "v": pa.array(rng.uniform(0, 10, n)),
+        "q": pa.array(rng.integers(1, 9, n).astype(np.int64), pa.int64()),
+    })
+    df = (from_arrow(t, _conf(), batch_rows=512, partitions=4)
+          .filter(E.GreaterThan(col("v"), lit(2.0)))
+          .group_by("k")
+          .agg(E.Sum(col("q")).alias("sq"), E.Count().alias("c"),
+               E.Average(col("v")).alias("av")))
+    df.shuffle_partitions = 4
+    local = [tuple(r.values()) for r in df.collect()]
+    out = cluster.run_query(df)
+    assert _canon(_rows(out)) == _canon(local)
+
+
+def test_cluster_tpch_q1(cluster):
+    from spark_rapids_tpu.bench import tpch
+
+    tables = tpch.tables_for(0.002)
+    d = tpch.df_tables(tables, _conf(), shuffle_partitions=3, partitions=4,
+                       batch_rows=2048)
+    df = tpch.DF_QUERIES["q1"](d)
+    local = [tuple(r.values()) for r in df.collect()]
+    out = cluster.run_query(df)
+    # q1 ends in an order-by: compare ordered
+    got = [tuple(round(v, 6) if isinstance(v, float) else v for v in r)
+           for r in _rows(out)]
+    want = [tuple(round(v, 6) if isinstance(v, float) else v for v in r)
+            for r in local]
+    assert got == want
+
+
+def test_cluster_tpcds_q42(cluster):
+    from spark_rapids_tpu.bench import tpcds_queries as Q
+    from spark_rapids_tpu.bench.tpcds_schema import tables_for
+
+    tables = tables_for(0.01)
+    d = {}
+    for k, v in tables.items():
+        df = from_arrow(v, _conf(), batch_rows=4096, partitions=2)
+        df.shuffle_partitions = 3
+        d[k] = df
+    q = Q.QUERIES["q42"](d)
+    local = [tuple(r.values()) for r in q.collect()]
+    out = cluster.run_query(q)
+    got = [tuple(round(v, 6) if isinstance(v, float) else v for v in r)
+           for r in _rows(out)]
+    want = [tuple(round(v, 6) if isinstance(v, float) else v for v in r)
+            for r in local]
+    assert got == want
+
+
+def test_cluster_heartbeat_discovery(cluster):
+    # both workers registered through the driver-mediated heartbeat manager
+    peers = cluster.heartbeats.peers()
+    assert len(peers) == 2
+    cluster.heartbeat_round()  # sweep keeps live peers
+    assert len(cluster.heartbeats.peers()) == 2

@@ -258,3 +258,107 @@ def test_join_explosion_guard():
     dd = from_arrow(right, conf)
     with _pt.raises(RuntimeError, match="join candidate explosion"):
         df.join(dd, left_on="k", right_on="k2").collect()
+
+
+# ---------------------------------------------------------------------------
+# bucketed unique-key table path (round-4 general-join rebuild)
+# ---------------------------------------------------------------------------
+
+
+def _pd_join(lt, rt, lk, rk, how):
+    ldf, rdf = lt.to_pandas(), rt.to_pandas()
+    return ldf.merge(rdf, left_on=lk, right_on=rk, how=how)
+
+
+def _table_join_case(n_probe=3000, n_build=500, seed=11):
+    rng = np.random.default_rng(seed)
+    # string + int composite key, unique on the build side, with probe
+    # misses — dense path ineligible (string key), bucketed table applies
+    bk_s = np.array([f"key_{i:04d}" for i in range(n_build)])
+    bk_i = (np.arange(n_build) * 7919) % 100_000  # unique, sparse domain
+    build = pa.table({
+        "bs": pa.array(bk_s),
+        "bi": pa.array(bk_i, pa.int64()),
+        "battr": pa.array(rng.uniform(0, 1, n_build)),
+    })
+    pick = rng.integers(0, n_build + 200, n_probe)  # some miss
+    ps = np.where(pick < n_build,
+                  np.array([f"key_{i:04d}" for i in
+                            np.clip(pick, 0, n_build - 1)]), "nokey")
+    pi = np.where(pick < n_build, bk_i[np.clip(pick, 0, n_build - 1)], -1)
+    probe = pa.table({
+        "ps": pa.array(ps),
+        "pi": pa.array(pi, pa.int64()),
+        "pv": pa.array(np.arange(n_probe), pa.int64()),
+    })
+    return probe, build
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_unique_table_join_string_int_keys(how):
+    probe, build = _table_join_case()
+    j = HashJoinExec([col("ps"), col("pi")], [col("bs"), col("bi")], how,
+                     source(probe, batch_rows=1024), source(build))
+    j._prepare()
+    # the bucketed unique path must engage (string key -> dense ineligible)
+    assert j._prepare_table(batch_from_arrow(build, 16)) is not None, \
+        "unique table path not taken"
+    got = rows(j)
+    keys = set(zip(build.column("bs").to_pylist(),
+                   build.column("bi").to_pylist()))
+    pdf = probe.to_pandas()
+    hitm = pdf.apply(lambda r: (r.ps, r.pi) in keys, axis=1)
+    if how == "left_semi":
+        assert sorted(g["pv"] for g in got) == sorted(
+            pdf[hitm]["pv"].tolist())
+    elif how == "left_anti":
+        assert sorted(g["pv"] for g in got) == sorted(
+            pdf[~hitm]["pv"].tolist())
+    else:
+        want = _pd_join(probe, build, ["ps", "pi"], ["bs", "bi"], how)
+        assert len(got) == len(want)
+        gm = sorted((g["pv"], g["bs"] or "") for g in got)
+        wm = sorted((int(v), "" if pd.isna(s) else s)
+                    for v, s in zip(want["pv"], want["bs"]))
+        assert gm == wm
+
+
+def test_unique_table_join_duplicates_fall_back():
+    # duplicate build keys MUST reject the unique path (exact, not hash)
+    build = pa.table({"k": pa.array(["a", "b", "a", "c"]),
+                      "v": pa.array([1, 2, 3, 4], pa.int64())})
+    probe = pa.table({"k": pa.array(["a", "c", "x"]),
+                      "p": pa.array([10, 20, 30], pa.int64())})
+    j = HashJoinExec([col("k")], [col("k")], "inner",
+                     source(probe), source(build))
+    j._prepare()
+    import spark_rapids_tpu.exec.kernels as K
+    prep = j._prepare_table(batch_from_arrow(build, 16))
+    # dup keys: the table build is reused as the general path's sorted
+    # hashes instead of being discarded
+    assert isinstance(prep, K.JoinHashes)
+    got = rows(j)  # general path still correct
+    assert sorted((g["p"], g["v"]) for g in got) == [
+        (10, 1), (10, 3), (20, 4)]
+
+
+def test_unique_table_join_with_condition():
+    probe, build = _table_join_case(n_probe=800, n_build=200, seed=5)
+    cond = E.GreaterThan(col("battr"), lit(0.5))
+    j = HashJoinExec([col("ps"), col("pi")], [col("bs"), col("bi")], "inner",
+                     source(probe, batch_rows=512), source(build),
+                     condition=cond)
+    got = rows(j)
+    want = _pd_join(probe, build, ["ps", "pi"], ["bs", "bi"], "inner")
+    want = want[want["battr"] > 0.5]
+    assert len(got) == len(want)
+    assert all(g["battr"] > 0.5 for g in got)
+
+
+def test_unique_table_join_full_outer():
+    probe, build = _table_join_case(n_probe=600, n_build=150, seed=3)
+    j = HashJoinExec([col("ps"), col("pi")], [col("bs"), col("bi")], "full",
+                     source(probe, batch_rows=256), source(build))
+    got = rows(j)
+    want = _pd_join(probe, build, ["ps", "pi"], ["bs", "bi"], "outer")
+    assert len(got) == len(want)
