@@ -46,6 +46,10 @@ class _AggSpec:
     input_index: Optional[int]  # index into the pre-projection, None = count(*)
     ops: List[str]  # per-buffer update op
     buffer_types: List[T.DataType]
+    # two-input aggregates (corr/covar): per-op pre-projection index
+    input_indices: Optional[List[Optional[int]]] = None
+    # min_by/max_by: pre-projection index of the ORDERING column
+    aux_index: Optional[int] = None
 
     @property
     def result_type(self) -> T.DataType:
@@ -54,7 +58,9 @@ class _AggSpec:
 
 _MERGE_OP = {"sum": "sum", "count": "sum", "count_all": "sum", "min": "min",
              "max": "max", "first": "first", "last": "last", "sumsq": "sum",
-             "sum3": "sum", "sum4": "sum"}
+             "sum3": "sum", "sum4": "sum",
+             "minby_v": "minby_v", "minby_o": "minby_o",
+             "maxby_v": "maxby_v", "maxby_o": "maxby_o"}
 
 
 def _lower_agg(func: E.AggregateExpression, name: str,
@@ -83,10 +89,15 @@ def _lower_agg(func: E.AggregateExpression, name: str,
         # _final_project (reference: cudf VARIANCE/STD groupby aggs)
         return _AggSpec(func, name, input_index, ["sum", "sumsq", "count"],
                         [T.DOUBLE, T.DOUBLE, T.LONG])
-    if isinstance(func, E.First):
+    if isinstance(func, (E.First, E.AnyValue)):
         return _AggSpec(func, name, input_index, ["first"], [func.dtype])
     if isinstance(func, E.Last):
         return _AggSpec(func, name, input_index, ["last"], [func.dtype])
+    if isinstance(func, E.BoolAnd):  # covers BoolOr (subclass)
+        op = "max" if isinstance(func, E.BoolOr) else "min"
+        return _AggSpec(func, name, input_index, [op], [T.INT])
+    if isinstance(func, E.CountIf):
+        return _AggSpec(func, name, input_index, ["sum"], [T.LONG])
     raise NotImplementedError(f"aggregate {type(func).__name__}")
 
 
@@ -148,17 +159,67 @@ class HashAggregateExec(UnaryExec):
             for e in self.agg_exprs:
                 func, name = _strip_alias(e)
                 assert isinstance(func, E.AggregateExpression), f"not an agg: {e!r}"
+
+                def rb(i):
+                    # mode "final": children were bound against the
+                    # pre-shuffle schema by final_from_partial(); only
+                    # dtypes are used there
+                    c = func.children[i]
+                    return c if self.mode == "final" else E.resolve(
+                        c, in_schema)
+
+                if isinstance(func, E._CovarianceBase):
+                    cx, cy = rb(0), rb(1)
+                    if cx.dtype != T.DOUBLE:
+                        cx = E.Cast(cx, T.DOUBLE)
+                    if cy.dtype != T.DOUBLE:
+                        cy = E.Cast(cy, T.DOUBLE)
+                    # Spark covariance/corr aggregate only PAIRS where both
+                    # sides are non-null
+                    both = E.And(E.IsNotNull(cx), E.IsNotNull(cy))
+                    null_d = E.Literal(None, T.DOUBLE)
+
+                    def mk(x):
+                        return E.If(both, x, null_d)
+
+                    exprs = [mk(cx), mk(cy), mk(E.Multiply(cx, cy))]
+                    if isinstance(func, E.Corr):
+                        exprs += [mk(E.Multiply(cx, cx)),
+                                  mk(E.Multiply(cy, cy))]
+                    idxs = []
+                    for ex in exprs:
+                        idxs.append(len(pre_exprs))
+                        pre_exprs.append(ex)
+                    self._specs.append(_AggSpec(
+                        type(func)(cx, cy), name, idxs[0],
+                        ["sum"] * len(exprs) + ["count"],
+                        [T.DOUBLE] * len(exprs) + [T.LONG],
+                        input_indices=idxs + [idxs[0]]))
+                    continue
+                if isinstance(func, E.MinBy):  # covers MaxBy
+                    cv, co = rb(0), rb(1)
+                    vi = len(pre_exprs)
+                    pre_exprs.append(cv)
+                    oi = len(pre_exprs)
+                    pre_exprs.append(co)
+                    kind = "maxby" if isinstance(func, E.MaxBy) else "minby"
+                    self._specs.append(_AggSpec(
+                        type(func)(cv, co), name, vi,
+                        [f"{kind}_v", f"{kind}_o"], [cv.dtype, co.dtype],
+                        aux_index=oi))
+                    continue
                 if func.children:
-                    if self.mode == "final":
-                        # children were bound against the pre-shuffle schema by
-                        # final_from_partial(); only dtypes are used here
-                        bound_child = func.children[0]
-                    else:
-                        bound_child = E.resolve(func.children[0], in_schema)
+                    bound_child = rb(0)
                     if (isinstance(func, E._VarianceBase)
                             and bound_child.dtype != T.DOUBLE):
                         # moments are computed in f64 (Spark casts the input)
                         bound_child = E.Cast(bound_child, T.DOUBLE)
+                    if isinstance(func, E.BoolAnd):
+                        # int buffer: segment min/max stay off bool dtype
+                        bound_child = E.Cast(bound_child, T.INT)
+                    if isinstance(func, E.CountIf):
+                        bound_child = E.Cast(
+                            E.Coalesce(bound_child, E.lit(False)), T.LONG)
                     func = type(func)(bound_child)
                     idx = len(pre_exprs)
                     pre_exprs.append(bound_child)
@@ -335,6 +396,8 @@ class HashAggregateExec(UnaryExec):
         if G > self.DENSE_MAX_IDS:
             return None
         for s in self._specs:
+            if s.input_indices is not None or s.aux_index is not None:
+                return None  # multi-input aggs: sorted-segment path
             for op in s.ops:
                 if op not in ("sum", "count", "count_all", "min", "max",
                               "first", "last"):
@@ -624,10 +687,19 @@ class HashAggregateExec(UnaryExec):
         buf_idx = self._n_keys + (2 if buffers_input and hashes is not None
                                   else 0)
         for s, ops in zip(self._specs, ops_per_spec):
+            if ops and ops[0] in ("minby_v", "maxby_v"):
+                out_cols.extend(self._minmax_by_agg(
+                    s, pre, gi, contributing, seg_ends, out_row_valid, cap,
+                    buffers_input, buf_idx))
+                if buffers_input:
+                    buf_idx += 2
+                continue
             for bi, (op, bt) in enumerate(zip(ops, s.buffer_types)):
                 if buffers_input:
                     src = pre.columns[buf_idx]
                     buf_idx += 1
+                elif s.input_indices is not None:
+                    src = pre.columns[s.input_indices[bi]]
                 elif s.input_index is None:
                     src = None
                 else:
@@ -681,6 +753,48 @@ class HashAggregateExec(UnaryExec):
                                                            jnp.zeros_like(data)),
                                              avalid & out_row_valid))
         return ColumnarBatch(out_cols, gi.num_groups)
+
+    def _minmax_by_agg(self, s: _AggSpec, pre: ColumnarBatch,
+                       gi: K.GroupInfo, contributing, seg_ends,
+                       out_row_valid, cap: int, buffers_input: bool,
+                       buf_idx: int) -> List[DeviceColumn]:
+        """min_by/max_by: segment arg-min/max over the ordering column's
+        order-preserving key, then gather the value (+ order, so merge
+        passes can re-reduce). Reference: GpuMinBy/GpuMaxBy."""
+        want_max = s.ops[0].startswith("maxby")
+        if buffers_input:
+            vsrc, osrc = pre.columns[buf_idx], pre.columns[buf_idx + 1]
+        else:
+            vsrc = pre.columns[s.input_index]
+            osrc = pre.columns[s.aux_index]
+        ov = osrc.data[gi.perm]
+        ovv = osrc.validity[gi.perm]
+        live = contributing & ovv
+        # order-preserving uint64 key (int/date/bool/dict-code orderings;
+        # floats/strings are planner-gated to the CPU engine)
+        key = K._int_sortable(ov.astype(jnp.int64))
+        win, any_v = K.segment_agg(key, ovv, contributing, gi.segment_ids,
+                                   cap, "max" if want_max else "min",
+                                   ends=seg_ends, starts=gi.group_starts)
+        sel_flag = live & (key == win[jnp.clip(gi.segment_ids, 0, cap - 1)])
+        pos = jnp.where(sel_flag, jnp.arange(cap, dtype=jnp.int32), cap)
+        sel_pos, _ = K.segment_agg(pos, jnp.ones(cap, jnp.bool_), sel_flag,
+                                   gi.segment_ids, cap, "min",
+                                   ends=seg_ends, starts=gi.group_starts)
+        spc = jnp.clip(sel_pos, 0, cap - 1).astype(jnp.int32)
+        valid = any_v & out_row_valid
+        vperm = vsrc.data[gi.perm]
+        vvperm = vsrc.validity[gi.perm]
+        vdata = jnp.where(valid & vvperm[spc], vperm[spc],
+                          jnp.zeros_like(vperm[:1]))
+        vcol = DeviceColumn(s.buffer_types[0], vdata, valid & vvperm[spc],
+                            None, vsrc.dictionary, vsrc.dict_size,
+                            vsrc.dict_max_len)
+        odata = jnp.where(valid, ov[spc], jnp.zeros_like(ov[:1]))
+        ocol = DeviceColumn(s.buffer_types[1], odata, valid, None,
+                            osrc.dictionary, osrc.dict_size,
+                            osrc.dict_max_len)
+        return [vcol, ocol]
 
     def _wide_agg(self, src: DeviceColumn, gi: K.GroupInfo, contributing,
                   op: str, bt, cap: int, out_row_valid) -> DeviceColumn:
@@ -880,6 +994,37 @@ class HashAggregateExec(UnaryExec):
                 valid = (cnt.data > 1) if samp else (cnt.data > 0)
                 out_cols.append(DeviceColumn(
                     rt, jnp.where(valid, data, 0.0), valid))
+            elif isinstance(s.func, E._CovarianceBase):
+                if isinstance(s.func, E.Corr):
+                    sx, sy, sxy, sx2, sy2, cnt = bufs
+                else:
+                    sx, sy, sxy, cnt = bufs
+                n = cnt.data.astype(jnp.float64)
+                ns = jnp.maximum(n, 1.0)
+                ck = sxy.data - sx.data * sy.data / ns
+                if isinstance(s.func, E.CovarPop):
+                    data = ck / ns
+                    valid = cnt.data > 0
+                elif isinstance(s.func, E.CovarSamp):
+                    data = ck / jnp.maximum(n - 1.0, 1.0)
+                    # Spark default nullOnDivideByZero: n<2 -> NULL
+                    valid = cnt.data > 1
+                else:  # Corr
+                    mx = n * sx2.data - sx.data ** 2
+                    my = n * sy2.data - sy.data ** 2
+                    den = jnp.sqrt(jnp.maximum(mx, 0.0)
+                                   * jnp.maximum(my, 0.0))
+                    data = (n * sxy.data - sx.data * sy.data) / jnp.maximum(
+                        den, 1e-300)
+                    valid = (cnt.data > 0) & (den > 0)
+                out_cols.append(DeviceColumn(
+                    rt, jnp.where(valid, data, 0.0), valid))
+            elif isinstance(s.func, E.CountIf):
+                b = bufs[0]
+                out_cols.append(DeviceColumn(
+                    rt, jnp.where(b.validity, b.data, 0).astype(
+                        T.numpy_dtype(rt)),
+                    jnp.ones(cap, jnp.bool_)))
             else:
                 b = bufs[0]
                 if b.is_dict:

@@ -740,6 +740,88 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         return ColVal(jnp.hypot(l.data.astype(jnp.float64),
                                 r.data.astype(jnp.float64)),
                       l.validity & r.validity)
+    if isinstance(expr, E.Positive):
+        return eval_expr(expr.child, ctx)
+    if isinstance(expr, E.BitCount):
+        c = eval_expr(expr.child, ctx)
+        d = c.data
+        if d.dtype == jnp.bool_:
+            pc = d.astype(jnp.int32)
+        else:
+            # popcount the two u32 words: the real-TPU backend cannot
+            # lower 64-bit bitcasts (see kernels._u64_from_words)
+            w = jax.lax.bitcast_convert_type(d.astype(jnp.int64), jnp.uint32)
+            pc = (jax.lax.population_count(w[..., 0])
+                  + jax.lax.population_count(w[..., 1])).astype(jnp.int32)
+        return ColVal(pc, c.validity)
+    if isinstance(expr, E.BitGet):
+        l = eval_expr(expr.left, ctx)
+        r = eval_expr(expr.right, ctx)
+        bits = 8 * T.numpy_dtype(expr.left.dtype).itemsize
+        pos = r.data.astype(jnp.int32)
+        ok = (pos >= 0) & (pos < bits)
+        d = (l.data.astype(jnp.int64)
+             >> jnp.clip(pos, 0, 63).astype(jnp.int64)) & 1
+        return ColVal(d.astype(jnp.int8), l.validity & r.validity & ok)
+    if isinstance(expr, E.Factorial):
+        c = eval_expr(expr.child, ctx)
+        import math as _math
+        tbl = jnp.asarray([_math.factorial(i) for i in range(21)],
+                          jnp.int64)
+        n = c.data.astype(jnp.int32)
+        ok = (n >= 0) & (n <= 20)
+        return ColVal(tbl[jnp.clip(n, 0, 20)], c.validity & ok)
+    if isinstance(expr, (E.Murmur3Hash, E.XxHash64)):
+        from spark_rapids_tpu.exec import kernels as K
+        variant = 1 if isinstance(expr, E.XxHash64) else 0
+        salt = jnp.uint64(K._INT_SALT[variant])
+        h = jnp.zeros(cap, jnp.uint64)
+        for ch in expr.children:
+            v = eval_expr(ch, ctx)
+            if isinstance(v, StringVal):
+                col = DeviceColumn(T.STRING, v.data, v.validity, v.offsets)
+                chh = K._string_hash(col, variant)
+            elif ch.dtype in T.FRACTIONAL_TYPES:
+                chh = K._splitmix64(K._float_hash_key(v.data) ^ salt)
+            else:
+                chh = K._splitmix64(K._int_sortable(v.data) ^ salt)
+            chh = jnp.where(v.validity, chh,
+                            jnp.uint64(0xDEADBEEFCAFEBABE))
+            h = K._splitmix64(h * jnp.uint64(K._COMBINE_MULT[variant]) + chh)
+        return ColVal(h.astype(jnp.int64), _all_valid(cap))
+    if isinstance(expr, E.Rand):
+        # deterministic per-row stream: splitmix of (seed, row index) — the
+        # engine contract (Spark rand is per-partition-seeded; both engines
+        # here agree exactly)
+        from spark_rapids_tpu.exec import kernels as K
+        idx = jnp.arange(cap, dtype=jnp.uint64)
+        h = K._splitmix64(idx + jnp.uint64(expr.seed) * jnp.uint64(
+            0x9E3779B97F4A7C15))
+        u = (h >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
+        return ColVal(u, _all_valid(cap))
+    if isinstance(expr, E.BRound):
+        c = eval_expr(expr.child, ctx)
+        ct = expr.child.dtype
+        if isinstance(ct, T.DecimalType):
+            raise NotImplementedError("decimal bround on device")
+        if ct in T.FRACTIONAL_TYPES:
+            s = 10.0 ** expr.scale
+            d = c.data.astype(jnp.float64)
+            # HALF_EVEN at the scale: numpy/jnp rint is half-even
+            return ColVal(jnp.rint(d * s) / s, c.validity)
+        if expr.scale >= 0:
+            return ColVal(c.data, c.validity)
+        s = 10 ** (-expr.scale)
+        d = c.data.astype(jnp.int64)
+        # round to the nearest multiple of s, HALF_EVEN: floor-divide keeps
+        # rem in [0, s) so the tie decision is a single parity check
+        q = jnp.floor_divide(d, s)
+        rem = d - q * s
+        tie = 2 * rem == s
+        take_hi = (2 * rem > s) | (tie & (q % 2 != 0))
+        out = ((q + take_hi.astype(jnp.int64)) * s).astype(
+            T.numpy_dtype(expr.dtype))
+        return ColVal(out, c.validity)
     if isinstance(expr, (E.Greatest, E.Least)):
         vals = [eval_expr(c, ctx) for c in expr.children]
         out_t = expr.dtype
@@ -900,6 +982,79 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
             (d1 == d2) | both_ends, 0.0, frac)
         out = jnp.sign(out) * jnp.floor(jnp.abs(out) * 1e8 + 0.5) / 1e8
         return ColVal(out, l.validity & r.validity)
+    if isinstance(expr, E.FromUTCTimestamp):
+        from spark_rapids_tpu.utils import tzdb
+        c = eval_expr(expr.child, ctx)
+        if isinstance(expr, E.ToUTCTimestamp):
+            lstarts, offs, prev = tzdb.local_transitions(expr.tz)
+            ustarts, _ = tzdb.utc_transitions(expr.tz)
+            ls = jnp.asarray(lstarts)
+            j = jnp.clip(jnp.searchsorted(ls, c.data, side="right") - 1,
+                         0, ls.shape[0] - 1)
+            offj = jnp.asarray(offs)[j]
+            prevj = jnp.asarray(prev)[j]
+            # DST overlap: if the earlier offset still lands before the
+            # transition instant, java (and Spark) keep it
+            cand = c.data - prevj
+            use_prev = cand < jnp.asarray(ustarts)[j]
+            out = jnp.where(use_prev, cand, c.data - offj)
+            return ColVal(out, c.validity)
+        starts, offs = tzdb.utc_transitions(expr.tz)
+        st = jnp.asarray(starts)
+        j = jnp.clip(jnp.searchsorted(st, c.data, side="right") - 1,
+                     0, st.shape[0] - 1)
+        return ColVal(c.data + jnp.asarray(offs)[j], c.validity)
+    if isinstance(expr, E.MakeDate):
+        y = eval_expr(expr.children[0], ctx)
+        m = eval_expr(expr.children[1], ctx)
+        d = eval_expr(expr.children[2], ctx)
+        yy = y.data.astype(jnp.int32)
+        mm = m.data.astype(jnp.int32)
+        dd = d.data.astype(jnp.int32)
+        mc = jnp.clip(mm, 1, 12)
+        ny = jnp.where(mc == 12, yy + 1, yy)
+        nm = jnp.where(mc == 12, 1, mc + 1)
+        mlen = (_days_from_civil(ny, nm, jnp.ones_like(yy))
+                - _days_from_civil(yy, mc, jnp.ones_like(yy)))
+        ok = ((mm >= 1) & (mm <= 12) & (dd >= 1) & (dd <= mlen)
+              & (yy >= 1) & (yy <= 9999))
+        days = _days_from_civil(yy, mc, jnp.clip(dd, 1, 31))
+        return ColVal(jnp.where(ok, days, 0).astype(jnp.int32),
+                      y.validity & m.validity & d.validity & ok)
+    if isinstance(expr, E.MakeTimestamp):
+        vs = [eval_expr(c, ctx) for c in expr.children]
+        yy, mm, dd, hh, mi = [v.data.astype(jnp.int32) for v in vs[:5]]
+        sec = vs[5].data.astype(jnp.float64)
+        mc = jnp.clip(mm, 1, 12)
+        ny = jnp.where(mc == 12, yy + 1, yy)
+        nm = jnp.where(mc == 12, 1, mc + 1)
+        mlen = (_days_from_civil(ny, nm, jnp.ones_like(yy))
+                - _days_from_civil(yy, mc, jnp.ones_like(yy)))
+        ok = ((mm >= 1) & (mm <= 12) & (dd >= 1) & (dd <= mlen)
+              & (hh >= 0) & (hh <= 23) & (mi >= 0) & (mi <= 59)
+              & (sec >= 0) & (sec < 60) & (yy >= 1) & (yy <= 9999))
+        days = _days_from_civil(yy, mc, jnp.clip(dd, 1, 31)).astype(jnp.int64)
+        micros = (days * 86_400_000_000
+                  + hh.astype(jnp.int64) * 3_600_000_000
+                  + mi.astype(jnp.int64) * 60_000_000
+                  + jnp.round(sec * 1e6).astype(jnp.int64))
+        valid = ok
+        for v in vs:
+            valid = valid & v.validity
+        return ColVal(jnp.where(valid, micros, 0), valid)
+    if isinstance(expr, E.TimestampSeconds):  # + Millis/Micros subclasses
+        c = eval_expr(expr.child, ctx)
+        return ColVal(c.data.astype(jnp.int64) * expr.SCALE, c.validity)
+    if isinstance(expr, E.UnixSeconds):  # + Millis/Micros subclasses
+        c = eval_expr(expr.child, ctx)
+        return ColVal(jnp.floor_divide(c.data.astype(jnp.int64), expr.DIV),
+                      c.validity)
+    if isinstance(expr, E.UnixDate):
+        c = eval_expr(expr.child, ctx)
+        return ColVal(c.data.astype(jnp.int32), c.validity)
+    if isinstance(expr, E.DateFromUnixDate):
+        c = eval_expr(expr.child, ctx)
+        return ColVal(c.data.astype(jnp.int32), c.validity)
     if isinstance(expr, E.TruncDate):
         c = eval_expr(expr.children[0], ctx)
         days = c.data.astype(jnp.int32)
@@ -1071,7 +1226,11 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
 _TRIG = {E.Sin: jnp.sin, E.Cos: jnp.cos, E.Tan: jnp.tan,
          E.Asin: jnp.arcsin, E.Acos: jnp.arccos, E.Atan: jnp.arctan,
          E.Sinh: jnp.sinh, E.Cosh: jnp.cosh, E.Tanh: jnp.tanh,
-         E.ToDegrees: jnp.degrees, E.ToRadians: jnp.radians}
+         E.ToDegrees: jnp.degrees, E.ToRadians: jnp.radians,
+         E.Asinh: jnp.arcsinh, E.Acosh: jnp.arccosh, E.Atanh: jnp.arctanh,
+         E.Cot: lambda x: 1.0 / jnp.tan(x),
+         E.Sec: lambda x: 1.0 / jnp.cos(x),
+         E.Csc: lambda x: 1.0 / jnp.sin(x)}
 
 
 def _eval_string_fns(expr: E.Expression, ctx: EvalContext):
@@ -1142,6 +1301,62 @@ def _eval_string_fns(expr: E.Expression, ctx: EvalContext):
     if isinstance(expr, E.SubstringIndex):
         return back(S.substring_index(sval(expr.children[0]),
                                       expr.delim.encode("utf-8"), expr.count))
+    if isinstance(expr, E.Hex):
+        cdt = expr.children[0].dtype
+        if cdt in (T.STRING, T.BINARY):
+            return back(S.hex_encode(sval(expr.children[0])))
+        # integral hex: no leading zeros, uppercase, two's complement
+        c = eval_expr(expr.children[0], ctx)
+        x = c.data.astype(jnp.int64)
+        words = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        nibs = []
+        for w in (words[..., 1], words[..., 0]):
+            for k in range(7, -1, -1):
+                nibs.append(((w >> jnp.uint32(4 * k)) & 15).astype(jnp.uint8))
+        mat = jnp.stack(nibs, axis=1)  # (cap, 16) most-significant first
+        nz = mat != 0
+        # position of first nonzero nibble (all-zero -> emit single '0')
+        first = jnp.argmax(nz, axis=1)
+        any_nz = jnp.any(nz, axis=1)
+        lens = jnp.where(any_nz, 16 - first, 1).astype(jnp.int32)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens).astype(jnp.int32)])
+        out_bytes = 16 * mat.shape[0]
+        j = jnp.arange(out_bytes, dtype=jnp.int32)
+        rows = jnp.clip(S.row_ids(offsets, out_bytes), 0, mat.shape[0] - 1)
+        rel = j - offsets[rows]
+        nib = mat[rows, jnp.clip(16 - lens[rows] + rel, 0, 15)]
+        ch = nib + jnp.where(nib < 10, jnp.uint8(48), jnp.uint8(55))
+        in_range = j < offsets[-1]
+        return StringVal(jnp.where(in_range, ch, jnp.uint8(0)), offsets,
+                         c.validity)
+    if isinstance(expr, E.Unhex):
+        return back(S.unhex(sval(expr.children[0])))
+    if isinstance(expr, E.Base64):
+        return back(S.base64_encode(sval(expr.children[0])))
+    if isinstance(expr, E.UnBase64):
+        return back(S.unbase64(sval(expr.children[0])))
+    if isinstance(expr, E.Overlay):
+        # overlay with an explicit FOR length decomposes into substrings +
+        # concat (the default length = char_length(replace) is per-row and
+        # stays on the CPU engine)
+        assert expr.length >= 0
+        inp, repl = expr.children
+        rew = E.Concat(E.Substring(inp, 1, max(expr.pos - 1, 0)), repl,
+                       E.Substring(inp, expr.pos + expr.length, 1 << 29))
+        return eval_expr(rew, ctx)
+    if isinstance(expr, E.FindInSet):
+        s = sval(expr.children[0])
+        cap = ctx.batch.capacity
+        idx = jnp.zeros(cap, jnp.int32)
+        # compare against each item of the (static) comma list, first hit
+        # wins; a needle containing ',' never matches (Spark)
+        items = expr.items.split(",")
+        for k in reversed(range(len(items))):
+            lit_sv = _broadcast_literal(items[k], T.STRING, cap)
+            eq = _string_eq(s, lit_sv, cap)
+            idx = jnp.where(eq, jnp.int32(k + 1), idx)
+        return ColVal(idx, s.validity)
     if isinstance(expr, E.Ascii):
         s = sval(expr.children[0])
         return ColVal(S.ascii_code(s), s.validity)

@@ -649,6 +649,139 @@ class ToRadians(_UnaryMath):
     pass
 
 
+class Asinh(_UnaryMath):
+    pass
+
+
+class Acosh(_UnaryMath):
+    pass
+
+
+class Atanh(_UnaryMath):
+    pass
+
+
+class Cot(_UnaryMath):
+    """cot(x) = 1/tan(x)."""
+
+
+class Sec(_UnaryMath):
+    """sec(x) = 1/cos(x)."""
+
+
+class Csc(_UnaryMath):
+    """csc(x) = 1/sin(x)."""
+
+
+class BRound(_Unary):
+    """bround: HALF_EVEN rounding at a literal scale (Spark BRound)."""
+
+    def __init__(self, child: Expression, scale: int = 0):
+        super().__init__(child)
+        self.scale = scale
+        self._params = (scale,)
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        if isinstance(ct, T.DecimalType):
+            # same precision/scale rule as Round
+            s = min(self.scale, ct.scale) if self.scale >= 0 else 0
+            p = ct.precision - (ct.scale - s) + (1 if s < ct.scale else 0)
+            return T.DecimalType(min(max(p, 1), 38), max(s, 0))
+        return ct
+
+
+class Bin(_Unary):
+    """bin(long): binary string representation."""
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+
+class Factorial(_Unary):
+    """factorial(n) for 0<=n<=20, else NULL (Spark semantics)."""
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Positive(_Unary):
+    """unary + (identity)."""
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class BitCount(_Unary):
+    """bit_count: number of set bits (Spark returns INT)."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class BitGet(_Binary):
+    """bit_get(x, pos) / getbit."""
+
+    @property
+    def dtype(self):
+        return T.BYTE
+
+
+class Murmur3Hash(Expression):
+    """hash(...): Spark murmur3-based hash of the argument tuple. Device
+    analog of GpuMurmur3Hash — the engine's own mixed 64-bit hash is used
+    (values agree between device and CPU engines, not with Spark's exact
+    murmur3 — documented in supported_ops)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+
+class XxHash64(Murmur3Hash):
+    """xxhash64(...) analog (variant-keyed engine hash)."""
+
+
+class Rand(Expression):
+    """rand([seed]): deterministic per-row uniform [0,1) stream.
+
+    CPU-engine expression: the device eval exists (seed + in-batch row
+    position) but is only exact for single-batch partitions, so the planner
+    keeps rand on the CPU engine where rows are numbered over the whole
+    partition."""
+
+    device_supported = False
+
+    def __init__(self, seed: int = 0):
+        self.children = ()
+        self.seed = seed
+        self._params = (seed,)
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+
 class Signum(_Unary):
     @property
     def dtype(self):
@@ -1226,11 +1359,10 @@ class Crc32(_Unary):
 
 
 class Base64(_CpuOnlyUnaryString):
-    pass
+    device_supported = True
 
 
 class UnBase64(_Unary):
-    device_supported = False
 
     @property
     def dtype(self):
@@ -1238,11 +1370,10 @@ class UnBase64(_Unary):
 
 
 class Hex(_CpuOnlyUnaryString):
-    pass
+    device_supported = True
 
 
 class Unhex(_Unary):
-    device_supported = False
 
     @property
     def dtype(self):
@@ -1283,8 +1414,6 @@ class Levenshtein(_Binary):
 class FindInSet(Expression):
     """find_in_set(str, comma-list-literal): 1-based index or 0."""
 
-    device_supported = False
-
     def __init__(self, child: Expression, items: str):
         self.children = (child,)
         self.items = items
@@ -1296,9 +1425,9 @@ class FindInSet(Expression):
 
 
 class Overlay(Expression):
-    """overlay(str PLACING replace FROM pos [FOR len])."""
-
-    device_supported = False
+    """overlay(str PLACING replace FROM pos [FOR len]). The default
+    length (-1 = char_length(replace), per-row) stays on the CPU engine;
+    an explicit FOR length runs on device as substring+concat."""
 
     def __init__(self, child: Expression, replace: Expression, pos: int,
                  length: int = -1):
@@ -1306,6 +1435,7 @@ class Overlay(Expression):
         self.pos = pos
         self.length = length
         self._params = (pos, length)
+        self.device_supported = length >= 0 and pos >= 1
 
     @property
     def dtype(self):
@@ -1476,6 +1606,231 @@ class Skewness(_VarianceBase):
 
 class Kurtosis(_VarianceBase):
     """Spark kurtosis: excess kurtosis m4/m2^2 - 3."""
+
+
+class FromUTCTimestamp(_Unary):
+    """from_utc_timestamp(ts, tz): shift a UTC instant into the zone's
+    wall time (device path: utils/tzdb transition-table lookup — the
+    GpuTimeZoneDB analog)."""
+
+    def __init__(self, child: Expression, tz: str):
+        super().__init__(child)
+        self.tz = tz
+        self._params = (tz,)
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+
+class ToUTCTimestamp(FromUTCTimestamp):
+    """to_utc_timestamp(ts, tz): interpret wall time in the zone -> UTC;
+    fall-back overlaps resolve to the earlier offset (java.time default)."""
+
+
+class MakeDate(Expression):
+    """make_date(y, m, d); invalid civil dates -> NULL (non-ANSI)."""
+
+    def __init__(self, year: Expression, month: Expression, day: Expression):
+        self.children = (year, month, day)
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+    @property
+    def nullable(self):
+        return True
+
+
+class MakeTimestamp(Expression):
+    """make_timestamp(y, m, d, h, min, sec) — sec may carry fractional
+    micros; invalid components -> NULL."""
+
+    def __init__(self, *children: Expression):
+        assert len(children) == 6
+        self.children = tuple(children)
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+    @property
+    def nullable(self):
+        return True
+
+
+class TimestampSeconds(_Unary):
+    """timestamp_seconds(n) (also the base for millis/micros variants)."""
+
+    SCALE = 1_000_000
+
+    @property
+    def dtype(self):
+        return T.TIMESTAMP
+
+
+class TimestampMillis(TimestampSeconds):
+    SCALE = 1_000
+
+
+class TimestampMicros(TimestampSeconds):
+    SCALE = 1
+
+
+class UnixSeconds(_Unary):
+    """unix_seconds(ts): floorDiv to the unit (Spark UnixSeconds)."""
+
+    DIV = 1_000_000
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+
+class UnixMillis(UnixSeconds):
+    DIV = 1_000
+
+
+class UnixMicros(UnixSeconds):
+    DIV = 1
+
+
+class UnixDate(_Unary):
+    """unix_date(d): days since epoch as INT."""
+
+    @property
+    def dtype(self):
+        return T.INT
+
+
+class DateFromUnixDate(_Unary):
+    """date_from_unix_date(n)."""
+
+    @property
+    def dtype(self):
+        return T.DATE
+
+
+class BoolAnd(AggregateExpression, _Unary):
+    """bool_and / every (reference: GpuOverrides BoolAnd rule; cudf ALL)."""
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
+class BoolOr(BoolAnd):
+    """bool_or / any / some."""
+
+
+class CountIf(AggregateExpression, _Unary):
+    """count_if(pred): rows where the predicate is TRUE (null-safe)."""
+
+    @property
+    def dtype(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+
+class AnyValue(AggregateExpression, _Unary):
+    """any_value: nondeterministic pick (First semantics, like the
+    reference's GpuAnyValue -> first)."""
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class _CovarianceBase(AggregateExpression, _Binary):
+    """Two-input moment aggregates over (x, y) pairs where BOTH are
+    non-null (reference: GpuCovarianceSamp/Pop, GpuCorr via cudf; here:
+    masked power-sum buffers Σx, Σy, Σxy (+Σx², Σy² for corr) + pair
+    count, merged as plain sums across batches/devices)."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+
+class CovarSamp(_CovarianceBase):
+    pass
+
+
+class CovarPop(_CovarianceBase):
+    pass
+
+
+class Corr(_CovarianceBase):
+    pass
+
+
+class MinBy(AggregateExpression, _Binary):
+    """min_by(value, ordering): value at the minimum ordering (reference:
+    GpuMinBy; device path = segment argmin over the ordering's sortable
+    key + gather)."""
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+
+class MaxBy(MinBy):
+    pass
+
+
+class BitAndAgg(AggregateExpression, _Unary):
+    """bit_and aggregate (CPU engine; word-level bit reductions do not map
+    to the sorted-segment min/max/sum reducers)."""
+
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class BitOrAgg(BitAndAgg):
+    pass
+
+
+class BitXorAgg(BitAndAgg):
+    pass
+
+
+class Percentile(AggregateExpression, _Unary):
+    """Exact percentile (reference: GpuPercentile via jni Histogram).
+    CPU engine for now; takes a literal percentage at construction."""
+
+    device_supported = False
+
+    def __init__(self, child: Expression, percentage: float):
+        super().__init__(child)
+        self.percentage = percentage
+        self._params = (percentage,)
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Median(Percentile):
+    """median(x) = percentile(x, 0.5)."""
+
+    def __init__(self, child: Expression):
+        Percentile.__init__(self, child, 0.5)
+        self._params = ()
 
 
 def resolve(expr: Expression, schema: T.Schema) -> Expression:

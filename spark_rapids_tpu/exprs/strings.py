@@ -489,3 +489,190 @@ def chr_of(codes: jax.Array, validity: jax.Array) -> SVal:
     rows = row_ids(off, cap)
     out = byte[jnp.clip(rows, 0, cap - 1)]
     return SVal(out, off, validity)
+
+
+# ---------------------------------------------------------------------------
+# byte-codec kernels (round-4: hex / base64 on device)
+# ---------------------------------------------------------------------------
+# Reference: CastStrings/format utilities in spark-rapids-jni; here each
+# codec is a pure byte-space gather: output byte j finds its source byte(s)
+# arithmetically from the scaled offsets, so the whole transform is one
+# vectorized pass with no per-row loops.
+
+
+def hex_encode(s: SVal) -> SVal:
+    """Each byte -> two uppercase hex chars (Spark hex(binary/string))."""
+    nbytes = s.data.shape[0]
+    out_off = (s.offsets * 2).astype(jnp.int32)
+    out_bytes = 2 * nbytes
+    j = jnp.arange(out_bytes, dtype=jnp.int32)
+    src = s.data[jnp.clip(j // 2, 0, nbytes - 1)]
+    nib = jnp.where(j % 2 == 0, src >> 4, src & 15).astype(jnp.uint8)
+    ch = nib + jnp.where(nib < 10, jnp.uint8(48), jnp.uint8(55))
+    in_range = j < out_off[-1]
+    return SVal(jnp.where(in_range, ch, jnp.uint8(0)), out_off, s.validity)
+
+
+def _hex_val(c: jax.Array):
+    """(value, ok) for one hex digit char."""
+    d = (c >= 48) & (c <= 57)
+    lo = (c >= 97) & (c <= 102)
+    hi = (c >= 65) & (c <= 70)
+    v = jnp.where(d, c - 48, jnp.where(lo, c - 87, jnp.where(hi, c - 55, 0)))
+    return v.astype(jnp.uint8), d | lo | hi
+
+
+def unhex(s: SVal) -> SVal:
+    """Hex chars -> bytes; odd length gets an implicit leading 0; any
+    non-hex char -> NULL row (Spark unhex)."""
+    nbytes = s.data.shape[0]
+    cap = s.offsets.shape[0] - 1
+    lens = s.offsets[1:] - s.offsets[:-1]
+    out_lens = (lens + 1) // 2
+    out_off = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(out_lens).astype(jnp.int32)])
+    rows = row_ids(out_off, nbytes)
+    rows_c = jnp.clip(rows, 0, cap - 1)
+    j = jnp.arange(nbytes, dtype=jnp.int32)
+    rel = j - out_off[rows_c]
+    odd = lens[rows_c] % 2
+    p0 = s.offsets[rows_c] + 2 * rel - odd
+    p1 = p0 + 1
+    has0 = (2 * rel - odd) >= 0
+    c0, _ = _hex_val(s.data[jnp.clip(p0, 0, nbytes - 1)])
+    c1, _ = _hex_val(s.data[jnp.clip(p1, 0, nbytes - 1)])
+    byte = (jnp.where(has0, c0, 0).astype(jnp.uint8) << 4) | c1
+    in_range = j < out_off[-1]
+    data = jnp.where(in_range, byte, jnp.uint8(0))
+    # row validity: every input char must be a hex digit
+    in_rows = row_ids(s.offsets, nbytes)
+    in_rows_c = jnp.clip(in_rows, 0, cap - 1)
+    _, ok = _hex_val(s.data)
+    live = jnp.arange(nbytes, dtype=jnp.int32) < s.offsets[-1]
+    bad = jax.ops.segment_max((live & ~ok).astype(jnp.int32), in_rows_c,
+                              num_segments=cap) > 0
+    return SVal(data, out_off, s.validity & ~bad)
+
+
+_B64_CHARS = (b"ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz"
+              b"0123456789+/")
+
+
+def base64_encode(s: SVal) -> SVal:
+    """3 bytes -> 4 chars with '=' padding (Spark base64)."""
+    nbytes = s.data.shape[0]
+    cap = s.offsets.shape[0] - 1
+    lens = s.offsets[1:] - s.offsets[:-1]
+    out_lens = 4 * ((lens + 2) // 3)
+    out_off = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(out_lens).astype(jnp.int32)])
+    # 4*ceil(len/3) <= 4*len/3 + 4 per row: pad-heavy tiny rows need the
+    # +4/row term, not just the 4/3 expansion
+    out_bytes = 2 * nbytes + 4 * cap
+    tbl = jnp.asarray(np.frombuffer(_B64_CHARS, np.uint8))
+    j = jnp.arange(out_bytes, dtype=jnp.int32)
+    rows = jnp.clip(row_ids(out_off, out_bytes), 0, cap - 1)
+    rel = j - out_off[rows]
+    q, sub = rel // 4, rel % 4
+    base = s.offsets[rows] + 3 * q
+    ln = lens[rows]
+
+    def byte_at(k):
+        ok = (3 * q + k) < ln
+        b = s.data[jnp.clip(base + k, 0, nbytes - 1)]
+        return jnp.where(ok, b, jnp.uint8(0)), ok
+
+    b0, _ = byte_at(0)
+    b1, ok1 = byte_at(1)
+    b2, ok2 = byte_at(2)
+    idx = jnp.where(
+        sub == 0, b0 >> 2,
+        jnp.where(sub == 1, ((b0 & 3) << 4) | (b1 >> 4),
+                  jnp.where(sub == 2, ((b1 & 15) << 2) | (b2 >> 6),
+                            b2 & 63))).astype(jnp.int32)
+    ch = tbl[jnp.clip(idx, 0, 63)]
+    pad = ((sub == 2) & ~ok1) | ((sub == 3) & ~ok2)
+    ch = jnp.where(pad, jnp.uint8(61), ch)  # '='
+    in_range = j < out_off[-1]
+    return SVal(jnp.where(in_range, ch, jnp.uint8(0)), out_off, s.validity)
+
+
+def _b64_val(c: jax.Array):
+    up = (c >= 65) & (c <= 90)
+    lo = (c >= 97) & (c <= 122)
+    dg = (c >= 48) & (c <= 57)
+    v = jnp.where(up, c - 65,
+                  jnp.where(lo, c - 71,
+                            jnp.where(dg, c + 4,
+                                      jnp.where(c == 43, 62,
+                                                jnp.where(c == 47, 63, 0)))))
+    ok = up | lo | dg | (c == 43) | (c == 47)
+    return v.astype(jnp.uint8), ok
+
+
+def unbase64(s: SVal) -> SVal:
+    """4 chars -> 3 bytes; '=' padding trims the tail.
+
+    Non-alphabet bytes (newlines, MIME wrapping) are DISCARDED before
+    decoding — the lenient commons-codec behavior Spark exposes (and the
+    CPU engine's b64decode(validate=False)); after stripping, a length not
+    divisible by 4 -> NULL row."""
+    # strip: compact alphabet/'=' bytes to the front of each row
+    nb = s.data.shape[0]
+    _, okc0 = _b64_val(s.data)
+    keep = (okc0 | (s.data == 61)) & (
+        jnp.arange(nb, dtype=jnp.int32) < s.offsets[-1])
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    new_data = jnp.zeros(nb, jnp.uint8).at[
+        jnp.where(keep, pos, nb)].set(s.data, mode="drop")
+    cap0 = s.offsets.shape[0] - 1
+    in_rows0 = jnp.clip(row_ids(s.offsets, nb), 0, cap0 - 1)
+    kept_per_row = jax.ops.segment_sum(keep.astype(jnp.int32), in_rows0,
+                                       num_segments=cap0)
+    new_off = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(kept_per_row).astype(jnp.int32)])
+    s = SVal(new_data, new_off, s.validity)
+    nbytes = s.data.shape[0]
+    cap = s.offsets.shape[0] - 1
+    lens = s.offsets[1:] - s.offsets[:-1]
+    groups = lens // 4
+    # count trailing '=' (0..2)
+    last = s.offsets[1:] - 1
+    last2 = s.offsets[1:] - 2
+    pad1 = (lens > 0) & (s.data[jnp.clip(last, 0, nbytes - 1)] == 61)
+    pad2 = pad1 & (lens > 1) & (s.data[jnp.clip(last2, 0, nbytes - 1)] == 61)
+    pads = pad1.astype(jnp.int32) + pad2.astype(jnp.int32)
+    out_lens = jnp.maximum(groups * 3 - pads, 0)
+    out_off = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(out_lens).astype(jnp.int32)])
+    out_bytes = nbytes  # 3/4 contraction: input size is a safe bound
+    j = jnp.arange(out_bytes, dtype=jnp.int32)
+    rows = jnp.clip(row_ids(out_off, out_bytes), 0, cap - 1)
+    rel = j - out_off[rows]
+    g, sub = rel // 3, rel % 3
+    base = s.offsets[rows] + 4 * g
+
+    def val_at(k):
+        v, _ = _b64_val(s.data[jnp.clip(base + k, 0, nbytes - 1)])
+        return v
+
+    v0, v1, v2, v3 = val_at(0), val_at(1), val_at(2), val_at(3)
+    byte = jnp.where(
+        sub == 0, (v0 << 2) | (v1 >> 4),
+        jnp.where(sub == 1, ((v1 & 15) << 4) | (v2 >> 2),
+                  ((v2 & 3) << 6) | v3)).astype(jnp.uint8)
+    in_range = j < out_off[-1]
+    data = jnp.where(in_range, byte, jnp.uint8(0))
+    # validity: len % 4 == 0 and every non-pad char decodes
+    in_rows = jnp.clip(row_ids(s.offsets, nbytes), 0, cap - 1)
+    pos_in_row = jnp.arange(nbytes, dtype=jnp.int32) - s.offsets[in_rows]
+    _, okc = _b64_val(s.data)
+    is_pad = s.data == 61
+    # '=' allowed only in the last two positions
+    tail = pos_in_row >= (lens[in_rows] - 2)
+    char_ok = okc | (is_pad & tail)
+    live = jnp.arange(nbytes, dtype=jnp.int32) < s.offsets[-1]
+    bad = jax.ops.segment_max((live & ~char_ok).astype(jnp.int32), in_rows,
+                              num_segments=cap) > 0
+    valid = s.validity & ~bad & (lens % 4 == 0)
+    return SVal(data, out_off, valid)

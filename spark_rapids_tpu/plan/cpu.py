@@ -413,6 +413,77 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
         return np.hypot(a.astype(np.float64),
                         b.astype(np.float64)), ma & mb
+    if isinstance(expr, E.Positive):
+        return ev(expr.child)
+    if isinstance(expr, E.BitCount):
+        d, m = ev(expr.child)
+        if d.dtype == np.bool_:
+            return d.astype(np.int32), m
+        u = d.astype(np.int64).astype(np.uint64)
+        return np.array([int(x).bit_count() for x in u], np.int32), m
+    if isinstance(expr, E.BitGet):
+        (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
+        bits = 8 * T.numpy_dtype(expr.left.dtype).itemsize
+        pos = b.astype(np.int64)
+        ok = (pos >= 0) & (pos < bits)
+        d = (a.astype(np.int64) >> np.clip(pos, 0, 63)) & 1
+        return d.astype(np.int8), ma & mb & ok
+    if isinstance(expr, E.Factorial):
+        import math as _math
+        d, m = ev(expr.child)
+        n_ = d.astype(np.int64)
+        ok = (n_ >= 0) & (n_ <= 20)
+        tbl = np.array([_math.factorial(i) for i in range(21)], np.int64)
+        return tbl[np.clip(n_, 0, 20)], m & ok
+    if isinstance(expr, (E.Murmur3Hash, E.XxHash64)):
+        kids = [ev(c) for c in expr.children]
+        variant = 1 if isinstance(expr, E.XxHash64) else 0
+        return (_np_engine_hash(kids, expr.children, n, variant), ones)
+    if isinstance(expr, E.Rand):
+        out = np.empty(n, np.float64)
+        for r in range(n):
+            h = _np_splitmix64(
+                (r + expr.seed * 0x9E3779B97F4A7C15) & _M64)
+            out[r] = (h >> 11) / float(1 << 53)
+        return out, ones
+    if isinstance(expr, E.BRound):
+        d, m = ev(expr.child)
+        ct = expr.child.dtype
+        if isinstance(ct, T.DecimalType):
+            # round at 10^(ct.scale - expr.scale): a NEGATIVE target scale
+            # rounds to tens/hundreds even though the result scale clamps
+            # at 0 (Spark bround(123.45, -1) = 120)
+            s_out = expr.dtype.scale
+            if expr.scale >= ct.scale:
+                return d, m
+            f = 10 ** (ct.scale - expr.scale)
+            back = 10 ** (s_out - min(expr.scale, 0))
+            out = []
+            for v in d:
+                q, rem = divmod(int(v), f)
+                if 2 * rem > f or (2 * rem == f and q % 2 != 0):
+                    q += 1
+                out.append(q * back)
+            if expr.dtype.precision > 18:
+                return np.array(out, object), m
+            return np.array(out, np.int64), m
+        if ct in T.FRACTIONAL_TYPES:
+            s = 10.0 ** expr.scale
+            return np.rint(d.astype(np.float64) * s) / s, m
+        if expr.scale >= 0:
+            return d, m
+        s = 10 ** (-expr.scale)
+        dd = d.astype(np.int64)
+        q = np.floor_divide(dd, s)
+        rem = dd - q * s
+        tie = 2 * rem == s
+        take_hi = (2 * rem > s) | (tie & (q % 2 != 0))
+        return ((q + take_hi.astype(np.int64)) * s).astype(
+            T.numpy_dtype(expr.dtype)), m
+    if isinstance(expr, E.Bin):
+        d, m = ev(expr.child)
+        return np.array([format(int(x) & _M64, "b") for x in
+                         d.astype(np.int64)], object), m
     if isinstance(expr, (E.Greatest, E.Least)):
         out_t = expr.dtype
         is_max = not isinstance(expr, E.Least)
@@ -635,6 +706,70 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
             (d1 == d2) | both_ends, 0.0, frac)
         out = np.sign(out) * np.floor(np.abs(out) * 1e8 + 0.5) / 1e8
         return out, ma & mb
+    if isinstance(expr, E.FromUTCTimestamp):
+        from spark_rapids_tpu.utils import tzdb
+        d, m = ev(expr.child)
+        dd = d.astype(np.int64)
+        if isinstance(expr, E.ToUTCTimestamp):
+            lstarts, offs, prev = tzdb.local_transitions(expr.tz)
+            ustarts, _ = tzdb.utc_transitions(expr.tz)
+            j = np.clip(np.searchsorted(lstarts, dd, side="right") - 1,
+                        0, len(lstarts) - 1)
+            cand = dd - prev[j]
+            use_prev = cand < ustarts[j]
+            return np.where(use_prev, cand, dd - offs[j]), m
+        starts, offs = tzdb.utc_transitions(expr.tz)
+        j = np.clip(np.searchsorted(starts, dd, side="right") - 1,
+                    0, len(starts) - 1)
+        return dd + offs[j], m
+    if isinstance(expr, E.MakeDate):
+        (y, my), (mo, mm_), (dy, md) = [ev(c) for c in expr.children]
+        out = np.zeros(n, np.int32)
+        ok = np.zeros(n, np.bool_)
+        import datetime as _dt
+        for i in range(n):
+            try:
+                out[i] = (_dt.date(int(y[i]), int(mo[i]), int(dy[i]))
+                          - _dt.date(1970, 1, 1)).days
+                ok[i] = True
+            except (ValueError, OverflowError):
+                pass
+        return out, my & mm_ & md & ok
+    if isinstance(expr, E.MakeTimestamp):
+        vals = [ev(c) for c in expr.children]
+        m = np.ones(n, np.bool_)
+        for _, mv in vals:
+            m = m & mv
+        out = np.zeros(n, np.int64)
+        ok = np.zeros(n, np.bool_)
+        import datetime as _dt
+        for i in range(n):
+            try:
+                sec = float(vals[5][0][i])
+                if not (0 <= sec < 60):
+                    raise ValueError
+                base = _dt.datetime(int(vals[0][0][i]), int(vals[1][0][i]),
+                                    int(vals[2][0][i]), int(vals[3][0][i]),
+                                    int(vals[4][0][i]))
+                out[i] = (int((base - _dt.datetime(1970, 1, 1))
+                              .total_seconds()) * 1_000_000
+                          + round(sec * 1e6))
+                ok[i] = True
+            except (ValueError, OverflowError):
+                pass
+        return out, m & ok
+    if isinstance(expr, E.TimestampSeconds):
+        d, m = ev(expr.child)
+        return d.astype(np.int64) * expr.SCALE, m
+    if isinstance(expr, E.UnixSeconds):
+        d, m = ev(expr.child)
+        return np.floor_divide(d.astype(np.int64), expr.DIV), m
+    if isinstance(expr, E.UnixDate):
+        d, m = ev(expr.child)
+        return d.astype(np.int32), m
+    if isinstance(expr, E.DateFromUnixDate):
+        d, m = ev(expr.child)
+        return d.astype(np.int32), m
     if isinstance(expr, E.TruncDate):
         d, m = ev(expr.children[0])
         days = d.astype("datetime64[D]")
@@ -863,7 +998,59 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
 _TRIG_NP = {E.Sin: np.sin, E.Cos: np.cos, E.Tan: np.tan,
             E.Asin: np.arcsin, E.Acos: np.arccos, E.Atan: np.arctan,
             E.Sinh: np.sinh, E.Cosh: np.cosh, E.Tanh: np.tanh,
-            E.ToDegrees: np.degrees, E.ToRadians: np.radians}
+            E.ToDegrees: np.degrees, E.ToRadians: np.radians,
+            E.Asinh: np.arcsinh, E.Acosh: np.arccosh, E.Atanh: np.arctanh,
+            E.Cot: lambda x: 1.0 / np.tan(x),
+            E.Sec: lambda x: 1.0 / np.cos(x),
+            E.Csc: lambda x: 1.0 / np.sin(x)}
+
+
+_M64 = (1 << 64) - 1
+
+
+def _np_splitmix64(x: int) -> int:
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def _np_engine_hash(children_vals, children_exprs, n, variant: int) -> np.ndarray:
+    """Python-int replica of kernels.hash_keys (engine hash; not Spark
+    murmur3 — the two ENGINES must agree, which the parity tests check)."""
+    from spark_rapids_tpu.exec.kernels import (_COMBINE_MULT, _INT_SALT,
+                                               _LEN_MIX, _STR_P)
+    salt = _INT_SALT[variant]
+    out = [0] * n
+    for (vals, valid), ex in zip(children_vals, children_exprs):
+        dt = ex.dtype
+        fkeys = None
+        if dt in T.FRACTIONAL_TYPES:
+            from spark_rapids_tpu.exec import kernels as K
+            import jax.numpy as jnp
+            fkeys = np.asarray(K._float_hash_key(
+                jnp.asarray(np.asarray(vals, np.float64))))
+        for r in range(n):
+            if not valid[r]:
+                ch = 0xDEADBEEFCAFEBABE
+            elif dt in (T.STRING, T.BINARY):
+                bs = vals[r].encode() if isinstance(vals[r], str) else bytes(vals[r])
+                h = 0
+                P = _STR_P[variant]
+                p = 1
+                for b in bs:
+                    h = (h + (b + 1) * p) & _M64
+                    p = (p * P) & _M64
+                ch = _np_splitmix64(h ^ ((len(bs) * _LEN_MIX[variant]) & _M64))
+            elif dt in T.FRACTIONAL_TYPES:
+                ch = _np_splitmix64(int(fkeys[r]) ^ salt)
+            else:
+                iv = (int(vals[r]) & _M64) ^ (1 << 63)
+                ch = _np_splitmix64(iv ^ salt)
+            out[r] = _np_splitmix64(((out[r] * _COMBINE_MULT[variant]) + ch) & _M64)
+    res = np.array([v - (1 << 64) if v >= (1 << 63) else v for v in out],
+                   np.int64)
+    return res
 
 
 def _dec_scale(dt: T.DataType) -> int:
@@ -1034,6 +1221,12 @@ def _values_to_arrow(vals: np.ndarray, valid: np.ndarray,
         py = [None if (mask is not None and mask[i]) else str(vals[i])
               for i in range(len(vals))]
         return pa.array(py, pa.string())
+    if dt == T.BINARY:
+        py = [None if (mask is not None and mask[i])
+              else (vals[i] if isinstance(vals[i], bytes)
+                    else str(vals[i]).encode())
+              for i in range(len(vals))]
+        return pa.array(py, pa.binary())
     if isinstance(dt, T.DecimalType):
         import decimal
         with decimal.localcontext() as dctx:

@@ -69,14 +69,17 @@ class CpuAggregateExec(CpuExec, UnaryExec):
         agg_inputs = []
         for j, e in enumerate(self.agg_exprs):
             func, name = _strip_alias(e)
-            bound = type(func)(E.resolve(func.children[0], cs)) if func.children \
-                else func
+            params = getattr(func, "_params", ())
+            bound = (type(func)(*[E.resolve(c, cs) for c in func.children],
+                                *params)
+                     if func.children else func)
             if func.children:
                 vals, valid = cpu_eval(bound.children[0], t, cs)
             else:
                 vals = np.ones(t.num_rows)
                 valid = np.ones(t.num_rows, np.bool_)
-            agg_inputs.append((bound, name, vals, valid))
+            extra = [cpu_eval(c, t, cs) for c in bound.children[1:]]
+            agg_inputs.append((bound, name, vals, valid, extra))
 
         n = t.num_rows
         groups = {}
@@ -115,7 +118,7 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                                        else None))
             if out_arrays[-1].type != kdt.arrow_type():
                 out_arrays[-1] = out_arrays[-1].cast(kdt.arrow_type())
-        for (bound, name, vals, valid), f in zip(
+        for (bound, name, vals, valid, extra), f in zip(
                 agg_inputs, list(schema)[len(key_names):]):
             out = []
             in_dt = bound.children[0].dtype if bound.children else None
@@ -198,10 +201,86 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                     out.append(int(len(set(
                         v.item() if hasattr(v, "item") else v
                         for v in vals[sel]))))
-                elif isinstance(bound, (E.First, E.Last)):
+                elif isinstance(bound, (E.First, E.Last, E.AnyValue)):
                     idxs = np.nonzero(sel)[0]
-                    out.append(vals[idxs[0 if isinstance(bound, E.First)
-                                         else -1]] if len(idxs) else None)
+                    out.append(vals[idxs[-1 if isinstance(bound, E.Last)
+                                         else 0]] if len(idxs) else None)
+                elif isinstance(bound, E.BoolAnd):  # + BoolOr subclass
+                    if not sel.any():
+                        out.append(None)
+                    elif isinstance(bound, E.BoolOr):
+                        out.append(bool(np.any(vals[sel])))
+                    else:
+                        out.append(bool(np.all(vals[sel])))
+                elif isinstance(bound, E.CountIf):
+                    out.append(int(np.count_nonzero(vals[sel])))
+                elif isinstance(bound, E._CovarianceBase):
+                    yvals, yvalid = extra[0]
+                    psel = (gid == g) & valid & yvalid
+                    nn = int(psel.sum())
+                    if nn == 0:
+                        out.append(None)
+                        continue
+                    x = vals[psel].astype(np.float64)
+                    y = yvals[psel].astype(np.float64)
+                    if dec_in:
+                        x = x / (10.0 ** in_dt.scale)
+                    ydt = bound.children[1].dtype
+                    if isinstance(ydt, T.DecimalType):
+                        y = y / (10.0 ** ydt.scale)
+                    ck = float((x * y).sum()) - x.sum() * y.sum() / nn
+                    if isinstance(bound, E.CovarPop):
+                        out.append(ck / nn)
+                    elif isinstance(bound, E.CovarSamp):
+                        out.append(ck / (nn - 1) if nn > 1 else None)
+                    else:  # Corr
+                        mx = nn * float((x * x).sum()) - x.sum() ** 2
+                        my = nn * float((y * y).sum()) - y.sum() ** 2
+                        den = np.sqrt(max(mx, 0.0) * max(my, 0.0))
+                        num = nn * float((x * y).sum()) - x.sum() * y.sum()
+                        out.append(num / den if den > 0 else None)
+                elif isinstance(bound, E.MinBy):  # + MaxBy subclass
+                    ovals, ovalid = extra[0]
+                    osel = (gid == g) & ovalid
+                    if not osel.any():
+                        out.append(None)
+                        continue
+                    idxs = np.nonzero(osel)[0]
+                    ox = np.asarray(ovals[idxs])
+                    if ox.dtype.kind == "f":
+                        # Spark float order: NaN is the GREATEST value
+                        ox = np.where(np.isnan(ox), np.inf, ox)
+                    pick = idxs[np.argmax(ox) if isinstance(bound, E.MaxBy)
+                                else np.argmin(ox)]
+                    out.append(vals[pick] if valid[pick] else None)
+                elif isinstance(bound, E.BitAndAgg):  # + Or/Xor subclasses
+                    if not sel.any():
+                        out.append(None)
+                    else:
+                        xs = [int(v) for v in vals[sel]]
+                        acc = xs[0]
+                        for v in xs[1:]:
+                            if isinstance(bound, E.BitXorAgg):
+                                acc ^= v
+                            elif isinstance(bound, E.BitOrAgg):
+                                acc |= v
+                            else:
+                                acc &= v
+                        out.append(acc)
+                elif isinstance(bound, E.Percentile):  # + Median subclass
+                    if not sel.any():
+                        out.append(None)
+                    else:
+                        x = np.sort(vals[sel].astype(np.float64))
+                        if dec_in:
+                            x = x / (10.0 ** in_dt.scale)
+                        # Spark exact percentile: linear interpolation at
+                        # rank p*(n-1)
+                        p = bound.percentage
+                        r = p * (len(x) - 1)
+                        lo = int(np.floor(r))
+                        hi = int(np.ceil(r))
+                        out.append(float(x[lo] + (x[hi] - x[lo]) * (r - lo)))
                 else:
                     raise NotImplementedError(type(bound).__name__)
             if isinstance(f.dtype, T.DecimalType):

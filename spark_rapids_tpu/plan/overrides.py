@@ -55,7 +55,10 @@ _DEVICE_EXPRS = (
     E.Sqrt, E.Floor, E.Ceil, E.Round, E.Exp, E.Log, E.Pow,
     E.Log10, E.Log2, E.Log1p, E.Expm1, E.Cbrt, E.Signum,
     E.Sin, E.Cos, E.Tan, E.Asin, E.Acos, E.Atan, E.Sinh, E.Cosh, E.Tanh,
+    E.Asinh, E.Acosh, E.Atanh, E.Cot, E.Sec, E.Csc,
     E.ToDegrees, E.ToRadians, E.Atan2, E.Hypot,
+    E.BRound, E.Factorial, E.Positive, E.BitCount, E.BitGet,
+    E.Murmur3Hash, E.XxHash64,
     E.Greatest, E.Least, E.NullIf, E.Nvl2,
     E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor, E.BitwiseNot,
     E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned,
@@ -63,17 +66,25 @@ _DEVICE_EXPRS = (
     E.Hour, E.Minute, E.Second, E.WeekOfYear, E.LastDay, E.AddMonths,
     E.MonthsBetween, E.TruncDate, E.NextDay, E.UnixTimestampOf,
     E.FromUnixTime, E.Nanvl, E.Rint,
+    E.FromUTCTimestamp, E.ToUTCTimestamp, E.MakeDate, E.MakeTimestamp,
+    E.TimestampSeconds, E.TimestampMillis, E.TimestampMicros,
+    E.UnixSeconds, E.UnixMillis, E.UnixMicros, E.UnixDate,
+    E.DateFromUnixDate,
     E.OctetLength, E.BitLength, E.StringLeft, E.StringRight,
     E.DateAdd, E.DateSub, E.DateDiff,
     E.Length, E.Upper, E.Lower, E.StartsWith, E.EndsWith, E.Contains,
     E.Substring,
     E.Concat, E.ConcatWs, E.StringTrim, E.StringReplace, E.Like, E.RLike,
-    E.StringInstr, E.StringLocate, E.StringLPad, E.StringRepeat,
+    E.StringInstr, E.StringLocate, E.StringLPad, E.StringRPad,
+    E.StringRepeat, E.StringTrimLeft, E.StringTrimRight,
     E.StringReverse, E.StringTranslate, E.InitCap, E.SubstringIndex,
-    E.Ascii, E.Chr,
+    E.Ascii, E.Chr, E.Hex, E.Unhex, E.Base64, E.UnBase64, E.Overlay,
+    E.FindInSet,
     E.Sum, E.Count, E.Min, E.Max, E.Average, E.First, E.Last,
     E.VarianceSamp, E.VariancePop, E.StddevSamp, E.StddevPop,
     E.Skewness, E.Kurtosis,
+    E.BoolAnd, E.BoolOr, E.CountIf, E.AnyValue,
+    E.Corr, E.CovarSamp, E.CovarPop, E.MinBy, E.MaxBy,
 )
 
 
@@ -131,9 +142,21 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
                     reasons.append("string ordering comparison not on device")
             # device kernels raise for decimal floor/ceil/round — tag to CPU
             # instead of crashing at execute time
-            if isinstance(bound, (E.Floor, E.Round)) and isinstance(
+            if isinstance(bound, (E.Floor, E.Round, E.BRound)) and isinstance(
                     bound.children[0].dtype, T.DecimalType):
                 reasons.append("decimal floor/ceil/round not on device")
+            # min_by/max_by device path needs a single-word order key and a
+            # fixed-width (or dict) value gather
+            if isinstance(bound, E.MinBy):
+                odt = bound.children[1].dtype
+                vdt = bound.children[0].dtype
+                if (odt in T.FRACTIONAL_TYPES
+                        or odt in (T.STRING, T.BINARY)
+                        or isinstance(odt, T.DecimalType)
+                        or vdt in (T.STRING, T.BINARY)
+                        or isinstance(vdt, T.DecimalType)):
+                    reasons.append(
+                        "min_by/max_by ordering/value type not on device")
             # decimal division/remainder needs exact wide intermediates
             # (reference: jni DecimalUtils.divide128) — CPU fallback for now
             if isinstance(bound, (E.Divide, E.IntegralDivide, E.Remainder,

@@ -1,0 +1,83 @@
+"""Timezone transition tables for device-side timestamp conversion.
+
+The TPU analog of the reference's jni ``GpuTimeZoneDB`` (SURVEY.md §2.11
+item 2): the reference loads the JVM timezone rules into a GPU-resident
+transition table and converts timestamps with a binary search per row.
+Here the table is built once on the host from ``zoneinfo`` and becomes a
+(sorted starts, offsets) pair the device kernels ``searchsorted`` into —
+one vectorized lookup per batch, no per-row host work.
+
+Tables are cached per zone id; a zone with no DST has a single entry.
+Ambiguous local times (DST fall-back overlaps) resolve to the EARLIER
+offset, matching java.time's ``ZonedDateTime.of`` default that Spark uses.
+"""
+
+from __future__ import annotations
+
+import datetime
+import functools
+from typing import Tuple
+
+import numpy as np
+
+_US = 1_000_000
+_UTC = datetime.timezone.utc
+_EPOCH = datetime.datetime(1970, 1, 1, tzinfo=_UTC)
+# probe window: the reference's GpuTimeZoneDB similarly materializes a
+# bounded transition range and clamps outside it
+_LO = int((datetime.datetime(1900, 1, 1, tzinfo=_UTC) - _EPOCH)
+          .total_seconds()) * _US
+_HI = int((datetime.datetime(2100, 1, 1, tzinfo=_UTC) - _EPOCH)
+          .total_seconds()) * _US
+_DAY = 86_400 * _US
+
+
+def _offset_us(zone, utc_us: int) -> int:
+    dt = _EPOCH + datetime.timedelta(microseconds=utc_us)
+    return int(dt.astimezone(zone).utcoffset().total_seconds()) * _US
+
+
+@functools.lru_cache(maxsize=None)
+def utc_transitions(tz: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(starts_us, offsets_us), both int64 sorted: ``offsets[i]`` applies
+    for UTC instants in ``[starts[i], starts[i+1])``. ``starts[0]`` is a
+    -inf sentinel so every instant has an offset."""
+    from zoneinfo import ZoneInfo
+
+    zone = ZoneInfo(tz)
+    starts = [np.iinfo(np.int64).min]
+    offsets = [_offset_us(zone, _LO)]
+    t = _LO
+    cur = offsets[0]
+    while t < _HI:
+        nxt = t + _DAY
+        o = _offset_us(zone, nxt)
+        if o != cur:
+            # bisect the day to the exact transition second
+            lo, hi = t, nxt
+            while hi - lo > _US:
+                mid = (lo + hi) // 2 // _US * _US
+                if mid <= lo:
+                    mid = lo + _US
+                if _offset_us(zone, mid) == cur:
+                    lo = mid
+                else:
+                    hi = mid
+            starts.append(hi)
+            offsets.append(o)
+            cur = o
+        t = nxt
+    return (np.asarray(starts, np.int64), np.asarray(offsets, np.int64))
+
+
+@functools.lru_cache(maxsize=None)
+def local_transitions(tz: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(local_starts_us, offsets_us, prev_offsets_us) for LOCAL wall-time
+    lookup (to_utc direction): entry i applies from the wall time at which
+    transition i takes effect. ``prev_offsets[i]`` is the offset before the
+    transition, used to resolve fall-back overlaps to the earlier offset."""
+    starts, offsets = utc_transitions(tz)
+    local_starts = starts.copy()
+    local_starts[1:] = starts[1:] + offsets[1:]
+    prev = np.concatenate([offsets[:1], offsets[:-1]])
+    return local_starts, offsets, prev

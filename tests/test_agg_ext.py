@@ -1,0 +1,159 @@
+"""Round-4 aggregate breadth: bool_and/or, count_if, any_value, corr,
+covar_samp/pop, min_by/max_by (device) + bit_and/or/xor, percentile, median
+(CPU engine) — all differential device-vs-CPU (reference:
+GpuOverrides aggregate rules; integration_tests hash_aggregate_test.py).
+"""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs.expr import col, lit
+from spark_rapids_tpu.plan import from_arrow
+
+
+def table(rng):
+    n = 500
+    k = rng.integers(0, 7, n)
+    x = rng.uniform(-10, 10, n)
+    y = 2.5 * x + rng.normal(0, 1, n)
+    b = rng.integers(0, 2, n).astype(bool)
+    o = rng.integers(0, 1000, n)
+    return pa.table({
+        "k": pa.array(k, pa.int64()),
+        "x": pa.array([None if i % 11 == 0 else float(v)
+                       for i, v in enumerate(x)], pa.float64()),
+        "y": pa.array([None if i % 13 == 0 else float(v)
+                       for i, v in enumerate(y)], pa.float64()),
+        "b": pa.array([None if i % 17 == 0 else bool(v)
+                       for i, v in enumerate(b)], pa.bool_()),
+        "o": pa.array(o, pa.int64()),
+        "w": pa.array(rng.integers(0, 255, n), pa.int64()),
+        "s": pa.array(np.array(["aa", "bb", "cc"])[rng.integers(0, 3, n)]),
+    })
+
+
+def both(t, build):
+    out = []
+    for enabled in (True, False):
+        conf = RapidsConf({"spark.rapids.tpu.sql.enabled": enabled})
+        df = from_arrow(t, conf, batch_rows=128)
+        df.shuffle_partitions = 3
+        out.append(build(df).collect())
+    return out
+
+
+def assert_same(t, build, approx=()):
+    dev, cpu = both(t, build)
+    assert len(dev) == len(cpu)
+    for ra, rb in zip(dev, cpu):
+        assert ra.keys() == rb.keys()
+        for kk in ra:
+            va, vb = ra[kk], rb[kk]
+            if va is None or vb is None:
+                assert va == vb, f"{kk}: {va!r} vs {vb!r}\n{ra}\n{rb}"
+            elif kk in approx or isinstance(va, float):
+                if isinstance(va, float) and (math.isnan(va)
+                                              or math.isnan(vb)):
+                    assert math.isnan(va) == math.isnan(vb), (kk, ra, rb)
+                else:
+                    assert abs(va - vb) <= 1e-6 * max(1.0, abs(va)), (
+                        kk, va, vb)
+            else:
+                assert va == vb, f"{kk}: {va!r} vs {vb!r}"
+    return dev
+
+
+def test_bool_and_or_countif(rng):
+    t = table(rng)
+    dev = assert_same(t, lambda df: df.group_by("k").agg(
+        E.BoolAnd(col("b")).alias("ba"),
+        E.BoolOr(col("b")).alias("bo"),
+        E.CountIf(E.GreaterThan(col("x"), lit(0.0))).alias("ci"),
+        E.AnyValue(col("o")).alias("av"),
+    ).sort("k"))
+    assert all(isinstance(r["ci"], int) for r in dev)
+    stats_df = from_arrow(t, RapidsConf({}))
+    q = stats_df.group_by("k").agg(E.BoolAnd(col("b")).alias("ba"))
+    assert q.device_plan_stats()["device_fraction"] == 1.0
+
+
+def test_corr_covar(rng):
+    t = table(rng)
+    dev = assert_same(t, lambda df: df.group_by("k").agg(
+        E.Corr(col("x"), col("y")).alias("r"),
+        E.CovarSamp(col("x"), col("y")).alias("cs"),
+        E.CovarPop(col("x"), col("y")).alias("cp"),
+    ).sort("k"))
+    # x and y are strongly correlated by construction
+    assert all(r["r"] is None or r["r"] > 0.9 for r in dev)
+    q = (from_arrow(t, RapidsConf({})).group_by("k")
+         .agg(E.Corr(col("x"), col("y")).alias("r")))
+    assert q.device_plan_stats()["device_fraction"] == 1.0
+
+
+def test_corr_covar_global_and_edge():
+    # n=1 group: covar_samp -> NULL; constant column: corr -> NULL
+    t = pa.table({
+        "k": pa.array([1, 2, 2], pa.int64()),
+        "x": pa.array([1.0, 3.0, 3.0]),
+        "y": pa.array([2.0, 5.0, 7.0]),
+    })
+    dev = assert_same(t, lambda df: df.group_by("k").agg(
+        E.CovarSamp(col("x"), col("y")).alias("cs"),
+        E.Corr(col("x"), col("y")).alias("r"),
+    ).sort("k"))
+    assert dev[0]["cs"] is None            # single pair
+    assert dev[1]["r"] is None             # zero x-variance
+
+
+def test_min_by_max_by(rng):
+    t = table(rng)
+    dev = assert_same(t, lambda df: df.group_by("k").agg(
+        E.MinBy(col("x"), col("o")).alias("mnb"),
+        E.MaxBy(col("x"), col("o")).alias("mxb"),
+        E.MaxBy(col("o"), col("w")).alias("oxw"),
+    ).sort("k"))
+    q = (from_arrow(t, RapidsConf({})).group_by("k")
+         .agg(E.MaxBy(col("o"), col("w")).alias("m")))
+    assert q.device_plan_stats()["device_fraction"] == 1.0
+    # string VALUE or float ORDER falls back to the CPU engine
+    q2 = (from_arrow(t, RapidsConf({})).group_by("k")
+          .agg(E.MaxBy(col("s"), col("o")).alias("m")))
+    assert q2.device_plan_stats()["cpu_nodes"]
+    assert_same(t, lambda df: df.group_by("k").agg(
+        E.MaxBy(col("s"), col("o")).alias("m")).sort("k"))
+
+
+def test_bit_aggs_cpu(rng):
+    t = table(rng)
+    dev = assert_same(t, lambda df: df.group_by("k").agg(
+        E.BitAndAgg(col("w")).alias("ba"),
+        E.BitOrAgg(col("w")).alias("bo"),
+        E.BitXorAgg(col("w")).alias("bx"),
+    ).sort("k"))
+    assert all(0 <= r["bo"] <= 255 for r in dev)
+
+
+def test_percentile_median_cpu(rng):
+    t = table(rng)
+    assert_same(t, lambda df: df.group_by("k").agg(
+        E.Percentile(col("o"), 0.25).alias("p25"),
+        E.Median(col("o")).alias("med"),
+    ).sort("k"))
+
+
+def test_global_new_aggs(rng):
+    t = table(rng)
+    dev = assert_same(t, lambda df: df.agg(
+        E.CountIf(E.GreaterThan(col("o"), lit(500))).alias("ci"),
+        E.BoolOr(col("b")).alias("bo"),
+        E.Corr(col("x"), col("y")).alias("r"),
+        E.MaxBy(col("o"), col("w")).alias("mb"),
+    ))
+    assert dev[0]["r"] > 0.9

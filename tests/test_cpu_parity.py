@@ -199,6 +199,46 @@ CASES = {
                  E.StringLeft(col("s"), 0)],
     "nanvl_rint": [E.Nanvl(col("f"), col("g")), E.Rint(col("f")),
                    E.Rint(col("g"))],
+    "trig_hyp_inv": [E.Asinh(col("g")),
+                     E.Acosh(E.Add(E.Abs(col("g")), lit(1.0))),
+                     E.Atanh(E.Divide(col("g"), lit(10.0))),
+                     E.Cot(col("g")), E.Sec(col("g")), E.Csc(col("g"))],
+    "bround": [E.BRound(col("f"), 1), E.BRound(col("i"), -1),
+               E.BRound(col("j"), -1), E.BRound(col("g"), 0)],
+    "bit_misc": [E.BitCount(col("j")), E.BitCount(col("b")),
+                 E.BitGet(col("j"), col("i")),
+                 E.Factorial(E.Pmod(col("e"), lit(21))),
+                 E.Positive(col("i"))],
+    "engine_hash": [E.Murmur3Hash(col("i"), col("s")),
+                    E.Murmur3Hash(col("f")),
+                    E.XxHash64(col("s"), col("j")), E.Rand(42)],
+    "pad_trim_r": [E.StringRPad(col("s"), 8, "*"),
+                   E.StringTrimLeft(col("s")), E.StringTrimRight(col("s"))],
+    "codec": [E.Hex(col("s")), E.Hex(col("j")),
+              E.Unhex(E.Hex(col("s"))), E.Base64(col("s")),
+              E.UnBase64(E.Base64(col("s")))],
+    "codec_bad": [E.Unhex(col("s")), E.UnBase64(col("p"))],
+    "overlay_fis": [E.Overlay(col("s"), lit("ZZ"), 2, 3),
+                    E.FindInSet(col("p"), "b,x,SQL,pad")],
+    "tz_convert": [
+        E.FromUTCTimestamp(E.Cast(col("d"), T.TIMESTAMP),
+                           "America/Los_Angeles"),
+        E.ToUTCTimestamp(E.Cast(col("d"), T.TIMESTAMP), "America/New_York"),
+        E.FromUTCTimestamp(E.Cast(col("d"), T.TIMESTAMP), "UTC"),
+        E.FromUTCTimestamp(E.Cast(col("d"), T.TIMESTAMP), "Asia/Kolkata")],
+    "make_dt": [
+        E.MakeDate(E.Add(lit(2000), E.Pmod(col("e"), lit(30))),
+                   E.Pmod(col("e"), lit(14)), E.Pmod(col("j"), lit(32))),
+        E.MakeTimestamp(lit(2024), E.Pmod(col("e"), lit(13)),
+                        E.Pmod(col("j"), lit(29)), E.Pmod(col("i"), lit(24)),
+                        E.Pmod(col("e"), lit(60)),
+                        E.Divide(E.Abs(col("g")), lit(10.0)))],
+    "ts_units": [E.TimestampSeconds(col("i")), E.TimestampMillis(col("j")),
+                 E.TimestampMicros(col("j")),
+                 E.UnixSeconds(E.Cast(col("d"), T.TIMESTAMP)),
+                 E.UnixMillis(E.Cast(col("d"), T.TIMESTAMP)),
+                 E.UnixMicros(E.Cast(col("d"), T.TIMESTAMP)),
+                 E.UnixDate(col("d")), E.DateFromUnixDate(col("e"))],
 }
 
 
@@ -240,6 +280,10 @@ def test_no_device_expr_without_cpu_oracle():
             "GreaterThanOrEqual": "BinaryComparison",
             "Ceil": "Floor", "StringRPad": "StringLPad",
             "StringTrimLeft": "StringTrim", "StringTrimRight": "StringTrim",
+            "TimestampMillis": "TimestampSeconds",
+            "TimestampMicros": "TimestampSeconds",
+            "UnixMillis": "UnixSeconds", "UnixMicros": "UnixSeconds",
+            "ToUTCTimestamp": "FromUTCTimestamp",
         }.get(name, name)
         if not re.search(r"\bE\." + base_handled + r"\b", src):
             missing.append(name)
