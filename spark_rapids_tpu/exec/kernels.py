@@ -424,6 +424,15 @@ def keys_equal(
         if ca.is_dict and cb.is_dict and ca.dictionary is cb.dictionary:
             # shared dictionary: codes compare exactly
             ceq = ca.data[a_idx] == cb.data[b_idx]
+        elif ca.is_wide_decimal or cb.is_wide_decimal:
+            def limbs(c, idx):
+                lo = c.data.astype(jnp.int64)[idx]
+                if c.data2 is not None:
+                    return c.data2[idx], lo
+                return jnp.where(lo < 0, jnp.int64(-1), jnp.int64(0)), lo
+            ha, la = limbs(ca, a_idx)
+            hb, lb = limbs(cb, b_idx)
+            ceq = (ha == hb) & (la == lb)
         elif (ca.offsets is not None or ca.is_dict
               or cb.offsets is not None or cb.is_dict):
             ceq = _string_eq_at(ca, a_idx, cb, b_idx)

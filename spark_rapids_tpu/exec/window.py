@@ -205,6 +205,18 @@ class WindowExec(UnaryExec):
                     ) -> DeviceColumn:
         if isinstance(f, W.RowNumber):
             return _icol(T.INT, idx - seg_start + 1, active)
+        if isinstance(f, W.PercentRank):
+            rank = (run_start - seg_start).astype(jnp.float64)
+            denom = (seg_end - seg_start).astype(jnp.float64)
+            data = jnp.where(denom > 0, rank / jnp.maximum(denom, 1.0), 0.0)
+            return DeviceColumn(T.DOUBLE, jnp.where(active, data, 0.0),
+                                active)
+        if isinstance(f, W.CumeDist):
+            inc = (run_end - seg_start + 1).astype(jnp.float64)
+            total = (seg_end - seg_start + 1).astype(jnp.float64)
+            data = inc / jnp.maximum(total, 1.0)
+            return DeviceColumn(T.DOUBLE, jnp.where(active, data, 0.0),
+                                active)
         if isinstance(f, W.Rank):
             return _icol(T.INT, run_start - seg_start + 1, active)
         if isinstance(f, W.DenseRank):
@@ -245,10 +257,75 @@ class WindowExec(UnaryExec):
         return self._agg_window(f, frame, sctx, idx, active, seg_start,
                                 seg_end, run_start, run_end, cap)
 
+    def _frame_bounds(self, frame, sctx, idx, seg_start, seg_end,
+                      run_start, run_end, cap):
+        """Per-row inclusive frame row bounds (lo, hi); empty = hi < lo.
+
+        Bounded RANGE frames bisect the (sorted) order-key values within
+        each segment — the device analog of the reference's value-bounded
+        windows (GpuWindowExpression range frames); the planner gates these
+        to a single ascending non-float order key."""
+        if frame.is_unbounded_both:
+            return seg_start, seg_end
+        if frame.kind == "rows":
+            lo = seg_start if frame.start is W.UNBOUNDED else jnp.maximum(
+                idx + frame.start, seg_start)
+            hi = seg_end if frame.end is W.UNBOUNDED else jnp.minimum(
+                idx + frame.end, seg_end)
+            return lo, hi
+        if frame.start is W.UNBOUNDED and frame.end == 0:
+            return seg_start, run_end
+        if frame.start == 0 and frame.end is W.UNBOUNDED:
+            return run_start, seg_end
+        # bounded RANGE: value search over the sorted order key
+        ob, asc, _nf = self._order_bound[0]
+        v = EV.eval_expr(ob, sctx)
+        okey = v.data.astype(jnp.int64)
+        onull = ~v.validity
+        steps = max(int(np.ceil(np.log2(max(cap, 2)))) + 1, 1)
+
+        def bisect_left(target, take_left):
+            lo = seg_start
+            hi = seg_end + 1
+            for _ in range(steps):
+                cont = lo < hi
+                mid = (lo + hi) // 2
+                mid_c = jnp.clip(mid, 0, cap - 1)
+                kv = okey[mid_c]
+                kn = onull[mid_c]
+                # nulls sort FIRST ascending: null key compares below all
+                go_right = kn | jnp.where(take_left, kv < target,
+                                          kv <= target)
+                lo = jnp.where(cont & go_right, mid + 1, lo)
+                hi = jnp.where(cont & ~go_right, mid, hi)
+            return lo
+
+        ones_b = jnp.ones(cap, jnp.bool_)
+        if frame.start is W.UNBOUNDED:
+            L = seg_start
+        else:
+            L = bisect_left(okey + frame.start, ones_b)
+        if frame.end is W.UNBOUNDED:
+            H = seg_end
+        else:
+            H = bisect_left(okey + frame.end, ~ones_b) - 1
+        # null order rows: the frame is exactly the null peer group
+        L = jnp.where(onull, run_start, L)
+        H = jnp.where(onull, run_end, H)
+        return L, H
+
     def _agg_window(self, f, frame, sctx, idx, active, seg_start, seg_end,
                     run_start, run_end, cap) -> DeviceColumn:
+        wide_out = (isinstance(f.dtype, T.DecimalType)
+                    and f.dtype.precision > T.DecimalType.MAX_LONG_DIGITS)
         if f.children:
             v = EV.eval_expr(f.children[0], sctx)
+            if isinstance(v, EV.WideVal) or (
+                    wide_out and isinstance(f, (E.Sum, E.Average))):
+                lo, hi = self._frame_bounds(frame, sctx, idx, seg_start,
+                                            seg_end, run_start, run_end,
+                                            cap)
+                return self._wide_agg_window(f, v, active, lo, hi, cap)
             assert isinstance(v, EV.ColVal), "string window aggs: min/max only via runs"
             vals, valid = v.data, v.validity & active
         else:
@@ -259,63 +336,205 @@ class WindowExec(UnaryExec):
         count_all = is_count and not f.children
         contributing = active if count_all else valid
 
-        sum_t = jnp.float64 if jnp.issubdtype(vals.dtype, jnp.floating) \
-            else jnp.int64
-        masked = jnp.where(contributing, vals.astype(sum_t), 0)
-        ones = contributing.astype(jnp.int64)
         seg_flag = idx == seg_start
+        lo, hi = self._frame_bounds(frame, sctx, idx, seg_start, seg_end,
+                                    run_start, run_end, cap)
+        empty = hi < lo
+        lo_c = jnp.clip(lo, 0, cap - 1)
+        hi_c = jnp.clip(hi, 0, cap - 1)
 
-        if frame.is_unbounded_both:
-            seg_id = jnp.cumsum(seg_flag.astype(jnp.int32)) - 1
-            seg_id = jnp.clip(seg_id, 0, cap - 1)
-            if isinstance(f, (E.Min, E.Max)):
-                red, rvalid = K.segment_agg(vals, valid, active, seg_id, cap,
-                                            "min" if isinstance(f, E.Min) else "max")
+        if isinstance(f, (E.First, E.Last)):
+            # engine-wide First/Last semantics: first/last NON-NULL value
+            # in the frame (matching HashAggregateExec and the CPU engine);
+            # variable frames find the position with a sparse-table query
+            first = isinstance(f, E.First)
+            sentinel = cap if first else -1
+            pos = jnp.where(valid, idx, sentinel)
+            op = jnp.minimum if first else jnp.maximum
+            tbl = _sparse_table(pos.astype(jnp.int32), op,
+                                jnp.int32(sentinel), cap)
+            at = _sparse_query(tbl, op, lo_c, hi_c, cap)
+            found = ~empty & active & (at != sentinel)
+            at_c = jnp.clip(at, 0, cap - 1)
+            data = jnp.where(found, vals[at_c], jnp.zeros_like(vals[:1]))
+            return _win_out(out_t, data, found, active)
+
+        if isinstance(f, (E.Min, E.Max)):
+            # specialized O(n) paths where the frame shape allows; RMQ
+            # sparse table for value-bounded (variable-width) frames
+            if frame.is_unbounded_both:
+                seg_id = jnp.cumsum(seg_flag.astype(jnp.int32)) - 1
+                seg_id = jnp.clip(seg_id, 0, cap - 1)
+                red, rvalid = K.segment_agg(
+                    vals, valid, active, seg_id, cap,
+                    "min" if isinstance(f, E.Min) else "max")
                 return _win_out(out_t, red[seg_id], rvalid[seg_id], active)
-            s = jax.ops.segment_sum(masked, seg_id, num_segments=cap)
-            c = jax.ops.segment_sum(ones, seg_id, num_segments=cap)
-            return _finish_agg(f, out_t, s[seg_id], c[seg_id], active)
-
-        if frame.kind == "rows" and frame.start is W.UNBOUNDED and frame.end == 0:
-            s = _segmented_scan(masked, seg_flag, jnp.add)
-            c = _segmented_scan(ones, seg_flag, jnp.add)
-            if isinstance(f, (E.Min, E.Max)):
-                return self._scan_minmax(f, vals, valid, seg_flag, c, out_t,
-                                         active, None, idx)
-            return _finish_agg(f, out_t, s, c, active)
-
-        if frame.kind == "range" and frame.start is W.UNBOUNDED and frame.end == 0:
-            # peers included: value of the scan at the run end
-            s = _segmented_scan(masked, seg_flag, jnp.add)
-            c = _segmented_scan(ones, seg_flag, jnp.add)
-            re_c = jnp.clip(run_end, 0, cap - 1)
-            if isinstance(f, (E.Min, E.Max)):
-                return self._scan_minmax(f, vals, valid, seg_flag, c, out_t,
-                                         active, re_c, idx)
-            return _finish_agg(f, out_t, s[re_c], c[re_c], active)
-
-        if frame.kind == "rows":
-            a = frame.start
-            b = frame.end
-            assert a is not W.UNBOUNDED and b is not W.UNBOUNDED
-            if isinstance(f, (E.Min, E.Max)):
+            if frame.kind == "rows" and frame.start is W.UNBOUNDED \
+                    and frame.end == 0:
+                c_run = _segmented_scan(contributing.astype(jnp.int64),
+                                        seg_flag, jnp.add)
+                return self._scan_minmax(f, vals, valid, seg_flag, c_run,
+                                         out_t, active, None, idx)
+            if frame.kind == "range" and frame.start is W.UNBOUNDED \
+                    and frame.end == 0:
+                c_run = _segmented_scan(contributing.astype(jnp.int64),
+                                        seg_flag, jnp.add)
+                re_c = jnp.clip(run_end, 0, cap - 1)
+                return self._scan_minmax(f, vals, valid, seg_flag, c_run,
+                                         out_t, active, re_c, idx)
+            if frame.kind == "rows" and frame.start is not W.UNBOUNDED \
+                    and frame.end is not W.UNBOUNDED:
                 return self._bounded_minmax(f, vals, valid, active, seg_flag,
-                                            seg_start, seg_end, idx, a, b,
-                                            out_t, cap)
-            pre_s = jnp.cumsum(masked)
-            pre_c = jnp.cumsum(ones)
-            lo = jnp.maximum(idx + a, seg_start)
-            hi = jnp.minimum(idx + b, seg_end)
-            empty = hi < lo
-            lo_c = jnp.clip(lo, 0, cap - 1)
-            hi_c = jnp.clip(hi, 0, cap - 1)
-            s = pre_s[hi_c] - pre_s[lo_c] + masked[lo_c]
-            c = pre_c[hi_c] - pre_c[lo_c] + ones[lo_c]
-            s = jnp.where(empty, 0, s)
-            c = jnp.where(empty, 0, c)
-            return _finish_agg(f, out_t, s, c, active)
+                                            seg_start, seg_end, idx,
+                                            frame.start, frame.end, out_t,
+                                            cap)
+            return self._rmq_minmax(f, vals, valid, active, lo_c, hi_c,
+                                    empty, out_t, cap)
 
-        raise NotImplementedError(f"window frame {frame!r}")
+        # sum family (sum/count/avg/variance/stddev) over [lo, hi] via
+        # NaN-safe inclusive prefix sums: one cumsum per lane, two gathers
+        # per row — every frame kind, fixed or value-bounded, same cost
+        is_f = jnp.issubdtype(vals.dtype, jnp.floating)
+        if is_f:
+            d, is_nan = K._float_canonical(vals)
+            clean = contributing & ~is_nan
+            nan_row = (contributing & is_nan).astype(jnp.int32)
+        else:
+            d = vals
+            clean = contributing
+            nan_row = None
+        sum_t = jnp.float64 if is_f else jnp.int64
+        masked = jnp.where(clean, d.astype(sum_t), 0)
+        ones = contributing.astype(jnp.int64)
+
+        def win(x):
+            pre = jnp.cumsum(x)
+            w = pre[hi_c] - pre[lo_c] + x[lo_c]
+            return jnp.where(empty, jnp.zeros_like(w), w)
+
+        s = win(masked)
+        c = win(ones)
+        if nan_row is not None:
+            nan_in = win(nan_row) > 0
+            s = jnp.where(nan_in, jnp.float64(jnp.nan), s)
+        if isinstance(f, E._VarianceBase):
+            s2 = win(masked.astype(jnp.float64) ** 2)
+            n = jnp.maximum(c, 1).astype(jnp.float64)
+            mean = s.astype(jnp.float64) / n
+            m2 = jnp.maximum(s2 - n * mean * mean, 0.0)
+            samp = isinstance(f, (E.VarianceSamp, E.StddevSamp))
+            den = jnp.maximum(n - 1, 1) if samp else n
+            var = m2 / den
+            data = jnp.sqrt(var) if isinstance(
+                f, (E.StddevSamp, E.StddevPop)) else var
+            ok = (c > 1) if samp else (c > 0)
+            return _win_out(out_t, data, ok, active)
+        return _finish_agg(f, out_t, s, c, active)
+
+    def _wide_agg_window(self, f, v, active, lo, hi, cap) -> DeviceColumn:
+        """DECIMAL128 window sum/avg/first/last via 128-bit (hi, lo)
+        prefix scans (the device replacement for the reference's wide
+        window aggregations; sums merge exactly mod 2^128 with
+        overflow-to-NULL at the result precision)."""
+        from spark_rapids_tpu.exec import int128 as I128
+
+        out_t = f.dtype
+        empty = hi < lo
+        lo_c = jnp.clip(lo, 0, cap - 1)
+        hi_c = jnp.clip(hi, 0, cap - 1)
+        if isinstance(v, EV.WideVal):
+            xh, xl = v.hi, v.lo
+            in_scale = f.children[0].dtype.scale
+        else:
+            xh, xl = I128.from_i64(v.data.astype(jnp.int64))
+            in_scale = f.children[0].dtype.scale
+        contributing = v.validity & active
+        mh = jnp.where(contributing, xh, 0)
+        ml = jnp.where(contributing, xl, 0)
+
+        if isinstance(f, (E.First, E.Last)):
+            first = isinstance(f, E.First)
+            sentinel = cap if first else -1
+            pos = jnp.where(contributing, jnp.arange(cap, dtype=jnp.int32),
+                            sentinel)
+            op = jnp.minimum if first else jnp.maximum
+            tbl = _sparse_table(pos, op, jnp.int32(sentinel), cap)
+            at = _sparse_query(tbl, op, lo_c, hi_c, cap)
+            found = ~empty & active & (at != sentinel)
+            at_c = jnp.clip(at, 0, cap - 1)
+            return DeviceColumn(
+                out_t, jnp.where(found, xl[at_c], 0), found,
+                data2=jnp.where(found, xh[at_c], 0))
+
+        def comb(a, b):
+            return I128.add(a[0], a[1], b[0], b[1])
+
+        ph, pl = jax.lax.associative_scan(comb, (mh, ml))
+        sh, sl = I128.sub(ph[hi_c], pl[hi_c], ph[lo_c], pl[lo_c])
+        sh, sl = I128.add(sh, sl, mh[lo_c], ml[lo_c])
+        pre_c = jnp.cumsum(contributing.astype(jnp.int64))
+        cnt = pre_c[hi_c] - pre_c[lo_c] + contributing[lo_c]
+        cnt = jnp.where(empty, 0, cnt)
+        has = cnt > 0
+        if isinstance(f, E.Average):
+            d = out_t.scale - in_scale
+            oh, ol, ovf = I128.decimal_avg_128(sh, sl, cnt, d,
+                                               out_t.precision)
+            ok = has & active & ~ovf
+            if out_t.precision > T.DecimalType.MAX_LONG_DIGITS:
+                return DeviceColumn(out_t, jnp.where(ok, ol, 0), ok,
+                                    data2=jnp.where(ok, oh, 0))
+            fits = oh == jnp.where(ol < 0, jnp.int64(-1), jnp.int64(0))
+            ok = ok & fits
+            return DeviceColumn(out_t, jnp.where(ok, ol, 0), ok)
+        # Sum
+        ovf = I128.overflow_mask(sh, sl, out_t.precision)
+        ok = has & active & ~ovf
+        return DeviceColumn(out_t, jnp.where(ok, sl, 0), ok,
+                            data2=jnp.where(ok, sh, 0))
+
+    def _rmq_minmax(self, f, vals, valid, active, lo_c, hi_c, empty, out_t,
+                    cap: int):
+        """Min/max over variable [lo, hi] ranges via a sparse table:
+        log2(cap) doubling levels, then each row combines two overlapping
+        power-of-two blocks. O(n log n) build, O(1) per query — the
+        TPU-shaped answer to value-bounded windows (no per-row loops)."""
+        op = jnp.minimum if isinstance(f, E.Min) else jnp.maximum
+        is_f = jnp.issubdtype(vals.dtype, jnp.floating)
+        if is_f:
+            d, is_nan = K._float_canonical(vals)
+            live = valid & active & ~is_nan
+            ident = jnp.float64(np.inf if isinstance(f, E.Min) else -np.inf)
+            m = jnp.where(live, d, ident)
+            nan_row = (valid & active & is_nan).astype(jnp.int32)
+        else:
+            live = valid & active
+            if vals.dtype == jnp.bool_:
+                ident = isinstance(f, E.Min)
+            else:
+                ii = jnp.iinfo(vals.dtype)
+                ident = ii.max if isinstance(f, E.Min) else ii.min
+            m = jnp.where(live, vals, jnp.full_like(vals, ident))
+            nan_row = None
+
+        tbl = _sparse_table(m, op, jnp.asarray(ident, m.dtype), cap)
+        red = _sparse_query(tbl, op, lo_c, hi_c, cap)
+        # counts for validity via the same prefix-sum trick
+        pre_c = jnp.cumsum(live.astype(jnp.int64))
+        cnt = pre_c[hi_c] - pre_c[lo_c] + live[lo_c]
+        cnt = jnp.where(empty, 0, cnt)
+        has = cnt > 0
+        if is_f:
+            pre_n = jnp.cumsum(nan_row.astype(jnp.int64))
+            nans = pre_n[hi_c] - pre_n[lo_c] + nan_row[lo_c]
+            nan_seen = jnp.where(empty, False, nans > 0)
+            any_val = has | nan_seen
+            if isinstance(f, E.Max):
+                dec = jnp.where(nan_seen, jnp.float64(np.nan), red)
+            else:
+                dec = jnp.where(has, red, jnp.float64(np.nan))
+            return _win_out(out_t, dec.astype(vals.dtype), any_val, active)
+        return _win_out(out_t, red, has, active)
 
     def _bounded_minmax(self, f, vals, valid, active, seg_flag, seg_start,
                         seg_end, idx, a: int, b: int, out_t, cap: int):
@@ -436,6 +655,28 @@ class WindowExec(UnaryExec):
         return _win_out(out_t, red, cnt > 0, active)
 
 
+def _sparse_table(m: jax.Array, op, ident, cap: int) -> jax.Array:
+    """Doubling sparse table for O(1) range reductions over variable
+    [lo, hi] windows: level k covers width 2^k starting at each row."""
+    levels = [m]
+    k = 1
+    while k < cap:
+        prev = levels[-1]
+        shifted = jnp.concatenate([prev[k:], jnp.full(k, ident, prev.dtype)])
+        levels.append(op(prev, shifted))
+        k *= 2
+    return jnp.stack(levels)
+
+
+def _sparse_query(tbl: jax.Array, op, lo_c: jax.Array, hi_c: jax.Array,
+                  cap: int) -> jax.Array:
+    width = jnp.maximum(hi_c - lo_c + 1, 1).astype(jnp.int32)
+    kk = 31 - jax.lax.clz(width)
+    kk = jnp.clip(kk, 0, tbl.shape[0] - 1)
+    second = jnp.clip(hi_c - (1 << kk) + 1, 0, cap - 1)
+    return op(tbl[kk, lo_c], tbl[kk, second])
+
+
 def _rev_flags(flags: jax.Array) -> jax.Array:
     """Segment-start flags in REVERSED coordinates: position i is an original
     segment END iff position i+1 starts a new segment (or i is last)."""
@@ -446,6 +687,8 @@ def _rev_flags(flags: jax.Array) -> jax.Array:
 def _to_col(dtype: T.DataType, v) -> DeviceColumn:
     if isinstance(v, EV.StringVal):
         return DeviceColumn(dtype, v.data, v.validity, v.offsets)
+    if isinstance(v, EV.WideVal):
+        return DeviceColumn(dtype, v.lo, v.validity, data2=v.hi)
     return DeviceColumn(dtype, v.data, v.validity)
 
 

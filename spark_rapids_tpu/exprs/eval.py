@@ -680,9 +680,17 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
 
     if isinstance(expr, E.UnaryMinus):
         c = eval_expr(expr.child, ctx)
+        if isinstance(c, WideVal):
+            from spark_rapids_tpu.exec import int128 as I128
+            h, l = I128.neg(c.hi, c.lo)
+            return WideVal(h, l, c.validity)
         return ColVal(-c.data, c.validity)
     if isinstance(expr, E.Abs):
         c = eval_expr(expr.child, ctx)
+        if isinstance(c, WideVal):
+            from spark_rapids_tpu.exec import int128 as I128
+            h, l = I128.abs_(c.hi, c.lo)
+            return WideVal(h, l, c.validity)
         return ColVal(jnp.abs(c.data), c.validity)
 
     if isinstance(expr, E.Sqrt):
@@ -1411,16 +1419,41 @@ def _eval_arith_wide(expr, out_t: T.DecimalType, lt, rt, l, r,
         return WideVal(jnp.where(ovf, z, h), jnp.where(ovf, z, lo),
                        valid & ~ovf)
     if isinstance(expr, E.Multiply):
-        # scaled product of two NARROW operands: out scale == s1 + s2, the
-        # raw 64x64 -> 128 product IS the result (wide operands stay on CPU)
-        assert isinstance(l, ColVal) and isinstance(r, ColVal), \
-            "decimal128 multiply operands must be DECIMAL64"
-        h, lo = I128.mul_64x64(l.data.astype(jnp.int64),
-                               r.data.astype(jnp.int64))
-        ovf = I128.overflow_mask(h, lo, out_t.precision)
+        # out scale == s1 + s2: the raw product of the scaled values IS the
+        # result, so no rescale — narrow pairs use the 64x64 fast path,
+        # wide operands the exact limb multiply (DecimalUtils.multiply128)
+        if isinstance(l, ColVal) and isinstance(r, ColVal):
+            h, lo = I128.mul_64x64(l.data.astype(jnp.int64),
+                                   r.data.astype(jnp.int64))
+            ovf = I128.overflow_mask(h, lo, out_t.precision)
+        else:
+            s1 = lt.scale if isinstance(lt, T.DecimalType) else 0
+            s2 = rt.scale if isinstance(rt, T.DecimalType) else 0
+            wl = _as_wide(l, lt, s1)
+            wr = _as_wide(r, rt, s2)
+            h, lo, ovf = I128.mul_128_exact(wl.hi, wl.lo, wr.hi, wr.lo,
+                                            out_t.precision)
         z = jnp.zeros_like(h)
         return WideVal(jnp.where(ovf, z, h), jnp.where(ovf, z, lo),
                        valid & ~ovf)
+    if isinstance(expr, E.Divide):
+        # Spark decimal divide: exact ROUND_HALF_UP at the result scale —
+        # q = HALF_UP(a * 10^(s_out - s1 + s2) / b) through the 256/128
+        # Knuth-D kernel (DecimalUtils.divide128 analog)
+        s1 = lt.scale if isinstance(lt, T.DecimalType) else 0
+        s2 = rt.scale if isinstance(rt, T.DecimalType) else 0
+        k = out_t.scale - s1 + s2
+        assert 0 <= k <= 76, "divide rescale outside device range (gated)"
+        wl = _as_wide(l, lt, s1)
+        wr = _as_wide(r, rt, s2)
+        h, lo, ovf = I128.decimal_divide_128(wl.hi, wl.lo, wr.hi, wr.lo, k,
+                                             out_t.precision)
+        # div-by-zero is folded into ovf by the kernel: NULL either way
+        ok = valid & ~ovf
+        z = jnp.zeros_like(h)
+        if _is_wide(out_t):
+            return WideVal(jnp.where(ok, h, z), jnp.where(ok, lo, z), ok)
+        return ColVal(jnp.where(ok, lo, z), ok)
     raise NotImplementedError(f"decimal128 {expr.symbol}")
 
 
@@ -1511,6 +1544,8 @@ def _eval_arith(expr: E.BinaryArithmetic, ctx: EvalContext) -> ColVal:
         if isinstance(expr, E.Multiply):
             # out scale == sa + sb: raw product of scaled values
             return ColVal(a * b, valid)
+        if isinstance(expr, E.Divide):
+            return _eval_arith_wide(expr, out_t, lt, rt, l, r, valid)
         raise NotImplementedError(f"decimal {expr.symbol}")
 
     # decimal ⊗ float -> double (Spark casts the decimal side)

@@ -316,10 +316,18 @@ class Divide(BinaryArithmetic):
         pair = _decimal_operands(self.left.dtype, self.right.dtype)
         if pair is not None:
             lt, rt = pair
-            # Spark: s = max(6, s1 + p2 + 1); p = p1 - s1 + s2 + s
+            # Spark: s = max(6, s1 + p2 + 1); p = p1 - s1 + s2 + s, then
+            # adjustPrecisionScale (allowPrecisionLoss=true default): when
+            # p > 38, keep the integral digits and shrink the scale down to
+            # at most min(s, 6)
             s = max(6, lt.scale + rt.precision + 1)
             p = lt.precision - lt.scale + rt.scale + s
-            return T.DecimalType(min(p, 38), min(s, 38))
+            if p > 38:
+                int_digits = p - s
+                min_scale = min(s, 6)
+                s = max(38 - int_digits, min_scale)
+                p = 38
+            return T.DecimalType(p, s)
         return T.DOUBLE
 
     @property
@@ -1606,6 +1614,42 @@ class Skewness(_VarianceBase):
 
 class Kurtosis(_VarianceBase):
     """Spark kurtosis: excess kurtosis m4/m2^2 - 3."""
+
+
+class GetJsonObject(_Unary):
+    """get_json_object(json_str, path): JSONPath subset ($.a.b[0], $['a'])
+    returning the matched value as a string (scalars unquoted, containers
+    re-serialized compactly). CPU-engine expression (reference: jni
+    JSONUtils GpuGetJsonObject; a device byte-level JSON scanner is future
+    work)."""
+
+    device_supported = False
+
+    def __init__(self, child: Expression, path: str):
+        super().__init__(child)
+        self.path = path
+        self._params = (path,)
+
+    @property
+    def dtype(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return True
+
+
+class JsonToStructsText(_Unary):
+    """from_json lite: validates/normalizes a JSON document to canonical
+    compact text (the struct-typed variant needs struct columns; the
+    reference's GpuJsonToStructs equivalent surface for text round-trips).
+    CPU engine."""
+
+    device_supported = False
+
+    @property
+    def dtype(self):
+        return T.STRING
 
 
 class FromUTCTimestamp(_Unary):

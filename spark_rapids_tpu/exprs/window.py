@@ -140,6 +140,31 @@ class NTile(WindowFunction):
         return False
 
 
+class PercentRank(WindowFunction):
+    """percent_rank() = (rank - 1) / (partition rows - 1), 0.0 for a
+    single-row partition (Spark PercentRank)."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+
+class CumeDist(WindowFunction):
+    """cume_dist() = rows <= current (peers included) / partition rows."""
+
+    @property
+    def dtype(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+
 class Lead(WindowFunction):
     def __init__(self, child: E.Expression, offset: int = 1,
                  default: Optional[E.Expression] = None):

@@ -25,6 +25,10 @@ class CsvScanExec(FileScanBase):
                  null_value: str = "", comment: str = "",
                  quote: str = '"', escape: str = "\\",
                  timestamp_format: Optional[str] = None,
+                 date_format: str = "yyyy-MM-dd",
+                 mode: str = "PERMISSIVE",
+                 corrupt_column: Optional[str] = None,
+                 spark_exact: Optional[bool] = None,
                  **kw):
         super().__init__(paths, columns, **kw)
         self.user_schema = schema
@@ -35,6 +39,14 @@ class CsvScanExec(FileScanBase):
         self.quote = quote
         self.escape = escape
         self.timestamp_format = timestamp_format
+        self.date_format = date_format
+        self.mode = mode
+        self.corrupt_column = corrupt_column
+        # Spark-exact conversion (GpuTextBasedPartitionReader discipline):
+        # decode every cell as a string, then apply Spark's own parsers —
+        # the default whenever a schema pins the types
+        self.spark_exact = (schema is not None if spark_exact is None
+                            else spark_exact)
 
     def _parse_opts(self):
         return pacsv.ParseOptions(
@@ -70,6 +82,27 @@ class CsvScanExec(FileScanBase):
         return t.schema
 
     def _read_path(self, path: str) -> pa.Table:
+        if self.spark_exact and self.user_schema is not None:
+            from spark_rapids_tpu import types as T
+            from spark_rapids_tpu.io.text_parse import (CsvOptions,
+                                                        convert_string_table)
+
+            names = [f.name for f in self.user_schema]
+            ropts = (pacsv.ReadOptions() if self.header
+                     else pacsv.ReadOptions(column_names=names))
+            raw = pacsv.read_csv(
+                path, read_options=ropts,
+                parse_options=self._parse_opts(),
+                convert_options=pacsv.ConvertOptions(
+                    column_types={n: pa.string() for n in names}))
+            raw = raw.select([n for n in names if n in raw.column_names])
+            schema = T.Schema.from_arrow(self.user_schema)
+            opts = CsvOptions(null_value=self.null_value,
+                              date_format=self.date_format,
+                              timestamp_format=self.timestamp_format,
+                              mode=self.mode,
+                              corrupt_column=self.corrupt_column)
+            return convert_string_table(raw, schema, opts)
         return pacsv.read_csv(
             path,
             read_options=self._read_opts(),

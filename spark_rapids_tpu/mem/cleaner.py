@@ -1,0 +1,84 @@
+"""End-of-run leak detection (MemoryCleaner analog).
+
+Reference: the jni ``MemoryCleaner`` shutdown hook the plugin re-registers
+(Plugin.scala:575-590; SURVEY.md §5 "leak detection") — at executor
+shutdown every still-referenced device buffer is reported as a leak.
+Here the net is explicit: pools, spill frameworks and shuffle managers
+register themselves at construction; ``sweep()`` reports anything still
+holding resources, and the test suite's session teardown asserts the
+report is empty (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Dict, List
+
+_lock = threading.Lock()
+_pools: "weakref.WeakSet" = weakref.WeakSet()
+_frameworks: "weakref.WeakSet" = weakref.WeakSet()
+_managers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_pool(pool) -> None:
+    with _lock:
+        _pools.add(pool)
+
+
+def register_framework(fw) -> None:
+    with _lock:
+        _frameworks.add(fw)
+
+
+def register_manager(m) -> None:
+    with _lock:
+        _managers.add(m)
+
+
+def sweep() -> List[str]:
+    """Leak report: non-empty entries mean resources outlived their owners.
+
+    - a pool with outstanding bytes after its users are done
+    - a spill framework still tracking live handles, or spill files left
+      on disk
+    - a shuffle manager with unregistered (never cleaned) shuffles whose
+      files still exist
+    """
+    leaks: List[str] = []
+    with _lock:
+        pools = list(_pools)
+        fws = list(_frameworks)
+        managers = list(_managers)
+    for p in pools:
+        if p.used != 0:
+            leaks.append(f"HbmPool: {p.used} bytes outstanding "
+                         f"(allocs={p.alloc_count})")
+    for fw in fws:
+        handles = getattr(fw, "_handles", None)
+        if handles:
+            live = [h for h in list(handles) if h.state != "closed"]
+            if live:
+                leaks.append(
+                    f"SpillFramework: {len(live)} unclosed handles "
+                    f"({sum(h.nbytes for h in live)} bytes)")
+        for h in list(handles or ()):
+            path = getattr(h, "_disk_path", None)
+            if path and os.path.exists(path) and h.state == "closed":
+                leaks.append(f"SpillFramework: orphan spill file {path}")
+    for m in managers:
+        regs = getattr(m, "_regs", {})
+        for sid, reg in list(regs.items()):
+            files = [mo.path for mo in reg.map_outputs
+                     if mo.path and os.path.exists(mo.path)]
+            if files:
+                leaks.append(
+                    f"ShuffleManager: shuffle {sid} never cleaned "
+                    f"({len(files)} files)")
+    return leaks
+
+
+def assert_clean() -> None:
+    leaks = sweep()
+    assert not leaks, "resource leaks at shutdown:\n" + "\n".join(leaks)

@@ -63,6 +63,8 @@ class HbmPool:
     """
 
     def __init__(self, limit_bytes: int):
+        from spark_rapids_tpu.mem import cleaner
+        cleaner.register_pool(self)
         self.limit = int(limit_bytes)
         self._used = 0
         self._lock = threading.Lock()

@@ -113,6 +113,8 @@ class SpillFramework:
 
     def __init__(self, pool: HbmPool, host_limit_bytes: int = 8 << 30,
                  spill_dir: str = "/tmp/srtpu_spill"):
+        from spark_rapids_tpu.mem import cleaner
+        cleaner.register_framework(self)
         self.pool = pool
         self.host_limit = host_limit_bytes
         self.host_used = 0

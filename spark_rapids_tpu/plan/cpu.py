@@ -706,6 +706,78 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
             (d1 == d2) | both_ends, 0.0, frac)
         out = np.sign(out) * np.floor(np.abs(out) * 1e8 + 0.5) / 1e8
         return out, ma & mb
+    if isinstance(expr, E.GetJsonObject):
+        import json as _json
+        s_, m = ev(expr.child)
+        out, mm = [], m.copy()
+
+        def walk(obj, path):
+            # subset of Spark's path grammar: $, .name, ['name'], [idx]
+            i = 0
+            if not path.startswith("$"):
+                return None, False
+            i = 1
+            cur = obj
+            while i < len(path):
+                if path[i] == ".":
+                    j = i + 1
+                    while j < len(path) and path[j] not in ".[":
+                        j += 1
+                    key = path[i + 1: j]
+                    if not isinstance(cur, dict) or key not in cur:
+                        return None, False
+                    cur = cur[key]
+                    i = j
+                elif path[i] == "[":
+                    j = path.index("]", i)
+                    tok = path[i + 1: j]
+                    if tok.startswith("'") or tok.startswith('"'):
+                        key = tok[1:-1]
+                        if not isinstance(cur, dict) or key not in cur:
+                            return None, False
+                        cur = cur[key]
+                    else:
+                        try:
+                            ix = int(tok)
+                        except ValueError:
+                            return None, False
+                        if not isinstance(cur, list) or not (
+                                -len(cur) <= ix < len(cur)):
+                            return None, False
+                        cur = cur[ix]
+                    i = j + 1
+                else:
+                    return None, False
+            return cur, True
+
+        for i, x in enumerate(s_):
+            try:
+                obj = _json.loads(x)
+                v, ok = walk(obj, expr.path)
+            except (ValueError, TypeError):
+                ok = False
+            if not ok or v is None:
+                out.append("")
+                mm[i] = False
+            elif isinstance(v, str):
+                out.append(v)
+            elif isinstance(v, bool):
+                out.append("true" if v else "false")
+            else:
+                out.append(_json.dumps(v, separators=(",", ":")))
+        return np.array(out, dtype=object), mm
+    if isinstance(expr, E.JsonToStructsText):
+        import json as _json
+        s_, m = ev(expr.child)
+        out, mm = [], m.copy()
+        for i, x in enumerate(s_):
+            try:
+                out.append(_json.dumps(_json.loads(x),
+                                       separators=(",", ":")))
+            except (ValueError, TypeError):
+                out.append("")
+                mm[i] = False
+        return np.array(out, dtype=object), mm
     if isinstance(expr, E.FromUTCTimestamp):
         from spark_rapids_tpu.utils import tzdb
         d, m = ev(expr.child)

@@ -493,6 +493,20 @@ class CpuWindowExec(CpuExec, UnaryExec):
                 res = _rank(df, grouper, okeys, "dense")
             elif isinstance(f, W.NTile):
                 res = _ntile(df, grouper, f.n)
+            elif isinstance(f, W.PercentRank):
+                rk = _rank(df, grouper, okeys, "min").astype(np.float64)
+                cnt = (grouper[okeys[0] if okeys else df.columns[0]]
+                       .transform("size") if grouper is not None
+                       else pd.Series(len(df), df.index)).astype(np.float64)
+                res = np.where(cnt > 1, (rk - 1) / np.maximum(cnt - 1, 1),
+                               0.0)
+                res = pd.Series(res, df.index)
+            elif isinstance(f, W.CumeDist):
+                rk = _rank(df, grouper, okeys, "max").astype(np.float64)
+                cnt = (grouper[okeys[0] if okeys else df.columns[0]]
+                       .transform("size") if grouper is not None
+                       else pd.Series(len(df), df.index)).astype(np.float64)
+                res = pd.Series(rk / np.maximum(cnt, 1), df.index)
             elif isinstance(f, (W.Lead, W.Lag)):
                 vals, valid = cpu_eval(E.resolve(f.child, cs), t, cs)
                 data = np.asarray(vals, dtype=object)
@@ -548,41 +562,42 @@ def _rank(df, grouper, okeys, method):
     if not okeys:
         return pd.Series(1, df.index)
     key = df[okeys].apply(tuple, axis=1)
-    if grouper is None:
+
+    def rank_sorted(keys):
         # rows are already sorted by the (asc/desc-aware) order keys —
         # pandas .rank() would re-rank by raw value ASC, inverting desc
-        # keys (round-3 q44 bug); rank = position of first equal instead
+        # keys (round-3 q44 bug). min = first position of equal run,
+        # max = last position (cume_dist), dense = run ordinal
         first_pos = {}
+        counts = {}
         seen = 0
         dense = 0
-        ranks = []
-        prev = object()
-        for v in key:
+        dense_of = []
+        vals = list(keys)
+        for v in vals:
             seen += 1
-            if v != prev:
+            if v not in first_pos or (seen > 1 and v != vals[seen - 2]):
                 dense += 1
                 first_pos[v] = seen
-                prev = v
-            ranks.append(first_pos[v] if method == "min" else dense)
-        return pd.Series(ranks, df.index)
-    # rank of the order tuple within each partition, respecting sort order:
-    # rows are already partition-sorted, so rank = position of first equal
+                counts[v] = 0
+            counts[v] += 1
+            dense_of.append(dense)
+        out = []
+        for i, v in enumerate(vals):
+            if method == "min":
+                out.append(first_pos[v])
+            elif method == "max":
+                out.append(first_pos[v] + counts[v] - 1)
+            else:
+                out.append(dense_of[i])
+        return out
+
+    if grouper is None:
+        return pd.Series(rank_sorted(key), df.index)
     out = []
     for _, g in grouper:
         gk = g[okeys].apply(tuple, axis=1)
-        first_pos = {}
-        seen = 0
-        ranks = []
-        dense = 0
-        prev = object()
-        for v in gk:
-            seen += 1
-            if v != prev:
-                dense += 1
-                first_pos[v] = seen
-                prev = v
-            ranks.append(first_pos[v] if method == "min" else dense)
-        out.append(pd.Series(ranks, g.index))
+        out.append(pd.Series(rank_sorted(gk), g.index))
     return pd.concat(out)
 
 
@@ -785,6 +800,10 @@ def _dec_window_agg(df, grouper, f, in_dt, frame, cs, t, okeys, asc=()):
             elif kind == "Average":
                 shift = 10 ** (out_t.scale - in_dt.scale)
                 v = _half_up_div(sum(sel) * shift, cnt)
+            elif kind in ("First", "AnyValue"):
+                v = sel[0]
+            elif kind == "Last":
+                v = sel[-1]
             else:
                 raise NotImplementedError(f"cpu decimal window {kind}")
             if bound is not None and abs(v) >= bound:

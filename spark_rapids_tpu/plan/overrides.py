@@ -97,9 +97,10 @@ def _is_wide(dt: T.DataType) -> bool:
 # a wide value falls back (reference: cuDF decimal128 coverage is similarly
 # narrower than decimal64's)
 _WIDE_OK = (E.Alias, E.ColumnRef, E.UnresolvedColumn, E.Literal, E.Cast,
-            E.Add, E.Subtract, E.BinaryComparison, E.IsNull, E.IsNotNull,
+            E.Add, E.Subtract, E.Multiply, E.Divide, E.Abs, E.UnaryMinus,
+            E.BinaryComparison, E.IsNull, E.IsNotNull,
             E.If, E.CaseWhen, E.Coalesce, E.Sum, E.Min, E.Max, E.Average,
-            E.Count, E.First, E.Last)
+            E.Count, E.First, E.Last, E.Greatest, E.Least)
 
 
 def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
@@ -117,11 +118,7 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
             wide_touch = _is_wide(bound.dtype) or any(
                 _is_wide(c.dtype) for c in bound.children)
             if wide_touch:
-                if isinstance(bound, E.Multiply):
-                    if any(_is_wide(c.dtype) for c in bound.children):
-                        reasons.append(
-                            "decimal128 multiply operand not on device")
-                elif not isinstance(bound, _WIDE_OK):
+                if not isinstance(bound, _WIDE_OK):
                     reasons.append(
                         f"{type(bound).__name__} not on device for "
                         "decimal128")
@@ -157,13 +154,23 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
                         or isinstance(vdt, T.DecimalType)):
                     reasons.append(
                         "min_by/max_by ordering/value type not on device")
-            # decimal division/remainder needs exact wide intermediates
-            # (reference: jni DecimalUtils.divide128) — CPU fallback for now
-            if isinstance(bound, (E.Divide, E.IntegralDivide, E.Remainder,
-                                  E.Pmod)):
+            # integral-divide/remainder still need exact trunc-division
+            # wide paths; plain decimal Divide runs on device via the
+            # Knuth-D kernel (int128.decimal_divide_128)
+            if isinstance(bound, (E.IntegralDivide, E.Remainder, E.Pmod)):
                 if any(isinstance(c.dtype, T.DecimalType)
                        for c in bound.children):
                     reasons.append("decimal division not on device")
+            if isinstance(bound, E.Divide) and isinstance(
+                    bound.dtype, T.DecimalType):
+                s1 = (bound.left.dtype.scale
+                      if isinstance(bound.left.dtype, T.DecimalType) else 0)
+                s2 = (bound.right.dtype.scale
+                      if isinstance(bound.right.dtype, T.DecimalType) else 0)
+                k = bound.dtype.scale - s1 + s2
+                if k < 0 or k > 76:
+                    reasons.append(
+                        "decimal divide rescale outside device range")
             # probe regex compilability (reference: RegexParser transpiler
             # bail-outs -> willNotWorkOnGpu); patterns outside the DFA
             # subset fall back to CPU
@@ -303,22 +310,12 @@ class Overrides:
                 for p in inner.spec.partition_by:
                     for r in check_expr(p, child_schema):
                         meta.will_not_work(r)
-                    try:
-                        if _is_wide(E.resolve(p, child_schema).dtype):
-                            meta.will_not_work(
-                                "decimal128 window partition key "
-                                "not on device")
-                    except (TypeError, KeyError):
-                        pass
+                    pass  # wide-decimal partition keys sort/compare on
+                    # device via two-limb sortable keys
                 for o in inner.spec.order_by:
                     for r in check_expr(o.child, child_schema):
                         meta.will_not_work(r)
-                    try:
-                        if _is_wide(E.resolve(o.child, child_schema).dtype):
-                            meta.will_not_work(
-                                "decimal128 window order key not on device")
-                    except (TypeError, KeyError):
-                        pass
+                    pass  # wide-decimal order keys: two-limb sort keys
                 # the window function's inputs and result type must be
                 # device-representable (e.g. sum(sum(decimal)) promotes
                 # past DECIMAL64 -> CPU window)
@@ -328,9 +325,14 @@ class Overrides:
                         meta.will_not_work(r)
                 try:
                     bound_fn = E.resolve(fn, child_schema)
-                    if _is_wide(bound_fn.dtype) or any(
-                            _is_wide(c.dtype)
-                            for c in getattr(bound_fn, "children", ())):
+                    wide_fn = _is_wide(bound_fn.dtype) or any(
+                        _is_wide(c.dtype)
+                        for c in getattr(bound_fn, "children", ()))
+                    # sum/avg/count/first/last ride the 128-bit prefix
+                    # scans; min/max and the rest stay on the CPU engine
+                    if wide_fn and not isinstance(
+                            bound_fn, (E.Sum, E.Average, E.Count,
+                                       E.First, E.Last)):
                         meta.will_not_work(
                             "decimal128 window function not on device")
                 except (TypeError, KeyError, NotImplementedError) as ex:
@@ -340,17 +342,29 @@ class Overrides:
                 fr = inner.spec.resolved_frame()
                 bounded_range = (fr.kind == "range"
                                  and not fr.is_unbounded_both
-                                 and not fr.is_running)
+                                 and not fr.is_running
+                                 and not (fr.start == 0
+                                          and fr.end is None))
                 if bounded_range:
+                    # device value-search (bisect) frames need a single
+                    # ASCENDING integral/date order key
+                    obs = inner.spec.order_by
+                    ok = len(obs) == 1 and obs[0].ascending
+                    if ok:
+                        try:
+                            odt = E.resolve(obs[0].child, child_schema).dtype
+                            ok = (odt in (T.BYTE, T.SHORT, T.INT, T.LONG,
+                                          T.DATE, T.TIMESTAMP)
+                                  and not isinstance(odt, T.DecimalType))
+                        except (TypeError, KeyError):
+                            ok = False
+                    if not ok:
+                        meta.will_not_work(
+                            "bounded RANGE frame needs one ascending "
+                            "integral/date order key on device")
+                if isinstance(fn, (E.Skewness, E.Kurtosis)):
                     meta.will_not_work(
-                        "bounded RANGE frames not on device (value-search "
-                        "windows run on the CPU engine)")
-                if isinstance(fn, (E.First, E.Last)):
-                    meta.will_not_work(
-                        "first/last window functions not on device")
-                if isinstance(fn, E._VarianceBase):
-                    meta.will_not_work(
-                        "variance/stddev window functions not on device")
+                        "skewness/kurtosis window functions not on device")
         elif isinstance(node, L.Join):
             for e, s in ([(k, node.left.schema) for k in node.left_keys]
                          + [(k, node.right.schema) for k in node.right_keys]):

@@ -56,6 +56,8 @@ class ShuffleManager:
     def __init__(self, local_dir: str = "/tmp/srtpu_shuffle",
                  writer_threads: int = 4, reader_threads: int = 4,
                  codec: str = "none", cache_only: bool = False):
+        from spark_rapids_tpu.mem import cleaner
+        cleaner.register_manager(self)
         self.local_dir = local_dir
         self.codec = codec
         self.cache_only = cache_only

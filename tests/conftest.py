@@ -44,3 +44,20 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "test_parallel" in item.nodeid:
             item.add_marker(skip_multi)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # MemoryCleaner-style end-of-suite sweep (reference: Plugin.scala:575-590
+    # shutdown leak check): pool balances must return to zero and no spill
+    # files may outlive their frameworks. Reported as a hard error so leaks
+    # cannot land silently.
+    if exitstatus != 0:
+        return  # don't mask real failures with leak noise
+    try:
+        from spark_rapids_tpu.mem import cleaner
+    except Exception:
+        return
+    leaks = [l for l in cleaner.sweep()
+             if "HbmPool" in l or "orphan spill file" in l]
+    if leaks:
+        raise RuntimeError("end-of-suite leak sweep:\n" + "\n".join(leaks))

@@ -248,16 +248,23 @@ def test_window_decimal_aggs():
 
 
 def test_device_placement():
-    """DECIMAL128 storage + sum/avg/min/max/compare run on device (two-limb
-    int64); division and wide multiply still fall back."""
+    """DECIMAL128 storage + sum/avg/min/max/compare AND (round 4) wide
+    multiply/divide run on device via the 16-bit-limb Knuth-D kernels."""
     t = table()
     df = from_arrow(t, RapidsConf({}))
     stats = (df.group_by("k").agg(Sum(col("w")).alias("s"))
              .device_plan_stats())
     assert stats["device_fraction"] == 1.0, stats
-    stats_div = (df.select(Divide(col("w"), col("w")).alias("d"))
+    stats_div = (df.select(Divide(col("w"), col("w")).alias("d"),
+                           Multiply(col("w"), col("m")).alias("m2"))
                  .device_plan_stats())
-    assert stats_div["cpu_nodes"], stats_div
+    assert stats_div["device_fraction"] == 1.0, stats_div
+    # the differential value check rides both engines
+    dev = assert_same(lambda df: df.select(
+        Divide(col("w"), col("n")).alias("d"),
+        Multiply(col("w"), col("m")).alias("m2"),
+        Divide(col("m"), col("w")).alias("d2")))
+    assert dev[0]["d"] is not None
 
 
 def test_variance_stddev_aggs():

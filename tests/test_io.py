@@ -298,3 +298,115 @@ def test_path_replacement_in_plan(tmp_path):
                        f"fake://tbl->{real}"})
     df = read_parquet("fake://tbl/f.parquet", conf=conf)
     assert [r["x"] for r in df.collect()] == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# round-4 Spark-exact text parsing (GpuTextBasedPartitionReader discipline)
+# ---------------------------------------------------------------------------
+
+
+def test_csv_spark_exact_permissive(tmp_path):
+    import pyarrow as pa
+    from spark_rapids_tpu.io.csv import CsvScanExec
+
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "i,f,b,d,dec\n"
+        "42,1.5e2,true,2024-02-29,12.345\n"
+        "xx,NaN,TRUE,2024-13-01,99999\n"          # bad int, bad date, dec ovf
+        "-129,Inf,false,1999-01-01,-0.005\n"      # byte-range ok for int col
+        ",  1.5,yes,2024-01-01,1\n")              # null int, bad float+bool
+    schema = pa.schema([("i", pa.int32()), ("f", pa.float64()),
+                        ("b", pa.bool_()), ("d", pa.date32()),
+                        ("dec", pa.decimal128(4, 2))])
+    t = pa.concat_tables(
+        [tbl for tbl in CsvScanExec([str(p)], schema=schema).host_tables()]
+    ) if hasattr(CsvScanExec([str(p)], schema=schema), "host_tables") else \
+        CsvScanExec([str(p)], schema=schema)._read_path(str(p))
+    rows = t.to_pylist()
+    import datetime
+    import decimal
+    assert rows[0] == {"i": 42, "f": 150.0, "b": True,
+                       "d": datetime.date(2024, 2, 29),
+                       "dec": decimal.Decimal("12.35")}  # HALF_UP at scale 2
+    assert rows[1]["i"] is None and rows[1]["b"] is True
+    assert rows[1]["d"] is None and rows[1]["dec"] is None
+    import math
+    assert math.isnan(rows[1]["f"])
+    assert rows[2]["i"] == -129 and rows[2]["f"] == float("inf")
+    assert rows[2]["dec"] == decimal.Decimal("-0.01")    # HALF_UP away from 0
+    assert rows[3]["i"] is None and rows[3]["f"] is None
+    assert rows[3]["b"] is None  # "yes" is not a Spark boolean
+
+
+def test_csv_modes_and_corrupt_record(tmp_path):
+    import pyarrow as pa
+    import pytest as _pytest
+    from spark_rapids_tpu.io.csv import CsvScanExec
+
+    p = tmp_path / "m.csv"
+    p.write_text("i,s\n1,a\nbad,b\n3,c\n")
+    schema = pa.schema([("i", pa.int64()), ("s", pa.string())])
+    perm = CsvScanExec([str(p)], schema=schema,
+                       corrupt_column="_corrupt")._read_path(str(p))
+    assert perm.column("_corrupt").to_pylist() == [None, "bad,b", None]
+    drop = CsvScanExec([str(p)], schema=schema,
+                       mode="DROPMALFORMED")._read_path(str(p))
+    assert drop.column("i").to_pylist() == [1, 3]
+    with _pytest.raises(ValueError):
+        CsvScanExec([str(p)], schema=schema,
+                    mode="FAILFAST")._read_path(str(p))
+
+
+def test_json_spark_exact(tmp_path):
+    import pyarrow as pa
+    from spark_rapids_tpu.io.json import JsonScanExec
+
+    p = tmp_path / "t.jsonl"
+    p.write_text(
+        '{"a": 1, "s": "x", "f": 2.5}\n'
+        '{"a": "not_int", "s": 7, "f": true}\n'   # int mismatch; s coerces? no
+        'not json at all\n'
+        '{"a": 3}\n')
+    schema = pa.schema([("a", pa.int64()), ("s", pa.string()),
+                        ("f", pa.float64())])
+    t = JsonScanExec([str(p)], schema=schema,
+                     corrupt_column="_c")._read_path(str(p))
+    rows = t.to_pylist()
+    assert rows[0] == {"a": 1, "s": "x", "f": 2.5, "_c": None}
+    # type mismatches null the fields and mark the record corrupt
+    assert rows[1]["a"] is None and rows[1]["f"] is None
+    assert rows[1]["s"] == "7"  # Spark stringifies non-string scalars
+    assert rows[1]["_c"].startswith('{"a": "not_int"')
+    assert rows[2]["a"] is None and rows[2]["_c"] == "not json at all"
+    assert rows[3] == {"a": 3, "s": None, "f": None, "_c": None}
+
+
+def test_get_json_object_expr():
+    import pyarrow as pa
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.exprs import expr as E
+    from spark_rapids_tpu.exprs.expr import col
+    from spark_rapids_tpu.plan import from_arrow
+
+    t = pa.table({"j": pa.array([
+        '{"a": {"b": [10, 20]}, "s": "hi", "n": null}',
+        '{"a": 1}',
+        'broken{',
+        None,
+    ])})
+    df = from_arrow(t, RapidsConf({}))
+    rows = df.select(
+        E.GetJsonObject(col("j"), "$.a.b[1]").alias("x"),
+        E.GetJsonObject(col("j"), "$.s").alias("s"),
+        E.GetJsonObject(col("j"), "$.a").alias("obj"),
+        E.GetJsonObject(col("j"), "$.missing").alias("m"),
+        E.GetJsonObject(col("j"), "$['s']").alias("br"),
+    ).collect()
+    assert rows[0]["x"] == "20"
+    assert rows[0]["s"] == "hi"          # scalars unquoted
+    assert rows[0]["obj"] == '{"b":[10,20]}'
+    assert rows[0]["m"] is None
+    assert rows[0]["br"] == "hi"
+    assert rows[1]["x"] is None
+    assert rows[2]["s"] is None and rows[3]["s"] is None

@@ -206,3 +206,42 @@ def test_spill_roundtrip_wide_decimal(tmp_path):
         assert [r["w"] for r in got] == vals
     for x in [h] + extra:
         x.close()
+
+
+def test_memory_cleaner_sweep():
+    """MemoryCleaner analog (reference: Plugin.scala:575-590): leaked pool
+    bytes, unclosed spill handles and uncleaned shuffles are all reported;
+    releasing them clears the report."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    from spark_rapids_tpu.mem import cleaner
+    from spark_rapids_tpu.mem.pool import HbmPool
+    from spark_rapids_tpu.mem.spill import SpillFramework
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    from spark_rapids_tpu.shuffle.partition import HashPartitioner
+
+    base = cleaner.sweep()
+
+    pool = HbmPool(1 << 20)
+    pool.allocate(4096)
+    fw = SpillFramework(pool)
+    b = batch_from_arrow(pa.table({"x": pa.array([1, 2, 3], pa.int64())}), 16)
+    h = fw.track(b) if hasattr(fw, "track") else None
+    mgr = ShuffleManager(local_dir="/tmp/srtpu_cleaner_test")
+    schema = T.Schema.from_arrow(pa.schema([("x", pa.int64())]))
+    reg = mgr.register(schema, 2)
+    mgr.write_map_output(reg, HashPartitioner([0], 2), [b])
+
+    leaks = [l for l in cleaner.sweep() if l not in base]
+    assert any("HbmPool" in l for l in leaks), leaks
+    assert any("ShuffleManager" in l for l in leaks), leaks
+
+    pool.release(4096)
+    if h is not None:
+        h.close()
+    mgr.cleanup(reg)
+    leaks2 = [l for l in cleaner.sweep() if l not in base]
+    assert not any("srtpu_cleaner_test" in l for l in leaks2)
+    assert not any("HbmPool: 4096" in l for l in leaks2), leaks2

@@ -89,16 +89,24 @@ def test_bounded_rows_minmax_stays_on_device():
     assert not any("Window" in c for c in stats["cpu_nodes"]), stats
 
 
-def test_bounded_range_tags_to_cpu_no_crash():
-    df = from_arrow(table(), RapidsConf({}))
+def test_bounded_range_on_device_matches_cpu():
+    """Round-4: bounded RANGE frames run on device (bisect frame bounds);
+    results must match the CPU engine exactly."""
     spec = window_spec(partition_by=[col("p")],
                        order_by=[SortOrder(col("o"))],
                        frame=WindowFrame("range", -10, 10))
-    plan = df.with_window(over(Sum(col("iv")), spec).alias("s"))
+
+    def build(conf):
+        df = from_arrow(table(), conf)
+        return df.with_window(over(Sum(col("iv")), spec).alias("s"))
+
+    plan = build(RapidsConf({}))
     stats = plan.device_plan_stats()
-    assert any("Window" in c for c in stats["cpu_nodes"]), stats
-    rows = plan.collect()  # must not raise
-    assert len(rows) == table().num_rows
+    assert not any("Window" in c for c in stats.get("cpu_nodes", [])), stats
+    dev = sorted(tuple(r.values()) for r in plan.collect())
+    cpu = sorted(tuple(r.values()) for r in build(RapidsConf(
+        {"spark.rapids.tpu.sql.enabled": False})).collect())
+    assert dev == cpu
 
 
 def test_bounded_range_values():
@@ -120,8 +128,9 @@ def test_bounded_range_values():
     assert got == {1: 30.0, 2: 60.0, 4: 50.0, 7: 90.0, 8: 90.0}, got
 
 
-def test_first_last_window_cpu_fallback():
-    """First/Last window functions tag to CPU and actually run there."""
+def test_first_last_window_on_device():
+    """Round-4: First/Last window functions run on device (sparse-table
+    position query, first/last NON-NULL engine semantics)."""
     t = pa.table({
         "p": pa.array([1, 1, 1, 2, 2], type=pa.int64()),
         "o": pa.array([1, 2, 3, 1, 2], type=pa.int64()),
@@ -133,7 +142,7 @@ def test_first_last_window_cpu_fallback():
     plan = df.with_window(over(E.First(col("v")), spec).alias("f"),
                           over(E.Last(col("v")), spec).alias("l"))
     stats = plan.device_plan_stats()
-    assert any("Window" in c for c in stats["cpu_nodes"]), stats
+    assert not any("Window" in c for c in stats.get("cpu_nodes", [])), stats
     got = {(r["p"], r["o"]): (r["f"], r["l"]) for r in plan.collect()}
     # running frame: first valid so far / last valid so far
     assert got[(1, 1)] == (None, None)
@@ -180,3 +189,135 @@ def test_running_range_peers_included():
         # peers at o=2 both see 1+2+3=6
         assert got == [(1, 1.0), (2, 6.0), (2, 6.0), (3, 10.0)], (enabled,
                                                                   got)
+
+
+def _both(build):
+    dev = run(build, True)
+    cpu = run(build, False)
+    assert len(dev) == len(cpu)
+
+    def canon(rows):
+        out = []
+        for r in rows:
+            row = []
+            for v in r.values():
+                if isinstance(v, float):
+                    row.append("nan" if math.isnan(v) else round(v, 9))
+                else:
+                    row.append(v)
+            out.append(tuple(row))
+        return sorted(out, key=repr)
+
+    assert canon(dev) == canon(cpu), f"\n{canon(dev)[:4]}\n{canon(cpu)[:4]}"
+    return dev
+
+
+def test_percent_rank_cume_dist_device():
+    from spark_rapids_tpu.exprs.window import CumeDist, PercentRank
+
+    spec = window_spec(partition_by=[col("p")], order_by=[SortOrder(col("iv"))])
+
+    def build(df):
+        return df.with_window(over(PercentRank(), spec).alias("pr"),
+                              over(CumeDist(), spec).alias("cd"))
+
+    dev = _both(build)
+    assert all(0.0 <= r["pr"] <= 1.0 and 0.0 < r["cd"] <= 1.0 for r in dev)
+    df = from_arrow(table(), RapidsConf({}))
+    q = df.with_window(over(PercentRank(), spec).alias("pr"))
+    assert not q.device_plan_stats().get("cpu_nodes")
+
+
+def test_variance_windows_device():
+    for fr in (None, WindowFrame("rows", -5, 5),
+               WindowFrame("range", -20, 20)):
+        spec = window_spec(partition_by=[col("p")],
+                           order_by=[SortOrder(col("o"))], frame=fr)
+
+        def build(df):
+            return df.with_window(
+                over(E.StddevSamp(col("v")), spec).alias("sd"),
+                over(E.VariancePop(col("v")), spec).alias("vp"))
+
+        _both(build)
+
+
+def test_first_last_bounded_frames_device():
+    for fr in (WindowFrame("rows", -3, 3), WindowFrame("range", -15, 5)):
+        spec = window_spec(partition_by=[col("p")],
+                           order_by=[SortOrder(col("o"))], frame=fr)
+
+        def build(df):
+            return df.with_window(
+                over(E.First(col("v")), spec).alias("f"),
+                over(E.Last(col("v")), spec).alias("l"))
+
+        _both(build)
+
+
+def test_bounded_range_minmax_sum_device():
+    spec = window_spec(partition_by=[col("p")],
+                       order_by=[SortOrder(col("o"))],
+                       frame=WindowFrame("range", -25, 10))
+
+    def build(df):
+        return df.with_window(
+            over(Min(col("v")), spec).alias("mn"),
+            over(Max(col("v")), spec).alias("mx"),
+            over(Sum(col("iv")), spec).alias("s"),
+            over(Count(col("v")), spec).alias("c"),
+            over(Average(col("v")), spec).alias("a"))
+
+    _both(build)
+
+
+def test_range_one_sided_unbounded_device():
+    for fr in (WindowFrame("range", None, 10),
+               WindowFrame("range", -10, None)):
+        spec = window_spec(partition_by=[col("p")],
+                           order_by=[SortOrder(col("o"))], frame=fr)
+
+        def build(df):
+            return df.with_window(over(Sum(col("iv")), spec).alias("s"),
+                                  over(Max(col("iv")), spec).alias("m"))
+
+        _both(build)
+
+
+def test_decimal128_window_sums_device():
+    """Round-4: wide-decimal window sum/avg/first/last via 128-bit prefix
+    scans, differential vs the CPU engine."""
+    import decimal
+
+    D = decimal.Decimal
+    rng = np.random.default_rng(5)
+    n = 200
+    t = pa.table({
+        "p": pa.array(rng.integers(0, 4, n).astype(np.int64)),
+        "o": pa.array(np.arange(n, dtype=np.int64)),
+        # decimal(30,2): wide from the start
+        "m": pa.array([None if i % 13 == 0 else
+                       (D(int(rng.integers(-10**18, 10**18)))
+                        * 100).scaleb(-2)
+                       for i in range(n)], pa.decimal128(30, 2)),
+    })
+    for fr in (None, WindowFrame("rows", -4, 4),
+               WindowFrame("range", -10, 10)):
+        spec = window_spec(partition_by=[col("p")],
+                           order_by=[SortOrder(col("o"))], frame=fr)
+
+        def build(conf):
+            df = from_arrow(t, conf)
+            return df.with_window(
+                over(Sum(col("m")), spec).alias("s"),
+                over(Average(col("m")), spec).alias("a"),
+                over(E.First(col("m")), spec).alias("f"),
+                over(E.Last(col("m")), spec).alias("l"))
+
+        plan = build(RapidsConf({}))
+        assert not any("Window" in c for c in
+                       plan.device_plan_stats().get("cpu_nodes", [])), fr
+        dev = sorted(tuple(r.values()) for r in plan.collect())
+        cpu = sorted(tuple(r.values()) for r in build(RapidsConf(
+            {"spark.rapids.tpu.sql.enabled": False})).collect())
+        assert dev == cpu, f"{fr}: {dev[:2]} vs {cpu[:2]}"
