@@ -36,6 +36,7 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch, bucket_capacity
 from spark_rapids_tpu.columnar.column import DeviceColumn
 
 
+
 # ---------------------------------------------------------------------------
 # Gather
 # ---------------------------------------------------------------------------
@@ -121,6 +122,131 @@ def ensure_plain_batch(batch: ColumnarBatch) -> ColumnarBatch:
                          batch.num_rows)
 
 
+def _arr_to_words(a: jax.Array) -> List[jax.Array]:
+    """Fixed-width data lane -> uint32 words (bijective encodings).
+
+    MEASURED TPU fact (tools/perf_probe.py, v5e): one XLA gather op at 16M
+    rows costs ~0.25s almost regardless of width, so gathering k columns as
+    k ops costs k*0.25s while ONE gather of a (W, N) packed uint32 matrix
+    costs ~0.4-0.6s total. All per-batch row movement therefore packs every
+    fixed-width lane into uint32 words, gathers once, and unpacks.
+    """
+    dt = a.dtype
+    if dt == jnp.bool_:
+        return [a.astype(jnp.uint32)]
+    if dt.itemsize <= 4 and jnp.issubdtype(dt, jnp.integer):
+        return [jax.lax.bitcast_convert_type(a.astype(jnp.int32), jnp.uint32)]
+    if dt == jnp.float32:
+        return [jax.lax.bitcast_convert_type(a, jnp.uint32)]
+    if dt.itemsize == 8 and jnp.issubdtype(dt, jnp.integer):
+        w = jax.lax.bitcast_convert_type(a, jnp.uint32)  # (..., 2) [lo, hi]
+        return [w[..., 0], w[..., 1]]
+    # NOTE: float64 is deliberately NOT word-packable. The real-TPU backend
+    # stores f64 as a f32 double-double with flush-to-zero arithmetic: any
+    # float decomposition (astype, subtract) silently flushes subnormal
+    # lo/hi parts, and 64-bit bitcasts don't lower. f64 columns instead ride
+    # a separate same-dtype matrix in gather_columns — pure data movement,
+    # exact on every backend.
+    raise NotImplementedError(f"pack dtype {dt}")
+
+
+def _words_to_arr(words: List[jax.Array], dt) -> jax.Array:
+    dt = jnp.dtype(dt)
+    if dt == jnp.bool_:
+        return words[0].astype(jnp.bool_)
+    if dt.itemsize <= 4 and jnp.issubdtype(dt, jnp.integer):
+        return jax.lax.bitcast_convert_type(words[0], jnp.int32).astype(dt)
+    if dt == jnp.float32:
+        return jax.lax.bitcast_convert_type(words[0], jnp.float32)
+    if dt.itemsize == 8 and jnp.issubdtype(dt, jnp.integer):
+        u = (words[1].astype(jnp.uint64) << jnp.uint64(32)) | words[0].astype(
+            jnp.uint64)
+        return u.astype(dt)
+    raise NotImplementedError(f"unpack dtype {dt}")
+
+
+def gather_columns(
+    cols: Sequence[DeviceColumn],
+    indices: jax.Array,
+    row_valid: jax.Array,
+    out_byte_capacities: Optional[Sequence[Optional[int]]] = None,
+) -> List[DeviceColumn]:
+    """Gather many columns by ONE index vector with ONE fused gather op.
+
+    Fixed-width lanes (data, data2, dict codes) pack into a (W, cap) uint32
+    matrix + validity bits pack 32-per-word; a single `take` moves
+    everything. Var-width (string/binary) columns keep the byte-space path
+    (`gather_column`) — their offsets/data shapes differ per column.
+
+    Semantics identical to mapping `gather_column` over `cols`.
+    """
+    safe_idx = jnp.where(row_valid, indices, 0).astype(jnp.int32)
+    fixed = [i for i, c in enumerate(cols) if c.offsets is None]
+    out: List[Optional[DeviceColumn]] = [None] * len(cols)
+    for i, c in enumerate(cols):
+        if c.offsets is not None:
+            bc = out_byte_capacities[i] if out_byte_capacities else None
+            out[i] = gather_column(c, indices, row_valid, bc)
+    if not fixed:
+        return out  # type: ignore[return-value]
+
+    # f64 lanes cannot be word-packed (see _arr_to_words) — they ride a
+    # separate same-dtype matrix: a 2nd gather op, still O(1) ops per batch.
+    f64_lanes: List[jax.Array] = []   # stacked f64 data arrays
+    f64_slot: dict = {}               # (col index, which) -> row in matrix
+    words: List[jax.Array] = []
+    word_slot: dict = {}              # col index -> (start, n_words)
+    for i in fixed:
+        c = cols[i]
+        for which, arr in (("data", c.data), ("data2", c.data2)):
+            if arr is None:
+                continue
+            if arr.dtype == jnp.float64:
+                f64_slot[(i, which)] = len(f64_lanes)
+                f64_lanes.append(arr)
+            else:
+                ws = _arr_to_words(arr)
+                word_slot[(i, which)] = (len(words), len(ws))
+                words.extend(ws)
+    # validity bits, 32 per uint32 word
+    n_vwords = (len(fixed) + 31) // 32
+    for base in range(0, len(fixed), 32):
+        vbits = jnp.zeros(cols[fixed[0]].validity.shape[0], jnp.uint32)
+        for bit, i in enumerate(fixed[base:base + 32]):
+            vbits = vbits | (cols[i].validity.astype(jnp.uint32)
+                             << jnp.uint32(bit))
+        words.append(vbits)
+    # mode="clip" matches gather_column's clamping [] indexing: an
+    # out-of-range index must never fabricate valid-looking rows (the
+    # validity bits ride this same matrix)
+    mat = jnp.stack(words, axis=0)  # (W, cap)
+    g = jnp.take(mat, safe_idx, axis=1, mode="clip")  # (W, out_cap)
+    gf = (jnp.take(jnp.stack(f64_lanes, axis=0), safe_idx, axis=1,
+                   mode="clip")
+          if f64_lanes else None)
+    vwords = [g[len(words) - n_vwords + k] for k in range(n_vwords)]
+
+    def _lane(i, which, dt):
+        if (i, which) in f64_slot:
+            return gf[f64_slot[(i, which)]]
+        start, n = word_slot[(i, which)]
+        return _words_to_arr([g[start + k] for k in range(n)], dt)
+
+    for j, i in enumerate(fixed):
+        c = cols[i]
+        vbit = (vwords[j // 32] >> jnp.uint32(j % 32)) & jnp.uint32(1)
+        validity = row_valid & vbit.astype(jnp.bool_)
+        data = _lane(i, "data", c.data.dtype)
+        data = jnp.where(validity, data, jnp.zeros_like(data))
+        data2 = None
+        if c.data2 is not None:
+            data2 = _lane(i, "data2", c.data2.dtype)
+            data2 = jnp.where(validity, data2, jnp.zeros_like(data2))
+        out[i] = DeviceColumn(c.dtype, data, validity, None, c.dictionary,
+                              c.dict_size, c.dict_max_len, data2)
+    return out  # type: ignore[return-value]
+
+
 def gather_batch(
     batch: ColumnarBatch,
     indices: jax.Array,
@@ -130,9 +256,8 @@ def gather_batch(
     """Gather a whole batch into a new batch of capacity len(indices)."""
     out_cap = indices.shape[0]
     row_valid = jnp.arange(out_cap, dtype=jnp.int32) < num_rows
-    cols = [
-        gather_column(c, indices, row_valid, out_byte_capacity) for c in batch.columns
-    ]
+    caps = [out_byte_capacity] * len(batch.columns)
+    cols = gather_columns(batch.columns, indices, row_valid, caps)
     return ColumnarBatch(cols, num_rows.astype(jnp.int32))
 
 

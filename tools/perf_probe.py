@@ -1,0 +1,168 @@
+"""Perf probe: dissect the operator-level slowdown (VERDICT r4 weak #2).
+
+Facts to explain: warm jitted FilterExec on a 16M-row 9-col batch = 8s
+while its primitives total ~1s, and the full Q1 chain runs 1.1-1.4s on
+fresh inputs.
+
+Each experiment times a warm jitted computation with the honest fence
+(device_get of a 1-element slice per output) and varies ONE axis:
+  - output buffer COUNT (same total bytes)
+  - output buffer BYTES (same count)
+  - chained consumption (big intermediates consumed by tiny reducer)
+  - the real FilterExec on a lineitem-shaped batch
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 24  # 16M
+
+
+def timeit(name, fn, *args, reps=3):
+    # warm
+    out = fn(*args)
+    jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    _fence(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _fence(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:55s} min={min(ts):7.3f}s  all={[round(t,3) for t in ts]}")
+    return min(ts)
+
+
+def _fence(out):
+    tiny = [jnp.ravel(x)[:1] for x in jax.tree_util.tree_leaves(out)
+            if isinstance(x, jax.Array) and x.size]
+    jax.device_get(tiny)
+
+
+def main():
+    print("devices:", jax.devices())
+    key = np.random.default_rng(0)
+    xs = [jnp.asarray(key.standard_normal(N).astype(np.float32))
+          for _ in range(10)]
+    for x in xs:
+        x.block_until_ready()
+
+    # 1. one big output, elementwise (bandwidth bound): 64MB out
+    @jax.jit
+    def one_out(a):
+        return a * 1.0001 + 3.0
+
+    timeit("1 output  x 64MB elementwise", one_out, xs[0])
+
+    # 2. ten big outputs (640MB out total)
+    @jax.jit
+    def ten_out(*a):
+        return [v * 1.0001 + 3.0 for v in a]
+
+    timeit("10 outputs x 64MB elementwise", ten_out, *xs)
+
+    # 3. twenty outputs from ten inputs (each input produces 2)
+    @jax.jit
+    def twenty_out(*a):
+        out = []
+        for v in a:
+            out.append(v * 1.0001)
+            out.append(v + 1.0)
+        return out
+
+    timeit("20 outputs x 64MB elementwise", twenty_out, *xs)
+
+    # 4. ten tiny outputs from ten big inputs (reduction)
+    @jax.jit
+    def ten_tiny(*a):
+        return [jnp.sum(v) for v in a]
+
+    timeit("10 outputs x 4B (sums)", ten_tiny, *xs)
+
+    # 5. gather-shaped: one permutation applied to 10 cols (10 big outputs)
+    perm = jnp.asarray(key.permutation(N).astype(np.int32))
+    perm.block_until_ready()
+
+    @jax.jit
+    def gather10(idx, *a):
+        return [v[idx] for v in a]
+
+    timeit("10 outputs x 64MB gather", gather10, perm, *xs)
+
+    # 6. chain: big-output producer fn then tiny-output consumer fn
+    @jax.jit
+    def consumer(cols):
+        return [jnp.sum(v) for v in cols]
+
+    def chain(idx, *a):
+        mids = gather10(idx, *a)
+        return consumer(mids)
+
+    timeit("chain gather10 -> sums (2 dispatches)", chain, perm, *xs)
+
+    # 7. the real FilterExec on a lineitem-shaped batch
+    from spark_rapids_tpu.bench import tpch
+    from spark_rapids_tpu.bench.tpch import _source
+    from spark_rapids_tpu.exec.project import FilterExec
+    from spark_rapids_tpu.exprs import expr as E
+
+    li = tpch.gen_lineitem(2.0, seed=7)
+    src = _source(li, batch_rows=1 << 24)
+    for c in src._parts[0][0].columns:
+        c.data.block_until_ready()
+    cut = (np.datetime64("1998-09-03") - np.datetime64("1970-01-01")).astype(int)
+    f = FilterExec(E.Lt(E.Col("l_shipdate"), E.Lit(int(cut), "date")), src)
+    f._bind()
+    batch = src._parts[0][0]
+
+    def run_filter(b):
+        return f._run(b)
+
+    timeit("FilterExec 16M x 9col (1 dispatch)", run_filter, batch)
+
+    # 8. filter_indices only (no gather)
+    from spark_rapids_tpu.exec import kernels as K
+    from spark_rapids_tpu.exprs import eval as EV
+
+    cond = E.resolve(E.Lt(E.Col("l_shipdate"), E.Lit(int(cut), "date")),
+                     src.output_schema)
+
+    @jax.jit
+    def just_indices(b):
+        ctx = EV.EvalContext(b, False)
+        pred = EV.eval_expr(cond, ctx)
+        keep = pred.data & pred.validity
+        return K.filter_indices(keep, b.active_mask())
+
+    timeit("filter_indices only (2 outputs)", just_indices, batch)
+
+    # 9. filter + gather but summing outputs on-device (tiny outputs)
+    @jax.jit
+    def filter_sum(b):
+        ctx = EV.EvalContext(b, False)
+        pred = EV.eval_expr(cond, ctx)
+        keep = pred.data & pred.validity
+        idx, n = K.filter_indices(keep, b.active_mask())
+        out = K.gather_batch(b, idx, n)
+        return [jnp.sum(c.data) for c in out.columns] + [n]
+
+    timeit("filter+gather+sum fused (tiny outputs)", filter_sum, batch)
+
+    # 10. filter exec then consume via sums (2 dispatches, big intermediates)
+    @jax.jit
+    def consume_batch(ob):
+        return [jnp.sum(c.data) for c in ob.columns]
+
+    def filter_then_sum(b):
+        ob = f._run(b)
+        return consume_batch(ob)
+
+    timeit("FilterExec -> sums (2 dispatches)", filter_then_sum, batch)
+
+
+if __name__ == "__main__":
+    main()
