@@ -378,6 +378,64 @@ def batch_from_arrow(
     return ColumnarBatch(cols, jnp.int32(n))
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnums=(1, 2))
+def _shrink_slice(batch: ColumnarBatch, newcap: int, byte_caps):
+    cols = []
+    for c, bc in zip(batch.columns, byte_caps):
+        if c.offsets is not None:
+            cols.append(DeviceColumn(c.dtype, c.data[:bc],
+                                     c.validity[:newcap],
+                                     c.offsets[: newcap + 1]))
+        else:
+            d2 = c.data2[:newcap] if c.data2 is not None else None
+            cols.append(DeviceColumn(c.dtype, c.data[:newcap],
+                                     c.validity[:newcap], None, c.dictionary,
+                                     c.dict_size, c.dict_max_len, d2))
+    return ColumnarBatch(cols, batch.num_rows)
+
+
+def shrink_to_live(batch: ColumnarBatch, min_capacity: int = 1 << 20
+                   ) -> ColumnarBatch:
+    """Re-bucket a front-packed batch DOWN to the live row count's bucket.
+
+    Static shapes mean every downstream kernel pays for the full capacity:
+    a filter/join/agg output holding 1M live rows in a 16M-capacity batch
+    makes every later gather/sort/scan 16x more expensive than needed
+    (device cost scales with capacity — tools/perf_probe.py). The shrink is
+    ONE host sync of (row count + string byte counts) and contiguous
+    slices; only applied when at least half the capacity would be saved
+    and the batch is big enough for the sync to pay for itself.
+
+    Reference analog: GpuCoalesceBatches' goal-driven re-batching
+    (GpuCoalesceBatches.scala:160) — sizing batches to what the data
+    needs, not what the worst case allowed.
+    """
+    cap = batch.capacity
+    if cap < min_capacity or not batch.columns:
+        return batch
+    scalars = [batch.num_rows]
+    for c in batch.columns:
+        if c.offsets is not None:
+            scalars.append(c.offsets[jnp.clip(batch.num_rows, 0, cap)])
+    vals = jax.device_get(scalars)
+    n = int(vals[0])
+    newcap = bucket_capacity(max(n, 1024))
+    if newcap * 2 > cap:
+        return batch
+    byte_caps = []
+    k = 1
+    for c in batch.columns:
+        if c.offsets is not None:
+            byte_caps.append(bucket_capacity(max(int(vals[k]), 8), 8))
+            k += 1
+        else:
+            byte_caps.append(0)
+    return _shrink_slice(batch, newcap, tuple(byte_caps))
+
+
 def batch_to_arrow(batch: ColumnarBatch, schema: T.Schema) -> pa.Table:
     """Device batch -> host Arrow table (slices away padding)."""
     n = batch.row_count()

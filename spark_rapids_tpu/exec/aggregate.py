@@ -119,6 +119,8 @@ class HashAggregateExec(UnaryExec):
     shuffle, mirroring Spark/the reference's partial+merge aggregate pair.
     """
 
+    shrink_output = True
+
     def __init__(self, group_exprs: Sequence[E.Expression],
                  agg_exprs: Sequence[E.Expression], child: TpuExec,
                  mode: str = "complete"):
@@ -668,17 +670,26 @@ class HashAggregateExec(UnaryExec):
                            hashes=None, row_mask=None) -> ColumnarBatch:
         cap = pre.capacity
         active = pre.active_mask() if row_mask is None else row_mask
-        contributing = active[gi.perm]
+        # ONE fused gather for every per-column [gi.perm] indexing below
+        # (incl. the active mask as a synthetic lane): one XLA gather op
+        # costs ~0.25s at 16M rows regardless of width (kernels.py note)
+        perm_in = [DeviceColumn(T.BOOLEAN, active, jnp.ones(cap, jnp.bool_))]
+        perm_src: dict = {}
+        for ci, c in enumerate(pre.columns):
+            if c.offsets is None and not c.is_wide_decimal:
+                perm_src[ci] = len(perm_in)
+                perm_in.append(c)
+        perm_all = K.gather_columns(perm_in, gi.perm,
+                                    jnp.ones(cap, jnp.bool_))
+        perm_cols = {ci: perm_all[slot] for ci, slot in perm_src.items()}
+        contributing = perm_all[0].data
         # sorted-segment layout: scan-based reducers instead of scatters
         seg_ends = K.segment_ends(gi.group_starts, gi.num_groups, cap)
         out_row_valid = jnp.arange(cap, dtype=jnp.int32) < gi.num_groups
         # keys: value at each group head (head -> original row via perm)
         head_rows = jnp.where(out_row_valid, gi.perm[jnp.clip(gi.group_starts, 0, cap - 1)], 0)
-        out_cols: List[DeviceColumn] = []
-        for kc in range(self._n_keys):
-            out_cols.append(
-                K.gather_column(pre.columns[kc], head_rows, out_row_valid)
-            )
+        out_cols: List[DeviceColumn] = list(K.gather_columns(
+            pre.columns[: self._n_keys], head_rows, out_row_valid))
         if hashes is not None:
             for h in hashes:
                 hv = h.astype(jnp.int64)[head_rows]
@@ -696,17 +707,21 @@ class HashAggregateExec(UnaryExec):
                 continue
             for bi, (op, bt) in enumerate(zip(ops, s.buffer_types)):
                 if buffers_input:
-                    src = pre.columns[buf_idx]
+                    src_i = buf_idx
                     buf_idx += 1
                 elif s.input_indices is not None:
-                    src = pre.columns[s.input_indices[bi]]
+                    src_i = s.input_indices[bi]
                 elif s.input_index is None:
-                    src = None
+                    src_i = None
                 else:
-                    src = pre.columns[s.input_index]
+                    src_i = s.input_index
+                src = pre.columns[src_i] if src_i is not None else None
                 if src is None:
                     vals = jnp.zeros(cap, jnp.int64)
                     valid = jnp.ones(cap, jnp.bool_)
+                elif src_i in perm_cols:
+                    vals = perm_cols[src_i].data
+                    valid = perm_cols[src_i].validity
                 else:
                     vals = src.data[gi.perm]
                     valid = src.validity[gi.perm]

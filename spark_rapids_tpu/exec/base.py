@@ -76,6 +76,12 @@ class TpuExec:
     base wires metrics and explain formatting.
     """
 
+    # Operators whose outputs are front-packed and often far sparser than
+    # their static capacity (filter/join/agg) opt in: the base execute
+    # re-buckets each output down (columnar.batch.shrink_to_live) so
+    # downstream kernels run at the smaller static shape.
+    shrink_output = False
+
     def __init__(self, *children: "TpuExec"):
         self.children: List[TpuExec] = list(children)
         self.metrics: Dict[str, Metric] = {}
@@ -111,6 +117,9 @@ class TpuExec:
             if SYNC_METRICS:
                 from spark_rapids_tpu.utils.sync import fence
                 fence(batch)
+            if self.shrink_output:
+                from spark_rapids_tpu.columnar.batch import shrink_to_live
+                batch = shrink_to_live(batch)
             op_time.add(time.perf_counter_ns() - t0)
             self.metrics["numOutputBatches"].add(1)
             self._pending_rows.append(batch.num_rows)

@@ -136,10 +136,9 @@ def _gen_expand(b: ColumnarBatch, gi: int, keep, position: bool, ecap: int,
         0, b.capacity - 1)
     pos = pos_all - gen_off[rows]
     src = jnp.clip(col.offsets[rows] + pos, 0, ecap - 1)
-    cols: List[DeviceColumn] = []
-    for i in keep:
-        cols.append(K.gather_column(b.columns[i], rows, in_range,
-                                    scaps.get(i)))
+    cols: List[DeviceColumn] = list(K.gather_columns(
+        [b.columns[i] for i in keep], rows, in_range,
+        [scaps.get(i) for i in keep]))
     if position:
         cols.append(DeviceColumn(
             T.INT, jnp.where(in_range, pos, 0), in_range))
@@ -159,7 +158,8 @@ def _gen_outer(b: ColumnarBatch, gi: int, keep, position: bool,
     idx, n = K.filter_indices(want, b.active_mask())
     idx = _pad_idx(idx, cap)
     row_valid = jnp.arange(cap, dtype=jnp.int32) < n
-    cols = [K.gather_column(b.columns[i], idx, row_valid) for i in keep]
+    cols = list(K.gather_columns([b.columns[i] for i in keep], idx,
+                                 row_valid))
     if position:
         cols.append(DeviceColumn(
             T.INT, jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.bool_)))

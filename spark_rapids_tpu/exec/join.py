@@ -44,6 +44,8 @@ JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti")
 
 
 class HashJoinExec(BinaryExec):
+    shrink_output = True
+
     def __init__(self, left_keys: Sequence[E.Expression],
                  right_keys: Sequence[E.Expression],
                  join_type: str, left: TpuExec, right: TpuExec,
@@ -358,14 +360,14 @@ class HashJoinExec(BinaryExec):
 
     def _gather_pairs(self, probe, build, pi, bi, bi_valid, n_out, out_cap):
         row_valid = jnp.arange(out_cap, dtype=jnp.int32) < n_out
-        cols: List[DeviceColumn] = []
-        for i, c in enumerate(probe.columns):
-            cols.append(K.gather_column(c, pi, row_valid, self._pcaps.get(i)))
-        for i, c in enumerate(build.columns):
-            cols.append(
-                K.gather_column(c, bi, row_valid & bi_valid, self._bcaps.get(i))
-            )
-        return ColumnarBatch(cols, n_out.astype(jnp.int32))
+        pcols = K.gather_columns(
+            probe.columns, pi, row_valid,
+            [self._pcaps.get(i) for i in range(len(probe.columns))])
+        bcols = K.gather_columns(
+            build.columns, bi, row_valid & bi_valid,
+            [self._bcaps.get(i) for i in range(len(build.columns))])
+        return ColumnarBatch(list(pcols) + list(bcols),
+                             n_out.astype(jnp.int32))
 
     def _unmatched_build(self, build: ColumnarBatch, matched) -> Optional[ColumnarBatch]:
         want = ~matched & build.active_mask()
@@ -379,12 +381,11 @@ class HashJoinExec(BinaryExec):
         ls = self.left.output_schema
         for f in ls:
             cols.append(_null_column(f.dtype, out_cap))
-        for c in build.columns:
-            # subset gather (each build row at most once): input byte capacity
-            # is already an upper bound
-            cols.append(K.gather_column(c, idx[:out_cap] if idx.shape[0] >= out_cap
-                                        else _pad_idx(idx, out_cap),
-                                        row_valid))
+        # subset gather (each build row at most once): input byte capacity
+        # is already an upper bound
+        sidx = idx[:out_cap] if idx.shape[0] >= out_cap else _pad_idx(
+            idx, out_cap)
+        cols.extend(K.gather_columns(build.columns, sidx, row_valid))
         return ColumnarBatch(cols, nn.astype(jnp.int32))
 
 
@@ -436,8 +437,9 @@ def _dense_probe(probe: ColumnarBatch, build: ColumnarBatch, tbl,
         bsafe = jnp.where(hit, cand, 0)
         bcaps = dict(bcaps_t)
         pair_cols = list(probe.columns)
-        for ci, c in enumerate(build.columns):
-            pair_cols.append(K.gather_column(c, bsafe, hit, bcaps.get(ci)))
+        pair_cols.extend(K.gather_columns(
+            build.columns, bsafe, hit,
+            [bcaps.get(ci) for ci in range(len(build.columns))]))
         pair = ColumnarBatch(pair_cols, probe.num_rows)
         cv = EV.eval_expr(cond, EV.EvalContext(pair))
         hit = hit & cv.data & cv.validity
@@ -524,9 +526,11 @@ def _unique_probe(probe, build, tbl, build_matched, lkeys, rkeys, slots,
     hit = hit & probe.active_mask()
     if cond_bound is not None:
         bcaps = dict(bcap_items)
-        bcols = [K.gather_column(c, jnp.where(hit, bi, 0), hit, bcaps.get(i))
-                 for i, c in enumerate(build.columns)]
-        pair = ColumnarBatch(list(probe.columns) + bcols, probe.num_rows)
+        bcols = K.gather_columns(
+            build.columns, jnp.where(hit, bi, 0), hit,
+            [bcaps.get(i) for i in range(len(build.columns))])
+        pair = ColumnarBatch(list(probe.columns) + list(bcols),
+                             probe.num_rows)
         cres = EV.eval_expr(cond_bound, EV.EvalContext(pair))
         hit = hit & cres.data & cres.validity
     if jt in ("right", "full"):
@@ -550,14 +554,12 @@ def _verified_pairs(probe, build, jh, lo, cnt, lkeys, rkeys, cond_bound,
     ver = pair_valid & K.keys_equal(probe, probe_c, list(lkeys),
                                     build, build_row, list(rkeys))
     if cond_bound is not None:
-        pair_cols = [
-            K.gather_column(c, probe_c, ver, pcaps.get(i))
-            for i, c in enumerate(probe.columns)
-        ]
-        pair_cols += [
-            K.gather_column(c, build_row, ver, bcaps.get(i))
-            for i, c in enumerate(build.columns)
-        ]
+        pair_cols = list(K.gather_columns(
+            probe.columns, probe_c, ver,
+            [pcaps.get(i) for i in range(len(probe.columns))]))
+        pair_cols += list(K.gather_columns(
+            build.columns, build_row, ver,
+            [bcaps.get(i) for i in range(len(build.columns))]))
         pair_batch = ColumnarBatch(pair_cols, jnp.int32(out_cap))
         ctx = EV.EvalContext(pair_batch)
         cres = EV.eval_expr(cond_bound, ctx)

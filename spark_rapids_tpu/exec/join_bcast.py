@@ -251,20 +251,23 @@ def _nlj_verify(probe: ColumnarBatch, build: ColumnarBatch, start: int,
         # bytes `chunk` times and build-chunk bytes P times, so input byte
         # capacity scaled by the fanout is an exact upper bound
         refs = set(E.referenced_columns(cond_bound))
-        cols = []
-        for i, c in enumerate(probe.columns):
-            if i not in refs:
-                cols.append(_null_column(c.dtype, P * chunk))
-                continue
-            cap = c.data.shape[0] * chunk if c.offsets is not None else None
-            cols.append(K.gather_column(c, pi, active, cap))
         nl = len(probe.columns)
-        for i, c in enumerate(build.columns):
-            if nl + i not in refs:
-                cols.append(_null_column(c.dtype, P * chunk))
-                continue
-            cap = c.data.shape[0] * P if c.offsets is not None else None
-            cols.append(K.gather_column(c, bi_c, active, cap))
+        pref = [i for i in range(nl) if i in refs]
+        bref = [i for i in range(len(build.columns)) if nl + i in refs]
+        pg = K.gather_columns(
+            [probe.columns[i] for i in pref], pi, active,
+            [probe.columns[i].data.shape[0] * chunk
+             if probe.columns[i].offsets is not None else None for i in pref])
+        bg = K.gather_columns(
+            [build.columns[i] for i in bref], bi_c, active,
+            [build.columns[i].data.shape[0] * P
+             if build.columns[i].offsets is not None else None for i in bref])
+        pmap = dict(zip(pref, pg))
+        bmap = dict(zip(bref, bg))
+        cols = [pmap[i] if i in pmap else _null_column(c.dtype, P * chunk)
+                for i, c in enumerate(probe.columns)]
+        cols += [bmap[i] if i in bmap else _null_column(c.dtype, P * chunk)
+                 for i, c in enumerate(build.columns)]
         pair = ColumnarBatch(cols, jnp.int32(P * chunk))
         res = EV.eval_expr(cond_bound, EV.EvalContext(pair))
         active = active & res.data & res.validity
@@ -290,11 +293,12 @@ def _nlj_gather(probe: ColumnarBatch, build: ColumnarBatch, ver: jax.Array,
     pi = idx // chunk
     bi = jnp.clip(start + (idx % chunk), 0, build.capacity - 1)
     row_valid = jnp.arange(out_cap, dtype=jnp.int32) < n
-    cols = []
-    for i, c in enumerate(probe.columns):
-        cols.append(K.gather_column(c, pi, row_valid, pcaps.get(i)))
-    for i, c in enumerate(build.columns):
-        cols.append(K.gather_column(c, bi, row_valid, bcaps.get(i)))
+    cols = list(K.gather_columns(
+        probe.columns, pi, row_valid,
+        [pcaps.get(i) for i in range(len(probe.columns))]))
+    cols += list(K.gather_columns(
+        build.columns, bi, row_valid,
+        [bcaps.get(i) for i in range(len(build.columns))]))
     return ColumnarBatch(cols, n.astype(jnp.int32))
 
 
@@ -401,6 +405,6 @@ def _bucket_gather(batch: ColumnarBatch, hmod: jax.Array, p: int, cap: int,
     idx, n = K.filter_indices(want, batch.active_mask())
     idx = _pad_idx(idx, cap)
     row_valid = jnp.arange(cap, dtype=jnp.int32) < n
-    cols = [K.gather_column(c, idx, row_valid, bcaps.get(i))
-            for i, c in enumerate(batch.columns)]
+    cols = K.gather_columns(batch.columns, idx, row_valid,
+                            [bcaps.get(i) for i in range(len(batch.columns))])
     return ColumnarBatch(cols, n.astype(jnp.int32))

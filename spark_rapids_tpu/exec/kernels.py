@@ -165,6 +165,31 @@ def _words_to_arr(words: List[jax.Array], dt) -> jax.Array:
     raise NotImplementedError(f"unpack dtype {dt}")
 
 
+def gather_lanes(lanes: Sequence[jax.Array], idx: jax.Array) -> List[jax.Array]:
+    """Gather many same-capacity 1-D arrays by one index vector with one
+    packed take (+ one more for f64 lanes) — the gather_columns trick for
+    raw arrays (one XLA gather op ~0.25s at 16M rows regardless of width)."""
+    f64_pos = [k for k, a in enumerate(lanes) if a.dtype == jnp.float64]
+    out: List[Optional[jax.Array]] = [None] * len(lanes)
+    if f64_pos:
+        gf = jnp.take(jnp.stack([lanes[k] for k in f64_pos], axis=0), idx,
+                      axis=1, mode="clip")
+        for j, k in enumerate(f64_pos):
+            out[k] = gf[j]
+    rest = [k for k in range(len(lanes)) if out[k] is None]
+    if rest:
+        words: List[jax.Array] = []
+        slots = []
+        for k in rest:
+            ws = _arr_to_words(lanes[k])
+            slots.append((len(words), len(ws)))
+            words.extend(ws)
+        g = jnp.take(jnp.stack(words, axis=0), idx, axis=1, mode="clip")
+        for k, (start, n) in zip(rest, slots):
+            out[k] = _words_to_arr([g[start + j] for j in range(n)],
+                                   lanes[k].dtype)
+    return out  # type: ignore[return-value]
+
 def gather_columns(
     cols: Sequence[DeviceColumn],
     indices: jax.Array,
@@ -190,57 +215,34 @@ def gather_columns(
     if not fixed:
         return out  # type: ignore[return-value]
 
-    # f64 lanes cannot be word-packed (see _arr_to_words) — they ride a
-    # separate same-dtype matrix: a 2nd gather op, still O(1) ops per batch.
-    f64_lanes: List[jax.Array] = []   # stacked f64 data arrays
-    f64_slot: dict = {}               # (col index, which) -> row in matrix
-    words: List[jax.Array] = []
-    word_slot: dict = {}              # col index -> (start, n_words)
+    lanes: List[jax.Array] = []
+    lane_slot: dict = {}  # (col index, "data"/"data2") -> lane index
     for i in fixed:
         c = cols[i]
         for which, arr in (("data", c.data), ("data2", c.data2)):
-            if arr is None:
-                continue
-            if arr.dtype == jnp.float64:
-                f64_slot[(i, which)] = len(f64_lanes)
-                f64_lanes.append(arr)
-            else:
-                ws = _arr_to_words(arr)
-                word_slot[(i, which)] = (len(words), len(ws))
-                words.extend(ws)
-    # validity bits, 32 per uint32 word
+            if arr is not None:
+                lane_slot[(i, which)] = len(lanes)
+                lanes.append(arr)
+    # validity bits, 32 per uint32 word (cheaper than one bool lane each)
     n_vwords = (len(fixed) + 31) // 32
     for base in range(0, len(fixed), 32):
         vbits = jnp.zeros(cols[fixed[0]].validity.shape[0], jnp.uint32)
         for bit, i in enumerate(fixed[base:base + 32]):
             vbits = vbits | (cols[i].validity.astype(jnp.uint32)
                              << jnp.uint32(bit))
-        words.append(vbits)
-    # mode="clip" matches gather_column's clamping [] indexing: an
-    # out-of-range index must never fabricate valid-looking rows (the
-    # validity bits ride this same matrix)
-    mat = jnp.stack(words, axis=0)  # (W, cap)
-    g = jnp.take(mat, safe_idx, axis=1, mode="clip")  # (W, out_cap)
-    gf = (jnp.take(jnp.stack(f64_lanes, axis=0), safe_idx, axis=1,
-                   mode="clip")
-          if f64_lanes else None)
-    vwords = [g[len(words) - n_vwords + k] for k in range(n_vwords)]
-
-    def _lane(i, which, dt):
-        if (i, which) in f64_slot:
-            return gf[f64_slot[(i, which)]]
-        start, n = word_slot[(i, which)]
-        return _words_to_arr([g[start + k] for k in range(n)], dt)
+        lanes.append(vbits)
+    g = gather_lanes(lanes, safe_idx)
+    vwords = g[len(lanes) - n_vwords:]
 
     for j, i in enumerate(fixed):
         c = cols[i]
         vbit = (vwords[j // 32] >> jnp.uint32(j % 32)) & jnp.uint32(1)
         validity = row_valid & vbit.astype(jnp.bool_)
-        data = _lane(i, "data", c.data.dtype)
+        data = g[lane_slot[(i, "data")]]
         data = jnp.where(validity, data, jnp.zeros_like(data))
         data2 = None
         if c.data2 is not None:
-            data2 = _lane(i, "data2", c.data2.dtype)
+            data2 = g[lane_slot[(i, "data2")]]
             data2 = jnp.where(validity, data2, jnp.zeros_like(data2))
         out[i] = DeviceColumn(c.dtype, data, validity, None, c.dictionary,
                               c.dict_size, c.dict_max_len, data2)
@@ -341,17 +343,49 @@ def string_prefix_keys(col: DeviceColumn) -> List[jax.Array]:
 def sortable_keys(
     col: DeviceColumn, ascending: bool = True, nulls_first: Optional[bool] = None
 ) -> List[jax.Array]:
-    """Per-column lexsort keys, least-significant first within the column:
-    [data_key_lo, ..., data_key_hi, null_key]. Spark default null ordering:
+    """Per-column lexsort keys, least-significant first within the column.
+
+    Key stacks by type (null ordering FOLDS into a data word wherever the
+    word has spare values, minimizing sort passes): dict/bool -> [folded
+    key]; float -> [value, exception_word] (null/NaN ordering in the
+    exception word); 32-bit ints -> [u32_key, null_key]; 64-bit ints /
+    decimals / strings -> [lo, hi, null_key]. Spark default null ordering:
     NULLS FIRST for ascending, NULLS LAST for descending."""
     if nulls_first is None:
         nulls_first = ascending
     dt = col.dtype
     if col.is_dict:
-        # sorted dictionary: int32 code order IS byte-lexicographic order
+        # sorted dictionary: int32 code order IS byte-lexicographic order.
+        # Codes are a small non-negative range, so null ordering folds into
+        # the SAME word (INT32_MIN/MAX are unreachable as +-codes) — one
+        # sort pass per dict key, no separate null key.
         k = col.data.astype(jnp.int32)
-        data_keys = [(-k) if not ascending else k]
-    elif dt in (T.STRING, T.BINARY):
+        if not ascending:
+            k = -k
+        null_v = jnp.int32(-2**31) if nulls_first else jnp.int32(2**31 - 1)
+        return [jnp.where(col.validity, k, null_v)]
+    if dt == T.BOOLEAN:
+        k = col.data.astype(jnp.int32)
+        if not ascending:
+            k = 1 - k
+        null_v = jnp.int32(-1) if nulls_first else jnp.int32(2)
+        return [jnp.where(col.validity, k, null_v)]
+    if dt in T.FRACTIONAL_TYPES:
+        # float order rides the VALUE itself — no f64 bit encoding exists on
+        # the real-TPU backend (f64 there is a f32 double-double). The
+        # "exception" orderings (NaN greater than all non-null; null per
+        # spec) fold into ONE more-significant word: null < normal < NaN
+        # for asc/nulls-first, flipped as the spec requires.
+        d, is_nan = _float_canonical(col.data)
+        ex = jnp.where(is_nan, jnp.int32(2), jnp.int32(1))
+        if not ascending:
+            d = -d
+            ex = 3 - ex  # nan below normals when descending
+        ex = jnp.where(col.validity, ex,
+                       jnp.int32(0) if nulls_first else jnp.int32(3))
+        d = jnp.where(col.validity & ~is_nan, d, jnp.zeros_like(d))
+        return [d, ex]
+    if dt in (T.STRING, T.BINARY):
         pk = string_prefix_keys(col)  # [hi_word, lo_word]; emit lo-first
         data_keys = [pk[1], pk[0]]
         if not ascending:
@@ -363,19 +397,11 @@ def sortable_keys(
         data_keys = [kl, kh]  # least-significant first
         if not ascending:
             data_keys = [~k for k in data_keys]
-    elif dt in T.FRACTIONAL_TYPES:
-        # float order rides the VALUE itself (a NaN flag key above it makes
-        # NaN greater than everything) — no f64 bit encoding exists on the
-        # real-TPU backend (float64 there is a float32 double-double)
-        d, is_nan = _float_canonical(col.data)
-        nan_key = is_nan.astype(jnp.int32)
-        if not ascending:
-            d = -d
-            nan_key = 1 - nan_key
-        data_keys = [d, nan_key]
-    elif dt == T.BOOLEAN:
-        k = col.data.astype(jnp.int32)
-        data_keys = [(1 - k) if not ascending else k]
+    elif dt in (T.INT, T.DATE, T.SHORT, T.BYTE):
+        # 32-bit-storable ints sort on ONE u32 word (not a u64 pair)
+        k32 = jax.lax.bitcast_convert_type(
+            col.data.astype(jnp.int32), jnp.uint32) ^ jnp.uint32(1 << 31)
+        data_keys = [~k32 if not ascending else k32]
     else:
         k = _int_sortable(col.data)
         data_keys = [~k if not ascending else k]
@@ -388,24 +414,31 @@ def sortable_keys(
     return data_keys + [null_key]
 
 
-def lexsort_chain(keys: Sequence[jax.Array]) -> jax.Array:
-    """Stable lexicographic argsort as a chain of single-key stable sorts
-    (LSD radix composition): sort by the least-significant key first, then
-    re-sort by each more-significant key; stability preserves prior order
-    within ties. Semantics match ``jnp.lexsort(keys)`` (last key primary).
+# Max key operands for the single variadic sort. Compile time grows
+# superlinearly with operand count (~12s/28s/64s/128s for 2/3/5/7) but is
+# one-time per (shape, operand set) under the persistent compile cache,
+# while RUNTIME is one fused pass (~0.17s at 16M for 3 operands on v5e) vs
+# ~0.4-0.6s per chained pass (gather + sort). Above the cap the chained
+# fallback bounds compile cost at O(n) fixed-size compiles.
+LEXSORT_VARIADIC_MAX = 6
 
-    Why not one variadic sort: TPU XLA sort compile time grows superlinearly
-    with operand count (~12s/23s/64s/128s for 2/3/5/7 operands), while each
-    chained pass is a fixed ~12s 2-operand sort — n keys compile in O(n).
-    Runtime does n passes over the data, but these sorts are
-    compile-dominated in practice and the passes are bandwidth-cheap.
+
+def lexsort_chain(keys: Sequence[jax.Array]) -> jax.Array:
+    """Stable lexicographic argsort. Semantics match ``jnp.lexsort(keys)``
+    (last key primary).
+
+    Primary path: ONE variadic ``lax.sort`` over all key words carrying the
+    row-id permutation as a payload operand — no per-pass gathers at all.
+    Fallback (many keys): LSD chain of single-key stable sorts, each
+    carrying the permutation as payload (stability preserves prior order
+    within ties).
     """
     assert keys, "lexsort_chain needs at least one key"
 
     def passes(k: jax.Array) -> List[jax.Array]:
         # 64-bit integer sorts are word-pair-emulated on the VPU (~18x the
-        # cost of native u32): split into (lo32, hi32) chained passes, which
-        # is the same total order under the stable chain
+        # cost of native u32): split into (lo32, hi32) passes, which give
+        # the same total order under the stable LSD composition
         if k.dtype == jnp.int64:
             k = k.astype(jnp.uint64) ^ jnp.uint64(_SIGN64)
         if k.dtype == jnp.uint64:
@@ -414,12 +447,19 @@ def lexsort_chain(keys: Sequence[jax.Array]) -> jax.Array:
             return [lo, hi]
         return [k]
 
-    flat: List[jax.Array] = []
+    flat: List[jax.Array] = []  # least-significant first
     for k in keys:
         flat.extend(passes(k))
-    perm = jnp.argsort(flat[0], stable=True)
-    for k in flat[1:]:
-        perm = perm[jnp.argsort(k[perm], stable=True)]
+    n = flat[0].shape[0]
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    if len(flat) <= LEXSORT_VARIADIC_MAX:
+        operands = tuple(reversed(flat)) + (row_ids,)
+        out = jax.lax.sort(operands, num_keys=len(flat), is_stable=True)
+        return out[-1]
+    perm = row_ids
+    for i, k in enumerate(flat):
+        kg = k if i == 0 else k[perm]
+        _, perm = jax.lax.sort((kg, perm), num_keys=1, is_stable=True)
     return perm
 
 
@@ -674,27 +714,56 @@ def group_rows(batch: ColumnarBatch, key_cols: Sequence[int],
         h2 = hash_keys(batch, key_cols, variant=1)
         keys = [h2, h1, jnp.where(active, jnp.uint32(0), jnp.uint32(1))]
         perm = lexsort_chain(keys).astype(jnp.int32)
-        prev = jnp.concatenate([perm[:1], perm[:-1]])
-        neq = (h1[perm] != h1[prev]) | (h2[perm] != h2[prev])
-        for i in key_cols:
-            c = batch.columns[i]
-            if c.offsets is None:
-                neq = neq | (c.data[perm] != c.data[prev])
-                neq = neq | (c.validity[perm] != c.validity[prev])
-                continue
-            lens = c.offsets[1:] - c.offsets[:-1]
-            neq = neq | (lens[perm] != lens[prev])
-            for w in string_prefix_keys(c):
-                neq = neq | (w[perm] != w[prev])
-            neq = neq | (c.validity[perm] != c.validity[prev])
+        neq = _neighbor_key_neq(batch, key_cols, perm, extra=(h1, h2))
         return _group_from_boundaries(perm, neq, active, cap)
     h = hash_keys(batch, key_cols)
     keys: List[jax.Array] = [h]
     keys.append(jnp.where(active, jnp.uint32(0), jnp.uint32(1)))
     perm = lexsort_chain(keys).astype(jnp.int32)
-    prev = jnp.concatenate([perm[:1], perm[:-1]])
-    neq = ~keys_equal(batch, perm, key_cols, batch, prev, key_cols)
+    neq = _neighbor_key_neq(batch, key_cols, perm)
     return _group_from_boundaries(perm, neq, active, cap)
+
+
+
+
+def _neighbor_key_neq(batch: ColumnarBatch, key_cols: Sequence[int],
+                      perm: jax.Array, extra: Sequence[jax.Array] = ()
+                      ) -> jax.Array:
+    """Per-position "differs from previous row" over key columns in permuted
+    order, with keys_equal semantics (null==null, Spark float canonical
+    equality) — but ONE fused gather instead of 4 per key column: every
+    comparable signature lane is computed elementwise first, gathered by
+    ``perm`` in one packed take, then compared against its shift-by-one."""
+    lanes: List[jax.Array] = list(extra)
+    for i in key_cols:
+        c = batch.columns[i]
+        lanes.append(c.validity)
+        # every data-derived lane is masked by validity: null keys must
+        # compare equal regardless of residual data under the null (some
+        # producers, e.g. projected expressions, do not zero it)
+        v = c.validity
+
+        def m(lane, v=v):
+            return jnp.where(v, lane, jnp.zeros_like(lane))
+
+        if c.offsets is not None:
+            lanes.append(m(c.offsets[1:] - c.offsets[:-1]))
+            lanes.extend(m(w) for w in string_prefix_keys(c))
+        elif c.is_wide_decimal:
+            lanes.append(m(c.data))
+            lanes.append(m(c.data2))
+        elif c.dtype in T.FRACTIONAL_TYPES:
+            d, is_nan = _float_canonical(c.data)
+            lanes.append(m(d))
+            lanes.append(m(is_nan))
+        else:
+            lanes.append(m(c.data))
+    g = gather_lanes(lanes, perm)
+    neq = jnp.zeros(perm.shape[0], jnp.bool_)
+    for lane in g:
+        prev = jnp.concatenate([lane[:1], lane[:-1]])
+        neq = neq | (lane != prev)
+    return neq
 
 
 def group_rows_prehashed(h1: jax.Array, h2: jax.Array,
@@ -705,8 +774,10 @@ def group_rows_prehashed(h1: jax.Array, h2: jax.Array,
     cap = h1.shape[0]
     keys = [h2, h1, jnp.where(active, jnp.uint32(0), jnp.uint32(1))]
     perm = lexsort_chain(keys).astype(jnp.int32)
-    prev = jnp.concatenate([perm[:1], perm[:-1]])
-    neq = (h1[perm] != h1[prev]) | (h2[perm] != h2[prev])
+    g1, g2 = gather_lanes([h1, h2], perm)
+    p1 = jnp.concatenate([g1[:1], g1[:-1]])
+    p2 = jnp.concatenate([g2[:1], g2[:-1]])
+    neq = (g1 != p1) | (g2 != p2)
     return _group_from_boundaries(perm, neq, active, cap)
 
 

@@ -166,7 +166,7 @@ def _sample_gather(b: ColumnarBatch, keep, cap: int):
     idx, n = K.filter_indices(keep, b.active_mask())
     idx = _pad_idx(idx, cap)
     row_valid = jnp.arange(cap, dtype=jnp.int32) < n
-    cols = [K.gather_column(c, idx, row_valid) for c in b.columns]
+    cols = K.gather_columns(b.columns, idx, row_valid)
     return ColumnarBatch(cols, n.astype(jnp.int32))
 
 

@@ -56,6 +56,8 @@ class ProjectExec(UnaryExec):
 class FilterExec(UnaryExec):
     """Filter + compaction in one fused kernel."""
 
+    shrink_output = True
+
     def __init__(self, condition: E.Expression, child: TpuExec,
                  ansi: bool = False):
         super().__init__(child)

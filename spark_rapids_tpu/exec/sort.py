@@ -242,6 +242,6 @@ def _slice_rows(batch: ColumnarBatch, start, count, cap: int, byte_caps):
     idx = jnp.arange(cap, dtype=jnp.int32) + start
     idx = jnp.clip(idx, 0, batch.capacity - 1)
     row_valid = jnp.arange(cap, dtype=jnp.int32) < count
-    cols = [K.gather_column(c, idx, row_valid, byte_caps[i] or None)
-            for i, c in enumerate(batch.columns)]
+    cols = K.gather_columns(batch.columns, idx, row_valid,
+                            [bc or None for bc in byte_caps])
     return ColumnarBatch(cols, count.astype(jnp.int32))

@@ -24,10 +24,9 @@ def _normalize_u32(col, capacity: int) -> jax.Array:
     range, so the curve's TOP bits discriminate regardless of the raw value
     distribution."""
     keys = K.sortable_keys(col, ascending=True, nulls_first=True)
-    # rank by the column's full key stack (lexsort primary key is last):
-    # floats carry [value, nan_flag, null_key], strings [lo, hi, null_key] —
-    # a single key would drop the value for floats / half the prefix for
-    # strings
+    # rank by the column's full key stack (lexsort primary key is last;
+    # layout per type in sortable_keys' docstring) — a single key would
+    # drop the value for floats / half the prefix for strings
     order = K.lexsort_chain(keys)
     ranks = jnp.zeros(capacity, jnp.uint32)
     ranks = ranks.at[order].set(jnp.arange(capacity, dtype=jnp.uint32))

@@ -142,10 +142,8 @@ def _local_partial_agg(batch: ColumnarBatch, n_keys: int,
     out_valid = jnp.arange(cap, dtype=jnp.int32) < gi.num_groups
     head_rows = jnp.where(out_valid,
                           gi.perm[jnp.clip(gi.group_starts, 0, cap - 1)], 0)
-    out_cols: List[DeviceColumn] = [
-        K.gather_column(batch.columns[i], head_rows, out_valid)
-        for i in range(n_keys)
-    ]
+    out_cols: List[DeviceColumn] = list(K.gather_columns(
+        batch.columns[:n_keys], head_rows, out_valid))
     seg_ends = K.segment_ends(gi.group_starts, gi.num_groups, cap)
     for col_i, op in ops:
         assert op in _SEG_OPS, op

@@ -157,3 +157,24 @@ def test_global_new_aggs(rng):
         E.MaxBy(col("o"), col("w")).alias("mb"),
     ))
     assert dev[0]["r"] > 0.9
+
+
+def test_group_by_computed_null_keys(rng):
+    """Null group keys with differing residual data under the null must form
+    ONE null group (regression: _neighbor_key_neq must mask data lanes by
+    validity — projected expressions don't zero data under invalid rows)."""
+    t = pa.table({
+        "a": pa.array([1, 2, 3, 4, 5, 6], pa.int64()),
+        "b": pa.array([None, None, 1, None, 2, None], pa.int64()),
+        "f": pa.array([None, None, 1.5, None, 2.5, None], pa.float64()),
+        "v": pa.array([10, 20, 30, 40, 50, 60], pa.int64()),
+    })
+    df = from_arrow(t).select(
+        E.Add(col("a"), col("b")).alias("k"),
+        E.Multiply(col("f"), lit(2.0)).alias("kf"),
+        col("v"),
+    ).group_by("k", "kf").agg(E.Sum(col("v")).alias("s")).sort("k")
+    rows = df.collect()
+    null_rows = [r for r in rows if r["k"] is None]
+    assert len(null_rows) == 1, rows
+    assert null_rows[0]["s"] == 10 + 20 + 40 + 60, rows
