@@ -835,6 +835,29 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         out_t = expr.dtype
         is_max = not isinstance(expr, E.Least)
 
+        if (isinstance(out_t, T.DecimalType)
+                and (out_t.precision > T.DecimalType.MAX_LONG_DIGITS
+                     or any(isinstance(v, WideVal) for v in vals))):
+            # decimal128 path: rescale every operand to the result scale as
+            # (hi, lo) limbs, compare with int128 ordering (ADVICE r4:
+            # Greatest/Least are in _WIDE_OK so this must exist)
+            from spark_rapids_tpu.exec import int128 as I128
+
+            acc_h = acc_l = av = None
+            for v, c in zip(vals, expr.children):
+                w = _as_wide(v, c.dtype, out_t.scale)
+                if acc_h is None:
+                    acc_h, acc_l, av = w.hi, w.lo, w.validity
+                    continue
+                both = av & w.validity
+                newer = (I128.cmp_lt(acc_h, acc_l, w.hi, w.lo) if is_max
+                         else I128.cmp_lt(w.hi, w.lo, acc_h, acc_l))
+                take = jnp.where(both, newer, w.validity)
+                acc_h = jnp.where(take, w.hi, acc_h)
+                acc_l = jnp.where(take, w.lo, acc_l)
+                av = av | w.validity
+            return WideVal(acc_h, acc_l, av)
+
         def conv(d, cd):
             # Operands must be rescaled to the common type before comparing:
             # raw unscaled int64 values of different scales are not ordered

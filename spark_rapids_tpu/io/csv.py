@@ -102,7 +102,22 @@ class CsvScanExec(FileScanBase):
                               timestamp_format=self.timestamp_format,
                               mode=self.mode,
                               corrupt_column=self.corrupt_column)
-            return convert_string_table(raw, schema, opts)
+            raw_lines = None
+            if self.corrupt_column:
+                n_rows, header = raw.num_rows, self.header
+
+                def raw_lines(path=path, n_rows=n_rows, header=header):
+                    # original record text for columnNameOfCorruptRecord
+                    # (resolved only when a bad row exists; only safe when
+                    # physical lines == records, i.e. no embedded newlines
+                    # in quoted fields — otherwise reconstruct)
+                    with open(path, "r", encoding="utf-8",
+                              errors="replace") as fh:
+                        lines = fh.read().splitlines()
+                    if header:
+                        lines = lines[1:]
+                    return lines if len(lines) == n_rows else None
+            return convert_string_table(raw, schema, opts, raw_lines)
         return pacsv.read_csv(
             path,
             read_options=self._read_opts(),

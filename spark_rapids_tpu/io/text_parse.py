@@ -209,12 +209,18 @@ class CsvOptions:
 
 
 def convert_string_table(raw: pa.Table, schema: T.Schema,
-                         opts: CsvOptions) -> pa.Table:
+                         opts: CsvOptions,
+                         raw_lines=None) -> pa.Table:
     """All-string arrow table -> Spark-typed table under the option set.
 
     PERMISSIVE: malformed fields -> NULL and (if configured) the raw
     record joins the corrupt column; DROPMALFORMED removes the row;
-    FAILFAST raises."""
+    FAILFAST raises. ``raw_lines`` — a list of record strings OR a
+    zero-arg callable returning one (resolved lazily on the FIRST bad row,
+    so well-formed files never pay the extra read) — preserves the
+    ORIGINAL record text, quoting/escaping included, in the corrupt
+    column, matching Spark's columnNameOfCorruptRecord; the fallback
+    reconstruction comma-joins the parsed fields."""
     n = raw.num_rows
     str_cols = [raw.column(i).to_pylist() if i < raw.num_columns
                 else [None] * n for i in range(len(schema))]
@@ -241,11 +247,18 @@ def convert_string_table(raw: pa.Table, schema: T.Schema,
         for ci, v in enumerate(row_vals):
             out_vals[ci].append(v)
         if opts.corrupt_column:
-            corrupt.append(
-                ",".join("" if s is None else str(s)
-                         for s in (str_cols[ci][r]
-                                   for ci in range(len(schema))))
-                if bad else None)
+            if not bad:
+                corrupt.append(None)
+            else:
+                if callable(raw_lines):
+                    raw_lines = raw_lines()  # lazy: first bad row only
+                if raw_lines is not None and r < len(raw_lines):
+                    corrupt.append(raw_lines[r])
+                else:
+                    corrupt.append(
+                        ",".join("" if s is None else str(s)
+                                 for s in (str_cols[ci][r]
+                                           for ci in range(len(schema)))))
     arrays = []
     names = []
     for f, vals in zip(schema, out_vals):

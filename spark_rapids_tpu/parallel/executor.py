@@ -47,6 +47,11 @@ from spark_rapids_tpu.exec.base import BatchSourceExec, TpuExec
 from spark_rapids_tpu.parallel.repartition import windowed_repartition
 
 
+class ExchangeOverflow(RuntimeError):
+    """A windowed exchange receive state exceeded its static capacity
+    (pathological skew); the subtree re-executes on the host engine."""
+
+
 class NotLowerable(Exception):
     """This node cannot run inside the mesh program (host engine instead)."""
 
@@ -125,10 +130,21 @@ class MeshExecutor:
     def _exec(self, node: TpuExec) -> pa.Table:
         from spark_rapids_tpu.shuffle.aqe import AQEShuffleReadExec
 
+        marker = len(self.dist_nodes)
         try:
             return self._run_distributed(node)
         except NotLowerable:
             pass
+        except ExchangeOverflow as e:
+            # skew beyond the exchange's static window: run this WHOLE
+            # subtree on the host engine, once — re-attempting distribution
+            # per child would re-execute (and re-overflow) the same
+            # exchange at every level. Roll back the diagnostics so
+            # explain doesn't report host-executed nodes as distributed.
+            import logging
+            logging.getLogger(__name__).warning("%s", e)
+            del self.dist_nodes[marker:]
+            return self._exec_host_tree(node)
         if isinstance(node, AQEShuffleReadExec):
             # AQE re-layout is partition bookkeeping over a live exchange;
             # once a subtree is spliced as a gathered source it no longer
@@ -136,23 +152,43 @@ class MeshExecutor:
             return self._exec(node.exchange)
         # node runs on the host engine; distribute subtrees below it first
         self.host_nodes.append(type(node).__name__)
-        for i, ch in enumerate(node.children):
-            if isinstance(ch, BatchSourceExec):
-                continue
-            tbl = self._exec(ch)
-            tbl = tbl.rename_columns(
-                [f"c{j}" for j in range(tbl.num_columns)])
-            src = BatchSourceExec(
-                [[batch_from_arrow(tbl, min_bucket=self.min_local_cap)]],
-                ch.output_schema)
-            node.children[i] = src
-        out = [b for b in node.execute_all()]
+        spliced = []
+        try:
+            for i, ch in enumerate(node.children):
+                if isinstance(ch, BatchSourceExec):
+                    continue
+                tbl = self._exec(ch)
+                tbl = tbl.rename_columns(
+                    [f"c{j}" for j in range(tbl.num_columns)])
+                src = BatchSourceExec(
+                    [[batch_from_arrow(tbl, min_bucket=self.min_local_cap)]],
+                    ch.output_schema)
+                node.children[i] = src
+                spliced.append((node, i, ch))
+            out = [b for b in node.execute_all()]
+        finally:
+            # restore the caller's plan even when a later child's
+            # materialization raises: splicing must not leave stale
+            # sources behind (the plan object is reusable)
+            for n, i, ch in spliced:
+                n.children[i] = ch
         schema = node.output_schema
         if not out:
             return pa.table({f.name: pa.array([], f.dtype.arrow_type())
                              for f in schema})
         tables = [batch_to_arrow(b, schema) for b in out]
         return pa.concat_tables(tables)
+
+    def _exec_host_tree(self, node: TpuExec) -> pa.Table:
+        """Execute a subtree entirely on the host engine (no distribution
+        attempts) — the ExchangeOverflow degradation path."""
+        self.host_nodes.append(type(node).__name__)
+        out = [b for b in node.execute_all()]
+        schema = node.output_schema
+        if not out:
+            return pa.table({f.name: pa.array([], f.dtype.arrow_type())
+                             for f in schema})
+        return pa.concat_tables([batch_to_arrow(b, schema) for b in out])
 
     # -- distributed program ----------------------------------------------
     def _run_distributed(self, root: TpuExec) -> pa.Table:
@@ -255,10 +291,10 @@ class MeshExecutor:
         counts = outs[i]; i += 1
         ovfs = outs[i]
         if bool(np.any(ovfs)):
-            raise RuntimeError(
+            raise ExchangeOverflow(
                 "distributed exchange overflow (receive state exceeded 2x "
-                "local capacity — pathological skew); rerun via the host "
-                "shuffle path")
+                "local capacity — pathological skew); re-executing via the "
+                "host shuffle path")
 
         # per-device reconstruction through the standard arrow egress (keeps
         # plain strings, dictionaries and decimal128 limbs uniform)

@@ -185,8 +185,13 @@ def _worker_main(worker_id: str, ctrl) -> None:
                     src = BatchSourceExec([[batch]], schema)
                     saved = final_agg.children[0]
                     final_agg.children[0] = src
-                    out = list(final_agg.execute(0))
-                    final_agg.children[0] = saved
+                    try:
+                        out = list(final_agg.execute(0))
+                    finally:
+                        # the plan is cached across tasks: a raising execute
+                        # must not leave the spliced source in place or later
+                        # tasks silently aggregate this task's stale batch
+                        final_agg.children[0] = saved
                     tbl = (pa.concat_tables(
                         [batch_to_arrow(b, final_agg.output_schema)
                          for b in out]) if out else None)

@@ -363,3 +363,40 @@ def test_greatest_least_mixed_scale():
     assert dev[2]["g"] == D("7.00") and dev[2]["l"] == D("7.00")
     assert dev[0]["gi"] == D("2.00")
     assert dev[2]["gi"] is None
+
+
+def test_greatest_least_wide_decimal128():
+    # ADVICE r4 (high): Greatest/Least over decimal128 (>18 digits) operands
+    # — and narrow operands widened to a >18-digit result — must run on
+    # device (they are in _WIDE_OK), not crash at execute time.
+    t = pa.table({
+        "w": pa.array([D("123456789012345678901.50"), D("-2.75"), None],
+                      type=pa.decimal128(23, 2)),
+        "x": pa.array([D("9.99"), D("88888888888888888888.25"), D("4.50")],
+                      type=pa.decimal128(23, 2)),
+        "n18a": pa.array([D("999999999999999.12"), D("1.00"), None],
+                         type=pa.decimal128(17, 2)),
+        "n18b": pa.array([D("5.5000"), D("777777777777777.2500"), D("3.2500")],
+                         type=pa.decimal128(19, 4)),
+    })
+
+    def both_t(build):
+        out = []
+        for enabled in (True, False):
+            conf = RapidsConf({"spark.rapids.tpu.sql.enabled": enabled})
+            df = from_arrow(t, conf)
+            out.append(build(df).collect())
+        return out
+
+    dev, cpu = both_t(lambda df: df.select(
+        E.Greatest(col("w"), col("x")).alias("g"),
+        E.Least(col("w"), col("x")).alias("l"),
+        E.Greatest(col("n18a"), col("n18b")).alias("gn"),
+    ))
+    assert dev == cpu, f"{dev}\n{cpu}"
+    assert dev[0]["g"] == D("123456789012345678901.50")
+    assert dev[0]["l"] == D("9.99")
+    assert dev[1]["g"] == D("88888888888888888888.25")
+    assert dev[1]["l"] == D("-2.75")
+    assert dev[2]["g"] == D("4.50") and dev[2]["l"] == D("4.50")
+    assert dev[1]["gn"] == D("777777777777777.2500")
