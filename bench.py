@@ -1,101 +1,142 @@
-"""Benchmark driver: TPC-H Q1+Q6 (scan/filter/agg) + Q3 (two joins +
-grouped agg + top-N) on the TPU exec stack vs a vectorized host-CPU engine.
+"""Benchmark driver: TPC-H Q1/Q3/Q5/Q6 (SF2) + a TPC-DS subset (SF1)
+through the PLANNER (Overrides.apply — never hand-assembled exec trees,
+matching the reference where every plan comes from the rewrite,
+GpuOverrides.scala:4541) on the TPU engine vs host-CPU baselines.
 
-Prints two JSON lines; the LAST is the driver metric
+Prints JSON lines; the LAST is the driver metric
 {"metric", "value", "unit", "vs_baseline", "utilization", ...}.
 
-Methodology (this platform): the axon tunnel has a fixed ~100ms
-dispatch+readback round trip, so single-iteration wall-clock mostly measures
-the tunnel, not the engine.  Sustained throughput is the engine-relevant
-number: N iterations are dispatched back-to-back (the device pipeline keeps
-them in flight) and ONE fence closes the run; per-iteration time is
-total/N.  min AND median over repeated runs are both reported — the
-tunnel's delivered throughput swings up to ~4x run to run (shared
-infrastructure), and the min/median pair brackets that variance honestly
-(VERDICT r3 item 8).
+Methodology (this platform):
 
-``utilization`` anchors the headline to the roofline: bytes the queries
-actually touch per second divided by the MEASURED device reduce-bandwidth
-ceiling (a 1GB f32 sum timed the same pipelined way) — not a theoretical
-HBM number, the ceiling this tunnel actually delivers.
+- The axon tunnel has a fixed ~100ms dispatch+readback round trip, so
+  single-iteration wall-clock mostly measures the tunnel. Sustained
+  throughput is the engine-relevant number: DEPTH iterations are
+  dispatched back-to-back and ONE fence closes the run; per-iteration
+  time is total/DEPTH. min AND median over RUNS runs are reported (the
+  tunnel's delivered throughput swings run to run).
 
-``vs_baseline`` is the speedup over the same three queries on the host CPU
-engine (pandas/numpy — the in-environment stand-in for CPU Spark; the
-reference repo publishes no absolute numbers, BASELINE.md).
+- MEMOIZATION (VERDICT r4): the platform memoizes repeated dispatches on
+  identical device buffers — Q1 re-run on the same buffers measured
+  ~0.14s vs 1.1-1.4s on fresh buffers with identical values. Every
+  headline number here therefore cycles COPIES pre-staged input copies
+  with PERMUTED ROW ORDER (different buffer content AND identity, same
+  query results) round-robin across iterations; the same-buffer numbers
+  are printed alongside as "reused" for comparison, and the headline
+  uses the fresh-input ("rotated") numbers only.
+
+- Correctness gates: copy 0 of every query is checked row-for-row
+  against an independent baseline before timing (TPC-H: hand-vectorized
+  pandas; TPC-DS: this framework's CPU fallback engine, which shares no
+  device code with the TPU path).
+
+``vs_baseline`` is the speedup over the same queries on the host CPU:
+TPC-H against the hand-written pandas/numpy implementations below (the
+in-environment stand-in for CPU Spark; the reference repo publishes no
+absolute numbers, BASELINE.md), TPC-DS against the framework's CPU
+engine (vectorized numpy/pandas operators, plan/cpu.py).
+
+``utilization`` anchors the headline to the roofline: bytes the TPC-H
+queries touch per second divided by the MEASURED device reduce-bandwidth
+ceiling through this tunnel.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
-SF = 2.0  # 12M lineitem rows; ~800MB device-resident, well within 16GB HBM
-RUNS = 6
-DEPTH = 8   # pipelined iterations per timed run (q1+q6)
-DEPTH3 = 3  # q3 iterations per timed run (join is heavier)
+# env overrides are for smoke tests only; driver runs use the defaults
+SF_H = float(os.environ.get("BENCH_SF_H", 2.0))    # TPC-H: 12M lineitem rows
+SF_DS = float(os.environ.get("BENCH_SF_DS", 1.0))  # TPC-DS: 2.88M store_sales
+COPIES_H = 3     # pre-staged permuted input copies (TPC-H)
+COPIES_DS = 2
+RUNS = int(os.environ.get("BENCH_RUNS", 5))
+DEPTH = int(os.environ.get("BENCH_DEPTH", 4))  # pipelined iters per timed run
+TPCDS_QUERIES = ["q3", "q7", "q42", "q52", "q96"]
 
 
-def _cpu_engine(li, orders, cust):
-    """Vectorized host execution of Q6 + Q1 + Q3 over the same arrays."""
+# ---------------------------------------------------------------------------
+# CPU baselines (hand-vectorized pandas/numpy) — TPC-H
+# ---------------------------------------------------------------------------
+
+def _cpu_tpch(li, orders, cust, supp, nation, region):
     import pandas as pd
 
     df = li.to_pandas()
     odf = orders.to_pandas()
     cdf = cust.to_pandas()
+    sdf = supp.to_pandas()
+    ndf = nation.to_pandas()
+    rdf = region.to_pandas()
     ship = df.l_shipdate.to_numpy().astype("datetime64[D]").astype(np.int64)
     lo = (np.datetime64("1994-01-01") - np.datetime64("1970-01-01")).astype(int)
     hi = (np.datetime64("1995-01-01") - np.datetime64("1970-01-01")).astype(int)
     cut = (np.datetime64("1998-09-03") - np.datetime64("1970-01-01")).astype(int)
-    d0315 = np.datetime64("1995-03-15")
-    d0316 = np.datetime64("1995-03-16")
 
-    def run_q1q6():
+    def q6():
         m = ((ship >= lo) & (ship < hi)
              & (df.l_discount.to_numpy() >= 0.05 - 1e-9)
              & (df.l_discount.to_numpy() < 0.07 + 1e-9)
              & (df.l_quantity.to_numpy() < 24))
-        q6 = float((df.l_extendedprice.to_numpy()[m]
-                    * df.l_discount.to_numpy()[m]).sum())
+        return float((df.l_extendedprice.to_numpy()[m]
+                      * df.l_discount.to_numpy()[m]).sum())
+
+    def q1():
         f = df[ship < cut].copy()
         f["disc_price"] = f.l_extendedprice * (1 - f.l_discount)
         f["charge"] = f.disc_price * (1 + f.l_tax)
-        q1 = (f.groupby(["l_returnflag", "l_linestatus"], sort=True)
-              .agg(sum_qty=("l_quantity", "sum"),
-                   sum_base=("l_extendedprice", "sum"),
-                   sum_disc=("disc_price", "sum"),
-                   sum_charge=("charge", "sum"),
-                   avg_qty=("l_quantity", "mean"),
-                   avg_price=("l_extendedprice", "mean"),
-                   avg_disc=("l_discount", "mean"),
-                   n=("l_quantity", "size")))
-        return q6, q1
+        return (f.groupby(["l_returnflag", "l_linestatus"], sort=True)
+                .agg(sum_qty=("l_quantity", "sum"),
+                     sum_base=("l_extendedprice", "sum"),
+                     sum_disc=("disc_price", "sum"),
+                     sum_charge=("charge", "sum"),
+                     avg_qty=("l_quantity", "mean"),
+                     avg_price=("l_extendedprice", "mean"),
+                     avg_disc=("l_discount", "mean"),
+                     n=("l_quantity", "size")))
 
-    def run_q3():
+    def q3():
         c = cdf[cdf.c_mktsegment == "BUILDING"]
-        o = odf[odf.o_orderdate.to_numpy().astype("datetime64[D]") < d0315]
-        ll = df[df.l_shipdate.to_numpy().astype("datetime64[D]") >= d0316]
+        o = odf[odf.o_orderdate.to_numpy().astype("datetime64[D]")
+                < np.datetime64("1995-03-15")]
+        ll = df[df.l_shipdate.to_numpy().astype("datetime64[D]")
+                >= np.datetime64("1995-03-16")]
         oc = o.merge(c, left_on="o_custkey", right_on="c_custkey")
         j = ll.merge(oc, left_on="l_orderkey", right_on="o_orderkey")
         j["rev"] = j.l_extendedprice * (1 - j.l_discount)
-        g = (j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
-             .agg(revenue=("rev", "sum")).reset_index()
-             .sort_values(["revenue", "o_orderdate"],
-                          ascending=[False, True]).head(10))
-        return g
+        return (j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+                .agg(revenue=("rev", "sum")).reset_index()
+                .sort_values(["revenue", "o_orderdate"],
+                             ascending=[False, True]).head(10))
 
-    return run_q1q6, run_q3
+    def q5():
+        r = rdf[rdf.r_name == "ASIA"]
+        n = ndf.merge(r, left_on="n_regionkey", right_on="r_regionkey")
+        s = sdf.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        od = odf.o_orderdate.to_numpy().astype("datetime64[D]")
+        o = odf[(od >= np.datetime64("1994-01-01"))
+                & (od < np.datetime64("1995-01-01"))]
+        co = o.merge(cdf, left_on="o_custkey", right_on="c_custkey")
+        lco = df.merge(co, left_on="l_orderkey", right_on="o_orderkey")
+        ls = lco.merge(s, left_on=["l_suppkey", "c_nationkey"],
+                       right_on=["s_suppkey", "s_nationkey"])
+        ls["rev"] = ls.l_extendedprice * (1 - ls.l_discount)
+        return (ls.groupby("n_name").agg(revenue=("rev", "sum"))
+                .reset_index().sort_values("revenue", ascending=False))
+
+    return {"q1": q1, "q3": q3, "q5": q5, "q6": q6}
 
 
 def _measure_roofline():
     """Delivered device reduce bandwidth through this tunnel: bytes/s of a
-    pipelined 1GB f32 sum (the realistic ceiling for bandwidth-bound query
-    kernels on this setup)."""
+    pipelined 1GB f32 sum."""
     import jax
     import jax.numpy as jnp
 
-    n = 1 << 28  # 1GB f32
+    n = 1 << 28
     x = jnp.ones(n, jnp.float32)
     x.block_until_ready()
 
@@ -115,134 +156,241 @@ def _measure_roofline():
     return best
 
 
+def _permute(table, seed):
+    rng = np.random.default_rng(seed)
+    return table.take(rng.permutation(table.num_rows))
+
+
+def _canon(rows):
+    def key(v):
+        if v is None:
+            return (0, "")
+        if isinstance(v, float):
+            return (1, round(v, 6))
+        if isinstance(v, int):
+            return (1, float(v))
+        return (2, str(v))
+
+    return sorted((tuple(r.values()) for r in rows),
+                  key=lambda t: tuple(key(v) for v in t))
+
+
+def _rows_match(a, b, rel=1e-6):
+    """Canonically sorted row-set equality with float tolerance (the TPU
+    backend's f64 is a double-double with ~1e-14 relative noise)."""
+    ca, cb = _canon(a), _canon(b)
+    if len(ca) != len(cb):
+        return False
+    for ra, rb in zip(ca, cb):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) or isinstance(vb, float):
+                if va is None or vb is None:
+                    return False
+                if abs(va - vb) > rel * max(1.0, abs(va), abs(vb)):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
 def main():
+    import jax
     from spark_rapids_tpu.bench import tpch
-    from spark_rapids_tpu.bench.tpch import _source
-    from spark_rapids_tpu.columnar.batch import batch_to_arrow
+    from spark_rapids_tpu.bench import tpcds_queries as DSQ
+    from spark_rapids_tpu.bench.tpcds_schema import tables_for as ds_tables
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.plan import from_arrow
     from spark_rapids_tpu.utils.sync import fence
 
-    li = tpch.gen_lineitem(SF, seed=7)
-    orders = tpch.gen_orders(SF, seed=8)
-    cust = tpch.gen_customer(SF, seed=9)
-    n_rows = li.num_rows
+    dev_conf = RapidsConf({})
+    cpu_conf = RapidsConf({"spark.rapids.tpu.sql.enabled": False})
 
-    cpu16, cpu3 = _cpu_engine(li, orders, cust)
-    q6_expected, q1_expected = cpu16()  # warm
-    q3_expected = cpu3()
-    cpu_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        cpu16()
-        cpu3()
-        cpu_times.append(time.perf_counter() - t0)
-    cpu_all = min(cpu_times)
+    # ---- TPC-H sources + permuted copies --------------------------------
+    base_h = {
+        "lineitem": tpch.gen_lineitem(SF_H, seed=7),
+        "orders": tpch.gen_orders(SF_H, seed=8),
+        "customer": tpch.gen_customer(SF_H, seed=9),
+        "supplier": tpch.gen_supplier(SF_H, seed=10),
+        "nation": tpch.gen_nation(),
+        "region": tpch.gen_region(),
+    }
+    copies_h = [base_h] + [
+        {k: _permute(v, 100 + 7 * c + i) for i, (k, v) in
+         enumerate(base_h.items())}
+        for c in range(1, COPIES_H)
+    ]
+    h_names = ["q1", "q3", "q5", "q6"]
 
-    # device-resident sources, built once (steady-state pipeline input)
-    src = _source(li, batch_rows=1 << 24)
-    src_o = _source(orders, batch_rows=1 << 24)
-    src_c = _source(cust, batch_rows=1 << 24)
-    for s in (src, src_o, src_c):
-        for c in s._parts[0][0].columns:
-            c.data.block_until_ready()
+    def build_plans(tables, conf, builders, names, batch_rows):
+        plans = {}
+        for qn in names:
+            d = {k: from_arrow(v, conf, batch_rows=batch_rows)
+                 for k, v in tables.items()}
+            plans[qn] = builders[qn](d).physical_plan()
+        return plans
 
-    nodes = {"q6": tpch.q6(src), "q1": tpch.q1(src),
-             "q3": tpch.q3(src_c, src_o, src)}
+    h_plans = [build_plans(tabs, dev_conf, tpch.DF_QUERIES, h_names, 1 << 24)
+               for tabs in copies_h]
 
-    def run_query(name):
-        node = nodes[name]
+    def run_plan(node):
         out = []
         for p in range(node.num_partitions()):
             out.extend(node.execute(p))
         return node, out
 
-    # correctness gates (fenced + checked against the CPU engine)
-    node, bs = run_query("q6")
-    got_q6 = batch_to_arrow(bs[0], node.output_schema).to_pylist()
-    assert abs(got_q6[0]["revenue"] - q6_expected) <= 1e-6 * abs(q6_expected)
-    node, bs = run_query("q1")
-    got_q1 = [r for b in bs
-              for r in batch_to_arrow(b, node.output_schema).to_pylist()]
-    assert len(got_q1) == len(q1_expected)
-    for row, (_, e) in zip(got_q1, q1_expected.reset_index().iterrows()):
+    # ---- correctness gates (copy 0, row-for-row) ------------------------
+    from spark_rapids_tpu.columnar.batch import batch_to_arrow
+
+    cpu_h = _cpu_tpch(*[base_h[k] for k in
+                        ("lineitem", "orders", "customer", "supplier",
+                         "nation", "region")])
+    q6_exp = cpu_h["q6"]()
+    node, bs = run_plan(h_plans[0]["q6"])
+    got = [r for b in bs for r in batch_to_arrow(b, node.output_schema).to_pylist()]
+    assert abs(got[0]["revenue"] - q6_exp) <= 1e-6 * abs(q6_exp)
+    q1_exp = cpu_h["q1"]()
+    node, bs = run_plan(h_plans[0]["q1"])
+    got = [r for b in bs for r in batch_to_arrow(b, node.output_schema).to_pylist()]
+    assert len(got) == len(q1_exp)
+    for row, (_, e) in zip(got, q1_exp.reset_index().iterrows()):
         assert row["l_returnflag"] == e.l_returnflag
         assert row["count_order"] == e.n
         assert abs(row["sum_disc_price"] - e.sum_disc) <= 1e-9 * abs(e.sum_disc)
-    node, bs = run_query("q3")
-    got_q3 = [r for b in bs
-              for r in batch_to_arrow(b, node.output_schema).to_pylist()]
-    top = got_q3[:10]
-    exp3 = q3_expected.reset_index(drop=True)
-    assert len(top) == len(exp3), (len(top), len(exp3))
-    for row, (_, e) in zip(top, exp3.iterrows()):
+    q3_exp = cpu_h["q3"]().reset_index(drop=True)
+    node, bs = run_plan(h_plans[0]["q3"])
+    got = [r for b in bs for r in batch_to_arrow(b, node.output_schema).to_pylist()]
+    assert len(got) == len(q3_exp)
+    for row, (_, e) in zip(got, q3_exp.iterrows()):
         assert row["l_orderkey"] == e.l_orderkey, (row, dict(e))
         assert abs(row["revenue"] - e.revenue) <= 1e-6 * abs(e.revenue)
+    q5_exp = cpu_h["q5"]().reset_index(drop=True)
+    node, bs = run_plan(h_plans[0]["q5"])
+    got = [r for b in bs for r in batch_to_arrow(b, node.output_schema).to_pylist()]
+    assert len(got) == len(q5_exp)
+    for row, (_, e) in zip(got, q5_exp.iterrows()):
+        assert row["n_name"] == e.n_name
+        assert abs(row["revenue"] - e.revenue) <= 1e-6 * abs(e.revenue)
 
-    # sustained throughput: pipelined iterations, one fence per run
-    def timed(names, depth):
+    # CPU baseline timing (TPC-H)
+    cpu_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for qn in h_names:
+            cpu_h[qn]()
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_h_s = min(cpu_times)
+
+    # ---- TPC-DS sources + plans -----------------------------------------
+    base_ds = ds_tables(SF_DS)
+    copies_ds = [base_ds] + [
+        {k: _permute(v, 500 + 11 * c + i) for i, (k, v) in
+         enumerate(base_ds.items())}
+        for c in range(1, COPIES_DS)
+    ]
+    ds_plans = [build_plans(tabs, dev_conf, DSQ.QUERIES, TPCDS_QUERIES,
+                            1 << 22)
+                for tabs in copies_ds]
+
+    # TPC-DS correctness vs the CPU engine + CPU engine baseline timing
+    cpu_ds_s = 0.0
+    for qn in TPCDS_QUERIES:
+        d = {k: from_arrow(v, cpu_conf) for k, v in base_ds.items()}
+        cdf = DSQ.QUERIES[qn](d)
+        t0 = time.perf_counter()
+        cpu_rows = cdf.collect()
+        cpu_ds_s += time.perf_counter() - t0
+        node, bs = run_plan(ds_plans[0][qn])
+        dev_rows = [r for b in bs
+                    for r in batch_to_arrow(b, node.output_schema).to_pylist()]
+        assert _rows_match(dev_rows, cpu_rows), f"tpcds {qn} mismatch"
+
+    # ---- timed runs ------------------------------------------------------
+    def timed(plan_copies, names, depth, rotate):
         times = []
+        it = 0
         for _ in range(RUNS):
             t0 = time.perf_counter()
             outs = []
             for _ in range(depth):
+                plans = plan_copies[it % len(plan_copies) if rotate else 0]
+                it += 1
                 for qn in names:
-                    outs.append(run_query(qn)[1])
+                    outs.append(run_plan(plans[qn])[1])
             fence(outs)
             times.append((time.perf_counter() - t0) / depth)
-        return times
+        return min(times), sorted(times)[len(times) // 2]
 
-    t16 = timed(("q6", "q1"), DEPTH)
-    t3 = timed(("q3",), DEPTH3)
-    lat = {}
-    for qn in ("q6", "q1", "q3"):
-        t0 = time.perf_counter()
-        fence([run_query(qn)[1]])
-        lat[qn] = round((time.perf_counter() - t0) * 1e3, 1)
+    # warm every copy (compile + first run) before timing
+    for plans in h_plans:
+        for qn in h_names:
+            fence([run_plan(plans[qn])[1]])
+    for plans in ds_plans:
+        for qn in TPCDS_QUERIES:
+            fence([run_plan(plans[qn])[1]])
+
+    h_fresh = timed(h_plans, h_names, DEPTH, rotate=True)
+    h_reused = timed(h_plans, h_names, DEPTH, rotate=False)
+    ds_fresh = timed(ds_plans, TPCDS_QUERIES, DEPTH, rotate=True)
+    ds_reused = timed(ds_plans, TPCDS_QUERIES, DEPTH, rotate=False)
 
     roofline = _measure_roofline()
-    # bytes each iteration actually reads from device-resident sources
+
     def q_bytes(table, cols):
         return sum(table.column(c).nbytes for c in cols)
 
-    bytes_q6 = q_bytes(li, ["l_shipdate", "l_discount", "l_quantity",
-                            "l_extendedprice"])
-    bytes_q1 = q_bytes(li, ["l_shipdate", "l_quantity", "l_extendedprice",
-                            "l_discount", "l_tax", "l_returnflag",
-                            "l_linestatus"])
-    bytes_q3 = (q_bytes(li, ["l_shipdate", "l_orderkey", "l_extendedprice",
-                             "l_discount"])
-                + q_bytes(orders, ["o_orderkey", "o_custkey", "o_orderdate",
-                                   "o_shippriority"])
-                + q_bytes(cust, ["c_custkey", "c_mktsegment"]))
+    li, orders, cust = base_h["lineitem"], base_h["orders"], base_h["customer"]
+    bytes_h = (
+        q_bytes(li, ["l_shipdate", "l_discount", "l_quantity",
+                     "l_extendedprice"])
+        + q_bytes(li, ["l_shipdate", "l_quantity", "l_extendedprice",
+                       "l_discount", "l_tax", "l_returnflag", "l_linestatus"])
+        + q_bytes(li, ["l_shipdate", "l_orderkey", "l_extendedprice",
+                       "l_discount"])
+        + q_bytes(orders, ["o_orderkey", "o_custkey", "o_orderdate",
+                           "o_shippriority"])
+        + q_bytes(cust, ["c_custkey", "c_mktsegment"])
+        + q_bytes(li, ["l_orderkey", "l_suppkey", "l_extendedprice",
+                       "l_discount"])
+        + q_bytes(orders, ["o_orderkey", "o_custkey", "o_orderdate"])
+        + q_bytes(cust, ["c_custkey", "c_nationkey"])
+    )
+    rows_h = (2 * li.num_rows                       # q1 + q6
+              + li.num_rows + orders.num_rows + cust.num_rows   # q3
+              + li.num_rows + orders.num_rows + cust.num_rows)  # q5
+    rows_ds = sum(base_ds["store_sales"].num_rows for _ in TPCDS_QUERIES)
 
-    tpu_16_min, tpu_16_med = min(t16), sorted(t16)[len(t16) // 2]
-    tpu_3_min, tpu_3_med = min(t3), sorted(t3)[len(t3) // 2]
-    total_min = tpu_16_min + tpu_3_min
-    total_med = tpu_16_med + tpu_3_med
-    total_rows = 2 * n_rows + (n_rows + orders.num_rows + cust.num_rows)
-    total_bytes = bytes_q6 + bytes_q1 + bytes_q3
-    util = (total_bytes / total_min) / roofline
+    total_fresh = h_fresh[0] + ds_fresh[0]
+    total_med = h_fresh[1] + ds_fresh[1]
+    cpu_total = cpu_h_s + cpu_ds_s
+    util = (bytes_h / h_fresh[0]) / roofline
 
     print(json.dumps({
-        "latency_ms_single_iter": lat,
-        "cpu_s_q1_q3_q6": round(cpu_all, 3),
-        "tpu_s_per_iter_q1q6": {"min": round(tpu_16_min, 4),
-                                "median": round(tpu_16_med, 4)},
-        "tpu_s_per_iter_q3": {"min": round(tpu_3_min, 4),
-                              "median": round(tpu_3_med, 4)},
+        "tpch_s_per_iter": {"fresh_min": round(h_fresh[0], 4),
+                            "fresh_median": round(h_fresh[1], 4),
+                            "reused_min": round(h_reused[0], 4),
+                            "reused_median": round(h_reused[1], 4)},
+        "tpcds_s_per_iter": {"fresh_min": round(ds_fresh[0], 4),
+                             "fresh_median": round(ds_fresh[1], 4),
+                             "reused_min": round(ds_reused[0], 4),
+                             "reused_median": round(ds_reused[1], 4)},
+        "cpu_s": {"tpch_pandas": round(cpu_h_s, 3),
+                  "tpcds_cpu_engine": round(cpu_ds_s, 3)},
         "roofline_GBps": round(roofline / 1e9, 2),
-        "bytes_per_iter_GB": round(total_bytes / 1e9, 3),
+        "tpch_bytes_per_iter_GB": round(bytes_h / 1e9, 3),
+        "queries": {"tpch": h_names, "tpcds": TPCDS_QUERIES,
+                    "sf": {"tpch": SF_H, "tpcds": SF_DS}},
     }))
     print(json.dumps({
-        "metric": f"tpch_q1_q3_q6_sf{SF}_rows_per_sec",
-        "value": round(total_rows / total_min, 1),
+        "metric": "tpch4_sf2_plus_tpcds5_sf1_rows_per_sec",
+        "value": round((rows_h + rows_ds) / total_fresh, 1),
         "unit": "rows/s",
-        "vs_baseline": round(cpu_all / total_min, 3),
+        "vs_baseline": round(cpu_total / total_fresh, 3),
         "utilization": round(util, 4),
-        "value_median": round(total_rows / total_med, 1),
+        "value_median": round((rows_h + rows_ds) / total_med, 1),
     }))
 
 
 if __name__ == "__main__":
     main()
-
-

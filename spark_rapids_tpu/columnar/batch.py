@@ -290,6 +290,48 @@ def _dict_col_from_arrow(arr: pa.DictionaryArray, dt: T.DataType, cap: int,
                         None, plain, dsize, dmax)
 
 
+def _column_from_arrow(arr: pa.Array, dt: T.DataType, cap: int, n: int,
+                       dict_cache: Optional[dict]) -> DeviceColumn:
+    """One arrow array -> device column (recursive for struct/map)."""
+    if isinstance(dt, T.StructType):
+        valid = (None if arr.null_count == 0
+                 else np.asarray(arr.is_valid(), dtype=np.bool_))
+        validity = np.zeros(cap, np.bool_)
+        validity[:n] = True if valid is None else valid
+        kids = []
+        for i, f in enumerate(dt.fields):
+            child = arr.field(i)
+            if isinstance(child, pa.ChunkedArray):
+                child = child.combine_chunks()
+            kids.append(_column_from_arrow(child, f.dtype, cap, n,
+                                           dict_cache))
+        return DeviceColumn(dt, jnp.zeros(0, jnp.int32),
+                            jnp.asarray(validity), children=tuple(kids))
+    if isinstance(dt, T.MapType):
+        valid = (None if arr.null_count == 0
+                 else np.asarray(arr.is_valid(), dtype=np.bool_))
+        raw_off = np.asarray(arr.offsets, dtype=np.int32)
+        offsets = raw_off - raw_off[0]
+        n_entries = int(offsets[-1]) if n else 0
+        keys = arr.keys.slice(int(raw_off[0]), n_entries)
+        items = arr.items.slice(int(raw_off[0]), n_entries)
+        ecap = bucket_capacity(max(n_entries, 8), 8)
+        kcol = _column_from_arrow(keys, dt.key, ecap, n_entries, dict_cache)
+        vcol = _column_from_arrow(items, dt.value, ecap, n_entries,
+                                  dict_cache)
+        off = np.full(cap + 1, offsets[-1] if n else 0, dtype=np.int32)
+        off[: n + 1] = offsets
+        validity = np.zeros(cap, np.bool_)
+        validity[:n] = True if valid is None else valid
+        return DeviceColumn(dt, jnp.zeros(0, jnp.int32),
+                            jnp.asarray(validity), jnp.asarray(off),
+                            children=(kcol, vcol))
+    # scalar types: reuse the table-level paths via a one-column table
+    tmp = pa.table({"c": arr})
+    b = batch_from_arrow(tmp, capacity=cap, dict_cache=dict_cache)
+    return b.columns[0]
+
+
 def batch_from_arrow(
     table, min_bucket: int = 1024, capacity: Optional[int] = None,
     dict_cache: Optional[dict] = None,
@@ -323,7 +365,9 @@ def batch_from_arrow(
             # non-string dictionary values (or entries so long the decoded
             # worst case would overflow int32 offsets): plain layout
             arr = arr.cast(vt)
-        if (isinstance(dt, T.DecimalType)
+        if isinstance(dt, (T.StructType, T.MapType)):
+            cols.append(_column_from_arrow(arr, dt, cap, n, dict_cache))
+        elif (isinstance(dt, T.DecimalType)
                 and dt.precision > T.DecimalType.MAX_LONG_DIGITS):
             cols.append(_wide_decimal_from_arrow(arr, dt, cap, n))
         elif dt.fixed_width:
@@ -381,19 +425,29 @@ def batch_from_arrow(
 from functools import partial as _partial
 
 
+def _shrink_col(c: DeviceColumn, newcap: int, bc: int) -> DeviceColumn:
+    if c.children is not None:
+        # struct/map: slice the ROW-space arrays only; children keep their
+        # element/byte buffers (offsets still index into them correctly)
+        kids = tuple(ck if ck.capacity <= newcap
+                     else _shrink_col(ck, newcap, 0)
+                     for ck in c.children) if c.offsets is None else c.children
+        return DeviceColumn(
+            c.dtype, c.data, c.validity[:newcap],
+            c.offsets[: newcap + 1] if c.offsets is not None else None,
+            children=kids)
+    if c.offsets is not None:
+        return DeviceColumn(c.dtype, c.data[:bc] if bc else c.data,
+                            c.validity[:newcap], c.offsets[: newcap + 1])
+    d2 = c.data2[:newcap] if c.data2 is not None else None
+    return DeviceColumn(c.dtype, c.data[:newcap], c.validity[:newcap], None,
+                        c.dictionary, c.dict_size, c.dict_max_len, d2)
+
+
 @_partial(jax.jit, static_argnums=(1, 2))
 def _shrink_slice(batch: ColumnarBatch, newcap: int, byte_caps):
-    cols = []
-    for c, bc in zip(batch.columns, byte_caps):
-        if c.offsets is not None:
-            cols.append(DeviceColumn(c.dtype, c.data[:bc],
-                                     c.validity[:newcap],
-                                     c.offsets[: newcap + 1]))
-        else:
-            d2 = c.data2[:newcap] if c.data2 is not None else None
-            cols.append(DeviceColumn(c.dtype, c.data[:newcap],
-                                     c.validity[:newcap], None, c.dictionary,
-                                     c.dict_size, c.dict_max_len, d2))
+    cols = [_shrink_col(c, newcap, bc)
+            for c, bc in zip(batch.columns, byte_caps)]
     return ColumnarBatch(cols, batch.num_rows)
 
 
@@ -442,91 +496,108 @@ def batch_to_arrow(batch: ColumnarBatch, schema: T.Schema) -> pa.Table:
     # pull every device buffer in ONE batched transfer: per-array readbacks
     # serialize at ~95ms each on the tunnel platform (utils/sync.py)
     host = jax.device_get(batch.columns)
-    arrays = []
-    for col, field in zip(host, schema):
-        dt = field.dtype
-        valid_np = np.asarray(col.validity)[:n]
-        mask = None if valid_np.all() else ~valid_np
-        if col.is_dict:
-            codes = np.asarray(col.data)[:n].astype(np.int32)
-            d = col.dictionary
-            doff = np.asarray(d.offsets)[: col.dict_size + 1].astype(np.int32)
-            dbytes = np.asarray(d.data)[: int(doff[-1]) if col.dict_size else 0]
-            dvals = pa.Array.from_buffers(
-                pa.string() if dt == T.STRING else pa.binary(),
-                col.dict_size,
-                [None, pa.py_buffer(doff.tobytes()),
-                 pa.py_buffer(dbytes.tobytes())],
-            )
-            codes_arr = pa.array(codes, pa.int32(), mask=mask)
-            arr = pa.DictionaryArray.from_arrays(codes_arr, dvals).cast(
-                pa.string() if dt == T.STRING else pa.binary())
-            arrays.append(arr)
-            continue
-        if col.is_wide_decimal:
-            from spark_rapids_tpu.exec import int128 as I128
+    arrays = [_host_column_to_arrow(col, field.dtype, n)
+              for col, field in zip(host, schema)]
+    return pa.table(arrays, schema=schema.to_arrow())
+
+
+def _host_column_to_arrow(col, dt: T.DataType, n: int) -> pa.Array:
+    """One host-leaf device column -> arrow array (recursive for nested)."""
+    valid_np = np.asarray(col.validity)[:n]
+    mask = None if valid_np.all() else ~valid_np
+    if isinstance(dt, T.StructType):
+        kids = [_host_column_to_arrow(c, f.dtype, n)
+                for c, f in zip(col.children, dt.fields)]
+        arr = pa.StructArray.from_arrays(
+            kids, fields=[pa.field(f.name, f.dtype.arrow_type(),
+                                   f.nullable) for f in dt.fields],
+            mask=(pa.array(mask) if mask is not None else None))
+        return arr
+    if isinstance(dt, T.MapType):
+        offsets = np.asarray(col.offsets)[: n + 1].astype(np.int32)
+        ne = int(offsets[-1]) if n else 0
+        keys = _host_column_to_arrow(col.children[0], dt.key, ne)
+        items = _host_column_to_arrow(col.children[1], dt.value, ne)
+        off_arr = pa.array(offsets, pa.int32(), mask=(
+            np.concatenate([mask, [False]]) if mask is not None
+            else None))
+        return pa.MapArray.from_arrays(off_arr, keys, items)
+    if col.is_dict:
+        codes = np.asarray(col.data)[:n].astype(np.int32)
+        d = col.dictionary
+        doff = np.asarray(d.offsets)[: col.dict_size + 1].astype(np.int32)
+        dbytes = np.asarray(d.data)[: int(doff[-1]) if col.dict_size else 0]
+        dvals = pa.Array.from_buffers(
+            pa.string() if dt == T.STRING else pa.binary(),
+            col.dict_size,
+            [None, pa.py_buffer(doff.tobytes()),
+             pa.py_buffer(dbytes.tobytes())],
+        )
+        codes_arr = pa.array(codes, pa.int32(), mask=mask)
+        return pa.DictionaryArray.from_arrays(codes_arr, dvals).cast(
+            pa.string() if dt == T.STRING else pa.binary())
+    if col.is_wide_decimal:
+        from spark_rapids_tpu.exec import int128 as I128
+        import decimal as _d
+
+        lo = np.asarray(col.data)[:n]
+        hi = np.asarray(col.data2)[:n]
+        ints = I128.to_py_ints(hi, lo)  # already signed (hi is signed)
+        with _d.localcontext() as _c:
+            _c.prec = 50
+            pyvals = [
+                None if (mask is not None and mask[i]) else
+                _d.Decimal(v).scaleb(-dt.scale)
+                for i, v in enumerate(ints)
+            ]
+        return pa.array(pyvals, type=dt.arrow_type())
+    if dt.fixed_width:
+        values = np.asarray(col.data)[:n]
+        if isinstance(dt, T.DecimalType):
             import decimal as _d
 
-            lo = np.asarray(col.data)[:n]
-            hi = np.asarray(col.data2)[:n]
-            ints = I128.to_py_ints(hi, lo)  # already signed (hi is signed)
             with _d.localcontext() as _c:
                 _c.prec = 50
                 pyvals = [
                     None if (mask is not None and mask[i]) else
-                    _d.Decimal(v).scaleb(-dt.scale)
-                    for i, v in enumerate(ints)
+                    _d.Decimal(int(values[i])).scaleb(-dt.scale)
+                    for i in range(n)
                 ]
-            arrays.append(pa.array(pyvals, type=dt.arrow_type()))
-            continue
-        if dt.fixed_width:
-            values = np.asarray(col.data)[:n]
-            if isinstance(dt, T.DecimalType):
-                import decimal as _d
-
-                with _d.localcontext() as _c:
-                    _c.prec = 50
-                    pyvals = [
-                        None if (mask is not None and mask[i]) else
-                        _d.Decimal(int(values[i])).scaleb(-dt.scale)
-                        for i in range(n)
-                    ]
-                arr = pa.array(pyvals, type=dt.arrow_type())
-            elif dt == T.DATE:
-                arr = pa.array(values.astype(np.int32), type=pa.int32(), mask=mask)
-                arr = arr.cast(pa.date32())
-            elif dt == T.TIMESTAMP:
-                arr = pa.array(values.astype(np.int64), type=pa.int64(), mask=mask)
-                arr = arr.cast(pa.timestamp("us", tz="UTC"))
-            else:
-                arr = pa.array(values, type=dt.arrow_type(), mask=mask)
-        elif isinstance(dt, T.ArrayType):
-            offsets = np.asarray(col.offsets)[: n + 1].astype(np.int32)
-            flat = np.asarray(col.data)[: int(offsets[-1]) if n else 0]
-            values = pa.array(flat, type=dt.element.arrow_type())
-            arr = pa.ListArray.from_arrays(
-                pa.array(offsets, pa.int32()), values)
-            if mask is not None:
-                # from_arrays has no mask param: rebuild with a validity buffer
-                arr = pa.Array.from_buffers(
-                    dt.arrow_type(), n,
-                    [_validity_buffer(valid_np),
-                     pa.py_buffer(offsets.tobytes())],
-                    children=[values])
+            arr = pa.array(pyvals, type=dt.arrow_type())
+        elif dt == T.DATE:
+            arr = pa.array(values.astype(np.int32), type=pa.int32(), mask=mask)
+            arr = arr.cast(pa.date32())
+        elif dt == T.TIMESTAMP:
+            arr = pa.array(values.astype(np.int64), type=pa.int64(), mask=mask)
+            arr = arr.cast(pa.timestamp("us", tz="UTC"))
         else:
-            offsets = np.asarray(col.offsets)[: n + 1]
-            data = np.asarray(col.data)[: int(offsets[-1]) if n else 0]
+            arr = pa.array(values, type=dt.arrow_type(), mask=mask)
+    elif isinstance(dt, T.ArrayType):
+        offsets = np.asarray(col.offsets)[: n + 1].astype(np.int32)
+        flat = np.asarray(col.data)[: int(offsets[-1]) if n else 0]
+        values = pa.array(flat, type=dt.element.arrow_type())
+        arr = pa.ListArray.from_arrays(
+            pa.array(offsets, pa.int32()), values)
+        if mask is not None:
+            # from_arrays has no mask param: rebuild with a validity buffer
             arr = pa.Array.from_buffers(
-                pa.string() if dt == T.STRING else pa.binary(),
-                n,
-                [
-                    _validity_buffer(valid_np) if mask is not None else None,
-                    pa.py_buffer(offsets.astype(np.int32).tobytes()),
-                    pa.py_buffer(data.tobytes()),
-                ],
-            )
-        arrays.append(arr)
-    return pa.table(arrays, schema=schema.to_arrow())
+                dt.arrow_type(), n,
+                [_validity_buffer(valid_np),
+                 pa.py_buffer(offsets.tobytes())],
+                children=[values])
+    else:
+        offsets = np.asarray(col.offsets)[: n + 1]
+        data = np.asarray(col.data)[: int(offsets[-1]) if n else 0]
+        arr = pa.Array.from_buffers(
+            pa.string() if dt == T.STRING else pa.binary(),
+            n,
+            [
+                _validity_buffer(valid_np) if mask is not None else None,
+                pa.py_buffer(offsets.astype(np.int32).tobytes()),
+                pa.py_buffer(data.tobytes()),
+            ],
+        )
+    return arr
 
 
 def _validity_buffer(valid: np.ndarray) -> pa.Buffer:

@@ -55,7 +55,7 @@ class DeviceColumn:
     dtype: T.DataType
     data: jax.Array
     validity: jax.Array
-    offsets: Optional[jax.Array] = None  # only for plain string/binary
+    offsets: Optional[jax.Array] = None  # plain string/binary, maps
     dictionary: Optional["DeviceColumn"] = None  # only for dict-encoded
     dict_size: int = 0  # static: live entries in dictionary
     dict_max_len: int = 0  # static: longest dictionary entry in bytes
@@ -63,32 +63,43 @@ class DeviceColumn:
     # semantics) and ``data2`` the signed HIGH limb; value = hi*2^64 + lo_u.
     # Arithmetic lives in exec/int128.py. (cudf decimal128 analog.)
     data2: Optional[jax.Array] = None
+    # Nested types (struct-of-columns design, see types.StructType):
+    # STRUCT: one child per field (each capacity rows), ``data`` is a
+    #   zero-length placeholder, ``validity`` is the struct-level validity.
+    # MAP: children = [keys, values] flat entry columns; ``offsets`` maps
+    #   row -> entry range; ``data`` is a zero-length placeholder.
+    children: Optional[tuple] = None
 
     def tree_flatten(self):
         aux = (self.dtype, self.offsets is not None,
                self.dictionary is not None, self.dict_size, self.dict_max_len,
-               self.data2 is not None)
-        children = [self.data, self.validity]
+               self.data2 is not None,
+               len(self.children) if self.children is not None else -1)
+        kids = [self.data, self.validity]
         if self.offsets is not None:
-            children.append(self.offsets)
+            kids.append(self.offsets)
         if self.dictionary is not None:
-            children.append(self.dictionary)
+            kids.append(self.dictionary)
         if self.data2 is not None:
-            children.append(self.data2)
-        return tuple(children), aux
+            kids.append(self.data2)
+        if self.children is not None:
+            kids.extend(self.children)
+        return tuple(kids), aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         (dtype, has_offsets, has_dict, dict_size, dict_max_len,
-         has_data2) = aux
+         has_data2, n_children) = aux
         it = iter(children)
         data = next(it)
         validity = next(it)
         offsets = next(it) if has_offsets else None
         dictionary = next(it) if has_dict else None
         data2 = next(it) if has_data2 else None
+        kids = (tuple(next(it) for _ in range(n_children))
+                if n_children >= 0 else None)
         return cls(dtype, data, validity, offsets, dictionary, dict_size,
-                   dict_max_len, data2)
+                   dict_max_len, data2, kids)
 
     @property
     def is_wide_decimal(self) -> bool:
@@ -102,6 +113,8 @@ class DeviceColumn:
     def capacity(self) -> int:
         if self.offsets is not None:
             return self.offsets.shape[0] - 1
+        if self.children is not None:  # struct: placeholder data is empty
+            return self.validity.shape[0]
         return self.data.shape[0]
 
     @property
@@ -118,7 +131,17 @@ class DeviceColumn:
             n += self.dictionary.nbytes()
         if self.data2 is not None:
             n += self.data2.size * self.data2.dtype.itemsize
+        if self.children is not None:
+            n += sum(c.nbytes() for c in self.children)
         return n
+
+    @property
+    def is_struct(self) -> bool:
+        return isinstance(self.dtype, T.StructType)
+
+    @property
+    def is_map(self) -> bool:
+        return isinstance(self.dtype, T.MapType)
 
     def as_colval(self) -> ColVal:
         assert self.offsets is None, "ColVal is fixed-width only"

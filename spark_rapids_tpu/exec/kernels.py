@@ -61,13 +61,36 @@ def gather_column(
     out_byte_capacity: Optional[int] = None,
 ) -> DeviceColumn:
     """Gather rows of one column. ``indices`` has the output capacity;
-    ``row_valid`` marks live output rows (False rows produce null/zero).
+    ``row_valid`` marks LIVE output rows (False rows produce null/zero).
 
     Out-of-range or negative indices must be pre-clipped by the caller except
     where ``row_valid`` is False (those gather row 0 and are masked).
     """
     safe_idx = jnp.where(row_valid, indices, 0).astype(jnp.int32)
     validity = jnp.where(row_valid, col.validity[safe_idx], False)
+    if col.is_struct:
+        # struct-of-columns: move every child by the same map (recursive)
+        kids = tuple(gather_column(c, indices, row_valid & validity)
+                     for c in col.children)
+        return DeviceColumn(col.dtype, jnp.zeros(0, jnp.int32), validity,
+                            children=kids)
+    if col.is_map:
+        # entry-space gather (string byte gather generalized to entries)
+        lens = col.offsets[1:] - col.offsets[:-1]
+        out_lens = jnp.where(row_valid & validity, lens[safe_idx], 0)
+        out_offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(out_lens).astype(jnp.int32)])
+        ecap = out_byte_capacity or col.children[0].capacity
+        rows = _string_row_ids(out_offsets, ecap)
+        rows = jnp.clip(rows, 0, indices.shape[0] - 1)
+        rel = jnp.arange(ecap, dtype=jnp.int32) - out_offsets[rows]
+        src = col.offsets[safe_idx[rows]] + rel
+        src = jnp.clip(src, 0, col.children[0].capacity - 1)
+        in_range = jnp.arange(ecap, dtype=jnp.int32) < out_offsets[-1]
+        kids = tuple(gather_column(c, src, in_range) for c in col.children)
+        return DeviceColumn(col.dtype, jnp.zeros(0, jnp.int32), validity,
+                            out_offsets, children=kids)
     if col.offsets is None:
         data = col.data[safe_idx]
         data = jnp.where(row_valid & validity, data, jnp.zeros_like(data))
@@ -206,10 +229,11 @@ def gather_columns(
     Semantics identical to mapping `gather_column` over `cols`.
     """
     safe_idx = jnp.where(row_valid, indices, 0).astype(jnp.int32)
-    fixed = [i for i, c in enumerate(cols) if c.offsets is None]
+    fixed = [i for i, c in enumerate(cols)
+             if c.offsets is None and c.children is None]
     out: List[Optional[DeviceColumn]] = [None] * len(cols)
     for i, c in enumerate(cols):
-        if c.offsets is not None:
+        if c.offsets is not None or c.children is not None:
             bc = out_byte_capacities[i] if out_byte_capacities else None
             out[i] = gather_column(c, indices, row_valid, bc)
     if not fixed:

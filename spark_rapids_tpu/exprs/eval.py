@@ -44,7 +44,18 @@ class WideVal(NamedTuple):
     validity: jax.Array
 
 
-Val = Union[ColVal, StringVal, WideVal]
+class NestedVal(NamedTuple):
+    """A struct/map/array expression value: the DeviceColumn itself (its
+    struct-of-columns / offsets+children layout IS the value)."""
+
+    col: "DeviceColumn"
+
+    @property
+    def validity(self):
+        return self.col.validity
+
+
+Val = Union[ColVal, StringVal, WideVal, NestedVal]
 
 
 class EvalContext:
@@ -55,20 +66,39 @@ class EvalContext:
         self.ansi = ansi
 
     def column(self, i: int) -> Val:
-        c = self.batch.columns[i]
-        if c.is_dict:
-            # expressions work on raw bytes: decode dict-encoded columns on
-            # read (group-by/sort/gather paths consume codes directly and
-            # never come through here)
-            from spark_rapids_tpu.exec.kernels import decode_dictionary
+        return _column_to_val(self.batch.columns[i])
 
-            p = decode_dictionary(c)
-            return StringVal(p.data, p.offsets, p.validity)
-        if c.offsets is not None:
-            return StringVal(c.data, c.offsets, c.validity)
-        if c.is_wide_decimal:
-            return WideVal(c.data2, c.data, c.validity)
-        return ColVal(c.data, c.validity)
+
+def _column_to_val(c: "DeviceColumn") -> Val:
+    if c.children is not None or isinstance(c.dtype, T.ArrayType):
+        return NestedVal(c)
+    if c.is_dict:
+        # expressions work on raw bytes: decode dict-encoded columns on
+        # read (group-by/sort/gather paths consume codes directly and
+        # never come through here)
+        from spark_rapids_tpu.exec.kernels import decode_dictionary
+
+        p = decode_dictionary(c)
+        return StringVal(p.data, p.offsets, p.validity)
+    if c.offsets is not None:
+        return StringVal(c.data, c.offsets, c.validity)
+    if c.is_wide_decimal:
+        return WideVal(c.data2, c.data, c.validity)
+    return ColVal(c.data, c.validity)
+
+
+def _val_to_column(v: Val, dt: T.DataType) -> "DeviceColumn":
+    """Expression value -> DeviceColumn (project materialization)."""
+    if isinstance(v, NestedVal):
+        return v.col
+    if isinstance(v, StringVal):
+        return DeviceColumn(T.STRING if dt != T.BINARY else T.BINARY,
+                            v.data, v.validity, v.offsets)
+    if isinstance(v, WideVal):
+        return DeviceColumn(dt, v.lo, v.validity, data2=v.hi)
+    out_t = dt if dt != T.NULL else T.BOOLEAN
+    return DeviceColumn(out_t, v.data.astype(T.numpy_dtype(out_t)),
+                        v.validity)
 
 
 def _all_valid(capacity: int) -> jax.Array:
@@ -830,6 +860,84 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         out = ((q + take_hi.astype(jnp.int64)) * s).astype(
             T.numpy_dtype(expr.dtype))
         return ColVal(out, c.validity)
+    if isinstance(expr, E.GetStructField):
+        v = eval_expr(expr.child, ctx)
+        st = expr.child.dtype
+        c = v.col.children[st.field_index(expr.field)]
+        validity = c.validity & v.col.validity
+        return _column_to_val(DeviceColumn(
+            c.dtype, c.data, validity, c.offsets, c.dictionary, c.dict_size,
+            c.dict_max_len, c.data2, c.children))
+    if isinstance(expr, E.CreateNamedStruct):
+        kids = tuple(_val_to_column(eval_expr(c, ctx), c.dtype)
+                     for c in expr.children)
+        return NestedVal(DeviceColumn(
+            expr.dtype, jnp.zeros(0, jnp.int32), _all_valid(cap),
+            children=kids))
+    if isinstance(expr, E.MapKeys):
+        v = eval_expr(expr.child, ctx)
+        keys = v.col.children[0]
+        return NestedVal(DeviceColumn(expr.dtype, keys.data, v.col.validity,
+                                      v.col.offsets))
+    if isinstance(expr, E.Size):
+        v = eval_expr(expr.child, ctx)
+        lens = (v.col.offsets[1:] - v.col.offsets[:-1]).astype(jnp.int32)
+        if expr.legacy_null:
+            return ColVal(jnp.where(v.col.validity, lens, jnp.int32(-1)),
+                          _all_valid(cap))
+        return ColVal(jnp.where(v.col.validity, lens, 0), v.col.validity)
+    if isinstance(expr, E.ElementAt) and isinstance(expr.left.dtype,
+                                                    T.MapType):
+        v = eval_expr(expr.left, ctx)
+        probe = eval_expr(expr.right, ctx)
+        mcol = v.col
+        keys, vals = mcol.children
+        ecap = keys.capacity
+        rows = jnp.clip(_string_row_ids(mcol.offsets, ecap), 0, cap - 1)
+        in_range = jnp.arange(ecap, dtype=jnp.int32) < mcol.offsets[-1]
+        eq = (in_range & keys.validity
+              & (keys.data == probe.data[rows]) & probe.validity[rows])
+        sel = jax.ops.segment_min(
+            jnp.where(eq, jnp.arange(ecap, dtype=jnp.int32), ecap),
+            rows, num_segments=cap)
+        found = sel < ecap
+        sel_c = jnp.clip(sel, 0, ecap - 1)
+        validity = (found & mcol.validity & probe.validity
+                    & vals.validity[sel_c])
+        data = jnp.where(validity, vals.data[sel_c],
+                         jnp.zeros((), vals.data.dtype))
+        if vals.data2 is not None:
+            d2 = jnp.where(validity, vals.data2[sel_c],
+                           jnp.zeros((), vals.data2.dtype))
+            return WideVal(d2, data, validity)
+        return ColVal(data, validity)
+    if isinstance(expr, E.ElementAt):  # array, 1-based index (neg = from end)
+        v = eval_expr(expr.left, ctx)
+        idx = eval_expr(expr.right, ctx)
+        acol = v.col
+        off = acol.offsets
+        lens = off[1:] - off[:-1]
+        i64 = idx.data.astype(jnp.int64)
+        pos = jnp.where(i64 > 0, i64 - 1, lens.astype(jnp.int64) + i64)
+        ok = (pos >= 0) & (pos < lens) & (i64 != 0)
+        src = jnp.clip(off[:-1].astype(jnp.int64) + pos, 0,
+                       acol.data.shape[0] - 1).astype(jnp.int32)
+        validity = acol.validity & idx.validity & ok
+        data = jnp.where(validity, acol.data[src],
+                         jnp.zeros((), acol.data.dtype))
+        return ColVal(data, validity)
+    if isinstance(expr, E.ArrayContains):
+        v = eval_expr(expr.left, ctx)
+        probe = eval_expr(expr.right, ctx)
+        acol = v.col
+        ecap = acol.data.shape[0]
+        rows = jnp.clip(_string_row_ids(acol.offsets, ecap), 0, cap - 1)
+        in_range = jnp.arange(ecap, dtype=jnp.int32) < acol.offsets[-1]
+        eq = in_range & (acol.data == probe.data[rows]) & probe.validity[rows]
+        hit = jax.ops.segment_max(eq.astype(jnp.int32), rows,
+                                  num_segments=cap) > 0
+        return ColVal(hit, acol.validity & probe.validity)
+
     if isinstance(expr, (E.Greatest, E.Least)):
         vals = [eval_expr(c, ctx) for c in expr.children]
         out_t = expr.dtype
@@ -1828,23 +1936,13 @@ def project_batch(
 ) -> ColumnarBatch:
     """Evaluate a bound projection over a batch (trace-time: called under jit)."""
     ctx = EvalContext(batch, ansi)
-    cols = []
-    for e in bound:
-        v = eval_expr(e, ctx)
-        if isinstance(v, StringVal):
-            cols.append(DeviceColumn(T.STRING, v.data, v.validity, v.offsets))
-        elif isinstance(v, WideVal):
-            cols.append(DeviceColumn(e.dtype, v.lo, v.validity, data2=v.hi))
-        else:
-            dt = e.dtype if e.dtype != T.NULL else T.BOOLEAN
-            cols.append(
-                DeviceColumn(dt, v.data.astype(T.numpy_dtype(dt)), v.validity)
-            )
+    cols = [_val_to_column(eval_expr(e, ctx), e.dtype) for e in bound]
     # padding rows keep validity False
     active = batch.active_mask()
     cols = [
         DeviceColumn(c.dtype, c.data, c.validity & active, c.offsets,
-                     c.dictionary, c.dict_size, c.dict_max_len, c.data2)
+                     c.dictionary, c.dict_size, c.dict_max_len, c.data2,
+                     c.children)
         for c in cols
     ]
     return ColumnarBatch(cols, batch.num_rows)

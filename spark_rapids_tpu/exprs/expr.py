@@ -1877,6 +1877,117 @@ class Median(Percentile):
         self._params = ()
 
 
+class GetStructField(_Unary):
+    """struct.field extraction (reference: GpuGetStructField — on the
+    struct-of-columns device layout this is a child-column pick plus a
+    validity AND, zero data movement)."""
+
+    def __init__(self, child: Expression, field: str):
+        super().__init__(child)
+        self.field = field
+        self._params = (field,)
+
+    @property
+    def dtype(self):
+        st = self.child.dtype
+        assert isinstance(st, T.StructType), st
+        return st.fields[st.field_index(self.field)].dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def __repr__(self):
+        return f"{self.child!r}.{self.field}"
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(name1, val1, ...) (reference: GpuCreateNamedStruct).
+    ``names`` are static; children are the value expressions."""
+
+    def __init__(self, names, *values: Expression):
+        self.names = tuple(names)
+        self.children = tuple(values)
+        assert len(self.names) == len(self.children)
+
+    def _rebuilt(self, new_children):
+        return CreateNamedStruct(self.names, *new_children)
+
+    @property
+    def dtype(self):
+        return T.StructType([(n, c.dtype)
+                             for n, c in zip(self.names, self.children)])
+
+    @property
+    def nullable(self):
+        return False
+
+
+class MapKeys(_Unary):
+    """map_keys(m) -> array of keys (reference: GpuMapKeys — the device
+    map layout already IS offsets + flat keys: a re-label, no compute)."""
+
+    @property
+    def dtype(self):
+        mt = self.child.dtype
+        assert isinstance(mt, T.MapType), mt
+        return T.ArrayType(mt.key)
+
+
+class MapValues(_Unary):
+    """map_values(m) -> array of values (reference: GpuMapValues)."""
+
+    @property
+    def dtype(self):
+        mt = self.child.dtype
+        assert isinstance(mt, T.MapType), mt
+        return T.ArrayType(mt.value)
+
+
+class Size(_Unary):
+    """size(array|map); Spark legacy returns -1 for null input unless
+    spark.sql.legacy.sizeOfNull=false (we implement the modern null->null
+    under ``legacy_null=False``, Spark 3.x default is legacy -1)."""
+
+    def __init__(self, child: Expression, legacy_null: bool = True):
+        super().__init__(child)
+        self.legacy_null = legacy_null
+        self._params = (legacy_null,)
+
+    @property
+    def dtype(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return not self.legacy_null
+
+
+class ElementAt(_Binary):
+    """element_at(map, key) / element_at(array, 1-based index)
+    (reference: GpuElementAt)."""
+
+    @property
+    def dtype(self):
+        ct = self.left.dtype
+        if isinstance(ct, T.MapType):
+            return ct.value
+        assert isinstance(ct, T.ArrayType), ct
+        return ct.element
+
+    @property
+    def nullable(self):
+        return True
+
+
+class ArrayContains(_Binary):
+    """array_contains(arr, value) (reference: GpuArrayContains)."""
+
+    @property
+    def dtype(self):
+        return T.BOOLEAN
+
+
 def resolve(expr: Expression, schema: T.Schema) -> Expression:
     """Replace UnresolvedColumn with typed ColumnRef against a schema."""
     if isinstance(expr, UnresolvedColumn):

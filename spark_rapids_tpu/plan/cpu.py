@@ -56,9 +56,21 @@ def _col_np(table: pa.Table, i: int) -> Tuple[np.ndarray, np.ndarray]:
                             dtype=object if dt.precision > 18 else np.int64)
     elif dt == T.BOOLEAN:
         vals = np.asarray(arr.fill_null(False))
+    elif not dt.fixed_width:
+        # nested (struct/map/array) and any other var-width type: python
+        # objects — the CPU oracle favors clarity over speed
+        vals = np.empty(len(arr), dtype=object)
+        vals[:] = arr.to_pylist()
     else:
         vals = np.asarray(arr.fill_null(0)).astype(T.numpy_dtype(dt))
     return vals, valid
+
+
+def _objs_np(objs, dt: T.DataType) -> Tuple[np.ndarray, np.ndarray]:
+    """Python objects -> the (values, valid) cpu_eval convention, via an
+    arrow round trip so every type uses _col_np's canonical encoding."""
+    arr = pa.array(objs, type=dt.arrow_type())
+    return _col_np(pa.table({"c": arr}), 0)
 
 
 def cpu_eval(expr: E.Expression, table: pa.Table,
@@ -94,6 +106,63 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
     if isinstance(expr, E.Cast):
         d, m = ev(expr.child)
         return _cpu_cast(d, m, expr.child.dtype, expr.to)
+    if isinstance(expr, E.GetStructField):
+        d, m = ev(expr.child)
+        objs = [d[i].get(expr.field)
+                if (m[i] and d[i] is not None) else None for i in range(n)]
+        return _objs_np(objs, expr.dtype)
+    if isinstance(expr, E.CreateNamedStruct):
+        kid_py = []
+        for c in expr.children:
+            v, val = ev(c)
+            kid_py.append(_values_to_arrow(v, val, c.dtype).to_pylist())
+        objs = np.empty(n, object)
+        objs[:] = [{nm: kid_py[j][i] for j, nm in enumerate(expr.names)}
+                   for i in range(n)]
+        return objs, ones
+    if isinstance(expr, (E.MapKeys, E.MapValues)):
+        d, m = ev(expr.child)
+        which = 0 if isinstance(expr, E.MapKeys) else 1
+        objs = np.empty(n, object)
+        objs[:] = [[kv[which] for kv in d[i]]
+                   if (m[i] and d[i] is not None) else None for i in range(n)]
+        return objs, m.copy()
+    if isinstance(expr, E.Size):
+        d, m = ev(expr.child)
+        lens = np.array([len(d[i]) if (m[i] and d[i] is not None) else -1
+                         for i in range(n)], np.int32)
+        if expr.legacy_null:
+            return lens, ones
+        return np.where(m, lens, 0).astype(np.int32), m.copy()
+    if isinstance(expr, E.ElementAt):
+        d, m = ev(expr.left)
+        pd_, pm = ev(expr.right)
+        objs = []
+        for i in range(n):
+            out = None
+            if m[i] and pm[i] and d[i] is not None:
+                if isinstance(expr.left.dtype, T.MapType):
+                    for k, v in d[i]:
+                        if k == pd_[i]:
+                            out = v
+                            break
+                else:
+                    ix = int(pd_[i])
+                    ln = len(d[i])
+                    if ix > 0 and ix <= ln:
+                        out = d[i][ix - 1]
+                    elif ix < 0 and -ix <= ln:
+                        out = d[i][ln + ix]
+            objs.append(out)
+        return _objs_np(objs, expr.dtype)
+    if isinstance(expr, E.ArrayContains):
+        d, m = ev(expr.left)
+        pd_, pm = ev(expr.right)
+        out = np.zeros(n, np.bool_)
+        for i in range(n):
+            if m[i] and pm[i] and d[i] is not None:
+                out[i] = any(x == pd_[i] for x in d[i])
+        return out, m & pm
     if isinstance(expr, E.BinaryArithmetic):
         (a, ma), (b, mb) = ev(expr.left), ev(expr.right)
         m = ma & mb
@@ -1313,6 +1382,10 @@ def _values_to_arrow(vals: np.ndarray, valid: np.ndarray,
     if dt == T.TIMESTAMP:
         return pa.array(np.asarray(vals).astype(np.int64), pa.int64(),
                         mask=mask).cast(pa.timestamp("us", tz="UTC"))
+    if isinstance(dt, (T.StructType, T.MapType, T.ArrayType)):
+        py = [None if (mask is not None and mask[i]) else vals[i]
+              for i in range(len(vals))]
+        return pa.array(py, dt.arrow_type())
     return pa.array(np.asarray(vals).astype(T.numpy_dtype(dt)),
                     dt.arrow_type(), mask=mask)
 

@@ -84,20 +84,33 @@ class CpuAggregateExec(CpuExec, UnaryExec):
         n = t.num_rows
         groups = {}
         order = []
+
+        def _hashable(v):
+            # nested group keys (struct=dict, map=list of pairs, array=list)
+            # need a canonical hashable form
+            if isinstance(v, dict):
+                return tuple((k2, _hashable(x)) for k2, x in v.items())
+            if isinstance(v, list):
+                return tuple(_hashable(x) for x in v)
+            return v
+
         for r in range(n):
-            key = tuple(
+            raw = tuple(
                 None if not masks[k][r] else
-                (cols[k][r].item() if hasattr(cols[k][r], "item") else cols[k][r])
+                (cols[k][r].item() if hasattr(cols[k][r], "item")
+                 else cols[k][r])
                 for k in key_names)
+            key = tuple(_hashable(v) for v in raw)
             if key not in groups:
                 groups[key] = len(order)
-                order.append(key)
+                order.append(raw)  # original (un-hashable-ified) values
         if not key_names and not order:
             groups[()] = 0
             order.append(())
         gid = np.array([groups[tuple(
             None if not masks[k][r] else
-            (cols[k][r].item() if hasattr(cols[k][r], "item") else cols[k][r])
+            _hashable(cols[k][r].item() if hasattr(cols[k][r], "item")
+                      else cols[k][r])
             for k in key_names)] for r in range(n)], dtype=np.int64) \
             if n else np.zeros(0, np.int64)
         ng = len(order)
@@ -113,9 +126,9 @@ class CpuAggregateExec(CpuExec, UnaryExec):
                 nvalid = np.array([v is not None for v in vals], np.bool_)
                 out_arrays.append(_values_to_arrow(nvals, nvalid, kdt))
                 continue
-            out_arrays.append(pa.array(vals, kdt.arrow_type()
-                                       if kdt in (T.STRING,)
-                                       else None))
+            out_arrays.append(pa.array(
+                vals, kdt.arrow_type()
+                if (kdt in (T.STRING,) or not kdt.fixed_width) else None))
             if out_arrays[-1].type != kdt.arrow_type():
                 out_arrays[-1] = out_arrays[-1].cast(kdt.arrow_type())
         for (bound, name, vals, valid, extra), f in zip(

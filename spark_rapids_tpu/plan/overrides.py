@@ -60,6 +60,8 @@ _DEVICE_EXPRS = (
     E.BRound, E.Factorial, E.Positive, E.BitCount, E.BitGet,
     E.Murmur3Hash, E.XxHash64,
     E.Greatest, E.Least, E.NullIf, E.Nvl2,
+    E.GetStructField, E.CreateNamedStruct, E.MapKeys, E.Size,
+    E.ElementAt, E.ArrayContains,
     E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor, E.BitwiseNot,
     E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned,
     E.Year, E.Month, E.DayOfMonth, E.DayOfWeek, E.DayOfYear, E.Quarter,
@@ -88,6 +90,38 @@ _DEVICE_EXPRS = (
 )
 
 
+# Device uploads of in-memory tables are cached per (table, batch_rows,
+# partitions): several physical_plan() calls over the same arrow table (one
+# query re-planned, or many queries over one source) share ONE set of
+# device batches instead of re-uploading per plan. Entries die with the
+# arrow table (weakref callback).
+_DEVICE_SOURCE_CACHE: dict = {}
+
+
+def _device_source_parts(table, batch_rows: int, partitions: int):
+    import weakref
+
+    key = (id(table), batch_rows, partitions)
+    ent = _DEVICE_SOURCE_CACHE.get(key)
+    if ent is not None and ent[0]() is table:
+        return ent[1]
+    from spark_rapids_tpu.columnar.batch import (
+        batch_from_arrow, dictionary_encode_table)
+
+    t = dictionary_encode_table(table)
+    cache: dict = {}
+    batches = [batch_from_arrow(t.slice(i, batch_rows), dict_cache=cache)
+               for i in range(0, max(t.num_rows, 1), batch_rows)]
+    n_parts = max(1, min(partitions, len(batches)))
+    parts = [batches[p::n_parts] for p in range(n_parts)]
+    try:
+        ref = weakref.ref(table, lambda _: _DEVICE_SOURCE_CACHE.pop(key, None))
+    except TypeError:
+        return parts  # not weakref-able: don't cache
+    _DEVICE_SOURCE_CACHE[key] = (ref, parts)
+    return parts
+
+
 def _is_wide(dt: T.DataType) -> bool:
     return (isinstance(dt, T.DecimalType)
             and dt.precision > T.DecimalType.MAX_LONG_DIGITS)
@@ -101,6 +135,17 @@ _WIDE_OK = (E.Alias, E.ColumnRef, E.UnresolvedColumn, E.Literal, E.Cast,
             E.BinaryComparison, E.IsNull, E.IsNotNull,
             E.If, E.CaseWhen, E.Coalesce, E.Sum, E.Min, E.Max, E.Average,
             E.Count, E.First, E.Last, E.Greatest, E.Least)
+
+# expressions with a device implementation over struct/map/array operands
+# (the nested analog of _WIDE_OK); everything else touching a nested value
+# falls back. Reference: incremental nested rules, GpuOverrides.scala:911.
+_NESTED_OK = (E.Alias, E.ColumnRef, E.UnresolvedColumn,
+              E.GetStructField, E.CreateNamedStruct, E.MapKeys, E.Size,
+              E.ElementAt, E.ArrayContains, E.IsNull, E.IsNotNull)
+
+
+def _is_nested(dt: T.DataType) -> bool:
+    return isinstance(dt, (T.StructType, T.MapType, T.ArrayType))
 
 
 def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
@@ -171,6 +216,40 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
                 if k < 0 or k > 76:
                     reasons.append(
                         "decimal divide rescale outside device range")
+            # nested-type device coverage (reference:
+            # GpuOverrides.scala:911 nested rules; map values / var-width
+            # or decimal128 map keys stay on CPU this round). Central gate
+            # first: any expression touching a nested value must be in
+            # _NESTED_OK or the node falls back (mirrors _WIDE_OK).
+            nested_touch = _is_nested(bound.dtype) or any(
+                _is_nested(c.dtype) for c in bound.children)
+            if nested_touch and not isinstance(bound, _NESTED_OK):
+                reasons.append(
+                    f"{type(bound).__name__} not on device for nested types")
+            if isinstance(bound, E.MapKeys):
+                kdt = bound.child.dtype.key
+                if not kdt.fixed_width or _is_wide(kdt):
+                    reasons.append(
+                        "map_keys key type not on device")
+            if isinstance(bound, E.ElementAt):
+                lt0 = bound.left.dtype
+                if isinstance(lt0, T.MapType):
+                    if (not lt0.key.fixed_width or _is_wide(lt0.key)
+                            or not lt0.value.fixed_width):
+                        reasons.append(
+                            "element_at key/value type not on device")
+                elif isinstance(lt0, T.ArrayType):
+                    if not lt0.element.fixed_width:
+                        reasons.append(
+                            "element_at element type not on device")
+            if isinstance(bound, E.ArrayContains):
+                lt0 = bound.left.dtype
+                if not (isinstance(lt0, T.ArrayType)
+                        and lt0.element.fixed_width
+                        and bound.right.dtype.fixed_width
+                        and not _is_wide(lt0.element)
+                        and not _is_wide(bound.right.dtype)):
+                    reasons.append("array_contains type not on device")
             # probe regex compilability (reference: RegexParser transpiler
             # bail-outs -> willNotWorkOnGpu); patterns outside the DFA
             # subset fall back to CPU
@@ -290,15 +369,27 @@ class Overrides:
                     meta.will_not_work(r)
             for e in node.group_exprs:
                 try:
-                    if _is_wide(E.resolve(e, child_schema).dtype):
+                    gdt = E.resolve(e, child_schema).dtype
+                    if _is_wide(gdt):
                         meta.will_not_work(
                             "decimal128 group key not on device")
+                    if isinstance(gdt, (T.StructType, T.MapType,
+                                        T.ArrayType)):
+                        meta.will_not_work(
+                            "nested group key not on device")
                 except (TypeError, KeyError):
                     pass
         elif isinstance(node, L.Sort):
             for o in node.orders:
                 for r in check_expr(o.child, child_schema):
                     meta.will_not_work(r)
+                try:
+                    sdt = E.resolve(o.child, child_schema).dtype
+                    if isinstance(sdt, (T.StructType, T.MapType,
+                                        T.ArrayType)):
+                        meta.will_not_work("nested sort key not on device")
+                except (TypeError, KeyError):
+                    pass
         elif isinstance(node, L.Window):
             from spark_rapids_tpu.exprs import window as W
 
@@ -371,9 +462,13 @@ class Overrides:
                 for r in check_expr(e, s):
                     meta.will_not_work(r)
                 try:
-                    if _is_wide(E.resolve(e, s).dtype):
+                    jdt = E.resolve(e, s).dtype
+                    if _is_wide(jdt):
                         meta.will_not_work(
                             "decimal128 join key not on device")
+                    if isinstance(jdt, (T.StructType, T.MapType,
+                                        T.ArrayType)):
+                        meta.will_not_work("nested join key not on device")
                 except (TypeError, KeyError):
                     pass
             if node.condition is not None:
@@ -492,17 +587,9 @@ class Overrides:
                 from spark_rapids_tpu.plan.cpu import CpuInMemoryScanExec
 
                 return CpuInMemoryScanExec(node.table)
-            from spark_rapids_tpu.columnar.batch import (
-                batch_from_arrow, dictionary_encode_table)
-
-            t = dictionary_encode_table(node.table)
-            cache: dict = {}
-            batches = [batch_from_arrow(t.slice(i, node.batch_rows),
-                                        dict_cache=cache)
-                       for i in range(0, max(t.num_rows, 1), node.batch_rows)]
-            n_parts = max(1, min(node.partitions, len(batches)))
-            parts = [batches[p::n_parts] for p in range(n_parts)]
-            return BatchSourceExec(parts, node.schema)
+            return BatchSourceExec(
+                _device_source_parts(node.table, node.batch_rows,
+                                     node.partitions), node.schema)
         if isinstance(node, L.Project):
             return (ProjectExec(node.exprs, kids[0]) if on_dev
                     else CpuProjectExec(node.exprs, kids[0]))

@@ -268,6 +268,73 @@ class ArrayType(DataType):
         return self.name
 
 
+class StructType(DataType):
+    """Struct of named fields — device layout is STRUCT-OF-COLUMNS: each
+    field is its own child DeviceColumn (any supported type, recursively)
+    plus one struct-level validity. TPU-first: there is no row-wise struct
+    representation to decompose; every kernel that moves a struct moves its
+    children as ordinary packed lanes (kernels.gather_columns recursion).
+
+    Reference: GpuColumnVector.java:40 carries Spark StructType onto cudf
+    STRUCT columns; expression rules at GpuOverrides.scala:911."""
+
+    def __init__(self, fields):
+        # fields: sequence of (name, DataType) or Field
+        self.fields = [f if isinstance(f, Field) else Field(f[0], f[1])
+                       for f in fields]
+
+    @property
+    def name(self):  # type: ignore[override]
+        inner = ",".join(f"{f.name}:{f.dtype.name}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    @property
+    def fixed_width(self):
+        return False
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def arrow_type(self):
+        return pa.struct([pa.field(f.name, f.dtype.arrow_type(), f.nullable)
+                          for f in self.fields])
+
+    def __repr__(self):
+        return self.name
+
+
+class MapType(DataType):
+    """Map — device layout: int32 row offsets (like arrays/strings) + two
+    flat child columns (keys, values) in entry order. Arrow map layout
+    without the intermediate entries struct.
+
+    Reference: GpuColumnVector.java map support (LIST of STRUCT<key,val>
+    on cudf); GpuMapKeys/GpuMapValues/GpuElementAt rules."""
+
+    def __init__(self, key: DataType, value: DataType,
+                 value_contains_null: bool = True):
+        self.key = key
+        self.value = value
+        self.value_contains_null = value_contains_null
+
+    @property
+    def name(self):  # type: ignore[override]
+        return f"map<{self.key.name},{self.value.name}>"
+
+    @property
+    def fixed_width(self):
+        return False
+
+    def arrow_type(self):
+        return pa.map_(self.key.arrow_type(), self.value.arrow_type())
+
+    def __repr__(self):
+        return self.name
+
+
 # Singletons (Spark-style)
 BOOLEAN = BooleanType()
 BYTE = ByteType()
@@ -384,6 +451,14 @@ def from_arrow_type(t: pa.DataType) -> DataType:
         if not elem.fixed_width:
             raise NotImplementedError("nested variable-width arrays")
         return ArrayType(elem)
+    if pa.types.is_struct(t):
+        return StructType([Field(t.field(i).name,
+                                 from_arrow_type(t.field(i).type),
+                                 t.field(i).nullable)
+                           for i in range(t.num_fields)])
+    if pa.types.is_map(t):
+        return MapType(from_arrow_type(t.key_type),
+                       from_arrow_type(t.item_type))
     raise NotImplementedError(f"arrow type {t}")
 
 

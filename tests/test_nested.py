@@ -1,0 +1,209 @@
+"""Struct/Map nested types end to end (VERDICT r4 missing #1).
+
+Differential device-vs-CPU-engine coverage for: ingest/egress round trips,
+GetStructField, CreateNamedStruct, map_keys, map_values (CPU), size,
+element_at (map + array), array_contains, nested parquet read/write, and
+gather survival (filter over batches carrying struct/map columns).
+
+Reference: GpuColumnVector.java:40 (nested type mapping),
+GpuOverrides.scala:911 (GetStructField/CreateNamedStruct/ElementAt rules).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.exprs.expr import col, lit
+from spark_rapids_tpu.plan import from_arrow
+
+
+def nested_table():
+    return pa.table({
+        "s": pa.array(
+            [{"a": 1, "b": "x", "d": 1.5}, None,
+             {"a": 3, "b": None, "d": -2.25}, {"a": None, "b": "w", "d": 0.0},
+             {"a": 5, "b": "zz", "d": 9.75}],
+            pa.struct([("a", pa.int64()), ("b", pa.string()),
+                       ("d", pa.float64())])),
+        "m": pa.array(
+            [[(1, 10.5), (2, 20.5)], [], None, [(7, 70.0)],
+             [(1, 11.0), (3, 33.0), (5, 55.0)]],
+            pa.map_(pa.int64(), pa.float64())),
+        "arr": pa.array([[1, 2, 3], [], [9], None, [4, 5]],
+                        pa.list_(pa.int64())),
+        "k": pa.array([2, 1, 3, 7, 1], pa.int64()),
+        "v": pa.array([10, 20, 30, 40, 50], pa.int64()),
+    })
+
+
+def both(build):
+    out = []
+    for enabled in (True, False):
+        conf = RapidsConf({"spark.rapids.tpu.sql.enabled": enabled})
+        df = build(from_arrow(nested_table(), conf))
+        out.append(df.collect())
+    return out
+
+
+def assert_device(df, expect=True):
+    plan = df.physical_plan()
+    from spark_rapids_tpu.plan.cpu import CpuExec
+
+    def kinds(n):
+        yield n
+        for c in n.children:
+            yield from kinds(c)
+
+    on_cpu = [type(n).__name__ for n in kinds(plan)
+              if isinstance(n, CpuExec)]
+    if expect:
+        assert not any("Project" in k or "Filter" in k for k in on_cpu), on_cpu
+
+
+def test_roundtrip_nested_through_plan():
+    dev, cpu = both(lambda df: df.select(col("s"), col("m"), col("arr"),
+                                         col("v")))
+    assert dev == cpu
+    assert dev[0]["s"] == {"a": 1, "b": "x", "d": 1.5}
+    assert dev[0]["m"] == [(1, 10.5), (2, 20.5)]
+
+
+def test_get_struct_field():
+    def b(df):
+        return df.select(E.GetStructField(col("s"), "a").alias("a"),
+                         E.GetStructField(col("s"), "b").alias("b"),
+                         E.GetStructField(col("s"), "d").alias("d"))
+    dev, cpu = both(b)
+    assert dev == cpu
+    assert dev[1] == {"a": None, "b": None, "d": None}  # null struct row
+    assert dev[2] == {"a": 3, "b": None, "d": -2.25}
+
+
+def test_create_named_struct_and_extract():
+    def b(df):
+        st = E.CreateNamedStruct(("x", "y"), col("k"),
+                                 E.Multiply(col("v"), lit(2)))
+        return df.select(st.alias("st"),
+                         E.GetStructField(st, "y").alias("y2"))
+    dev, cpu = both(b)
+    assert dev == cpu
+    assert dev[0]["st"] == {"x": 2, "y": 20}
+    assert dev[0]["y2"] == 20
+
+
+def test_map_keys_values_size():
+    def b(df):
+        return df.select(E.MapKeys(col("m")).alias("mk"),
+                         E.MapValues(col("m")).alias("mv"),
+                         E.Size(col("m")).alias("sz"),
+                         E.Size(col("arr")).alias("asz"))
+    dev, cpu = both(b)
+    assert dev == cpu
+    assert dev[0]["mk"] == [1, 2]
+    assert dev[0]["mv"] == [10.5, 20.5]
+    assert dev[2]["sz"] == -1  # legacy sizeOfNull
+    assert dev[4]["asz"] == 2
+
+
+def test_element_at_map_and_array():
+    def b(df):
+        return df.select(E.ElementAt(col("m"), lit(1)).alias("m1"),
+                         E.ElementAt(col("m"), col("k")).alias("mk"),
+                         E.ElementAt(col("arr"), lit(2)).alias("a2"),
+                         E.ElementAt(col("arr"), lit(-1)).alias("alast"))
+    dev, cpu = both(b)
+    assert dev == cpu
+    assert dev[0]["m1"] == 10.5
+    assert dev[0]["mk"] == 20.5  # k=2 -> value 20.5
+    assert dev[4]["m1"] == 11.0
+    assert dev[0]["a2"] == 2
+    assert dev[0]["alast"] == 3
+    assert dev[1]["a2"] is None  # empty array
+
+
+def test_array_contains():
+    def b(df):
+        return df.select(E.ArrayContains(col("arr"), lit(2)).alias("c2"),
+                         E.ArrayContains(col("arr"), col("v")).alias("cv"))
+    dev, cpu = both(b)
+    assert dev == cpu
+    assert dev[0]["c2"] is True and dev[2]["c2"] is False
+    assert dev[3]["c2"] is None  # null array
+
+
+def test_filter_carries_nested_columns():
+    # gather_column recursion: struct + map + array columns survive a
+    # filter's row movement intact
+    def b(df):
+        return df.filter(E.GreaterThan(col("v"), lit(15))).select(
+            col("s"), col("m"), col("arr"), col("v"))
+    dev, cpu = both(b)
+    assert dev == cpu
+    assert len(dev) == 4
+    assert dev[0]["s"] is None  # row v=20 carries a null struct
+    assert dev[1]["s"] == {"a": 3, "b": None, "d": -2.25}
+    assert dev[1]["m"] is None and dev[3]["m"] == [(1, 11.0), (3, 33.0),
+                                                   (5, 55.0)]
+
+
+def test_nested_parquet_roundtrip(tmp_path):
+    t = nested_table()
+    path = str(tmp_path / "nested.parquet")
+    pq.write_table(t, path)
+    from spark_rapids_tpu.plan import read_parquet
+
+    for enabled in (True, False):
+        conf = RapidsConf({"spark.rapids.tpu.sql.enabled": enabled})
+        df = read_parquet(path, conf=conf).select(
+            E.GetStructField(col("s"), "a").alias("a"),
+            E.Size(col("m")).alias("sz"))
+        rows = df.collect()
+        assert rows[0] == {"a": 1, "sz": 2}
+        assert rows[2] == {"a": 3, "sz": -1}
+
+
+def test_nested_group_key_falls_back():
+    conf = RapidsConf({})
+    df = from_arrow(nested_table(), conf).group_by("s").agg(
+        E.Sum(col("v")).alias("sv"))
+    # must not crash: nested group keys run on the CPU engine
+    rows = df.collect()
+    assert sum(r["sv"] for r in rows) == 150
+
+
+def test_struct_write_parquet(tmp_path):
+    # device plan output with struct column written back to parquet
+    conf = RapidsConf({})
+    df = from_arrow(nested_table(), conf).select(
+        E.CreateNamedStruct(("k", "v"), col("k"), col("v")).alias("kv"))
+    out = df.to_arrow()
+    p = str(tmp_path / "out.parquet")
+    pq.write_table(out, p)
+    back = pq.read_table(p)
+    assert back.to_pylist()[0]["kv"] == {"k": 2, "v": 10}
+
+
+def test_nested_unsupported_exprs_fall_back():
+    # central _NESTED_OK gate: If over structs, First(struct) aggregates and
+    # decimal128 map keys run on the CPU engine, not crash on device
+    import decimal as D
+    t = pa.table({
+        "s": pa.array([{"a": 1}, {"a": 2}], pa.struct([("a", pa.int64())])),
+        "wm": pa.array([[(D.Decimal(10) ** 20, 1)], []],
+                       pa.map_(pa.decimal128(22, 0), pa.int64())),
+        "c": pa.array([True, False]),
+        "v": pa.array([1, 2], pa.int64()),
+    })
+    conf = RapidsConf({})
+    df = from_arrow(t, conf)
+    rows = df.select(E.If(col("c"), col("s"), col("s")).alias("i"),
+                     E.MapKeys(col("wm")).alias("wk")).collect()
+    assert rows[0]["i"] == {"a": 1}
+    assert rows[0]["wk"] == [D.Decimal(10) ** 20]
+    rows2 = (from_arrow(t, conf).group_by("v")
+             .agg(E.First(col("s")).alias("fs")).sort("v").collect())
+    assert rows2[0]["fs"] == {"a": 1}
