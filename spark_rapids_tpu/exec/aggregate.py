@@ -1133,6 +1133,15 @@ def concat_jit(batches: Sequence[ColumnarBatch],
 
     ``out_capacity`` may be smaller than the capacity sum when the caller
     knows the live row total (coalesce compaction)."""
+    if any(c.children is not None for c in batches[0].columns):
+        # nested (struct/map) columns: host arrow concat (correct for every
+        # layout; device nested concat is future work)
+        from spark_rapids_tpu.columnar.batch import concat_batches
+        from spark_rapids_tpu import types as _T
+
+        schema = _T.Schema([_T.Field(f"c{i}", c.dtype, True)
+                            for i, c in enumerate(batches[0].columns)])
+        return concat_batches(list(batches), schema)
     # dict columns: codes are only comparable when every batch shares ONE
     # device dictionary (object identity, guaranteed for batches sliced from
     # one ingest); otherwise decode to plain bytes before concatenating

@@ -54,9 +54,14 @@ class WindowExec(UnaryExec):
     """Appends window columns to the child's output (rows re-ordered to
     partition-sorted order, as Spark's WindowExec does)."""
 
-    def __init__(self, window_exprs: Sequence[E.Expression], child: TpuExec):
+    def __init__(self, window_exprs: Sequence[E.Expression], child: TpuExec,
+                 streaming: bool = False):
         super().__init__(child)
         self.window_exprs = list(window_exprs)  # Alias(WindowExpression) ...
+        # streaming=True is a PLANNER contract: the child stream is already
+        # (partition, order)-sorted ACROSS batches (the planner inserts the
+        # out-of-core sort); plan_stream_mode classified the group
+        self.streaming = streaming
         self._prepared = False
         self._register_metric("windowTimeNs")
 
@@ -99,7 +104,14 @@ class WindowExec(UnaryExec):
         def run(batch):
             return self._compute(batch)
 
+        @jax.jit
+        def run_presorted(batch):
+            # planner-sorted stream: the within-batch sort is an identity
+            # permutation — skip it (and its two full-batch gathers)
+            return self._compute(batch, presorted=True)
+
         self._run = run
+        self._run_presorted = run_presorted
         self._prepared = True
 
     @property
@@ -114,18 +126,388 @@ class WindowExec(UnaryExec):
         return f"TpuWindow [{', '.join(n for _, n in self._wins)}] {self._spec!r}" \
             if self._prepared else "TpuWindow"
 
+    # -- streaming classification -----------------------------------------
+    MAX_BOUNDED_CONTEXT = 1024  # rows of carried neighbor context
+
+    @staticmethod
+    def plan_stream_mode(window_exprs, child_schema):
+        """Classify a window group for batch-streaming execution.
+
+        Returns ("running", 0) when every function is a carried-state
+        running computation (ROWS UNBOUNDED..CURRENT aggregates, rankings)
+        over fixed-width keys, ("bounded", K) when every function only
+        needs K neighbor rows of context (bounded ROWS frames, lead/lag),
+        else None (single-batch path; reference: the GpuRunningWindowExec /
+        GpuBatchedBoundedWindowExec split, GpuWindowExecMeta.scala:262-299).
+        """
+        spec = None
+        run_ok, bnd_ok, k = True, True, 0
+        for e in window_exprs:
+            func = e.child if isinstance(e, E.Alias) else e
+            if not isinstance(func, W.WindowExpression):
+                return None
+            spec = spec or func.spec
+            f = func.function
+            frame = func.spec.resolved_frame()
+            if isinstance(f, (W.RowNumber, W.Rank, W.DenseRank)):
+                bnd_ok = False
+            elif isinstance(f, (W.Lead, W.Lag)):
+                run_ok = False
+                k = max(k, abs(f.offset))
+            elif (isinstance(f, (E.Sum, E.Count, E.Min, E.Max))
+                  and frame.kind == "rows" and frame.is_running):
+                bnd_ok = False
+            elif (isinstance(f, E.AggregateExpression)
+                  and frame.kind == "rows"
+                  and frame.start is not W.UNBOUNDED
+                  and frame.end is not W.UNBOUNDED):
+                run_ok = False
+                k = max(k, abs(frame.start), abs(frame.end))
+            else:
+                return None
+        if spec is None:
+            return None
+        if run_ok:
+            try:
+                # carried key scalars compare with raw equality: fixed-width
+                # non-float non-wide keys only (float NaN/-0.0 canonical
+                # equality and limb pairs would need keys_equal semantics)
+                for p in list(spec.partition_by) + [o.child
+                                                    for o in spec.order_by]:
+                    dt = E.resolve(p, child_schema).dtype
+                    if (not dt.fixed_width or dt in T.FRACTIONAL_TYPES
+                            or (isinstance(dt, T.DecimalType)
+                                and dt.precision > 18)):
+                        return None
+                # running float min/max carry would need Spark NaN ordering;
+                # wide-decimal (two-limb) results would need a limb-pair
+                # carry — both stay on the single-batch path
+                for e in window_exprs:
+                    func = e.child if isinstance(e, E.Alias) else e
+                    f = func.function
+                    if isinstance(f, E.AggregateExpression) and f.children:
+                        ff = E.resolve(f, child_schema)
+                        fdt = ff.children[0].dtype
+                        if (isinstance(f, (E.Min, E.Max))
+                                and fdt in T.FRACTIONAL_TYPES):
+                            return None
+                        rdt = ff.dtype
+                        if (isinstance(rdt, T.DecimalType)
+                                and rdt.precision > 18) or (
+                                isinstance(fdt, T.DecimalType)
+                                and fdt.precision > 18):
+                            return None
+            except (TypeError, KeyError, NotImplementedError):
+                return None
+            return ("running", 0)
+        if bnd_ok and k <= WindowExec.MAX_BOUNDED_CONTEXT:
+            return ("bounded", max(k, 1))
+        return None
+
     # -- execution ---------------------------------------------------------
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._prepare()
-        batches = list(self.child.execute(partition))
-        if not batches:
+        it = self.child.execute(partition)
+        first = next(it, None)
+        if first is None:
             return
-        whole = batches[0] if len(batches) == 1 else concat_jit(batches)
+        second = next(it, None)
+        if second is None:
+            with self.timer("windowTimeNs"):
+                yield self._run(first)
+            return
+        mode = (self.plan_stream_mode(self.window_exprs,
+                                      self.child.output_schema)
+                if self.streaming else None)
+        if mode is None:
+            # single-batch fallback: concat the whole partition
+            batches = [first, second] + list(it)
+            whole = concat_jit(batches)
+            with self.timer("windowTimeNs"):
+                yield self._run(whole)
+            return
+
+        def stream():
+            yield first
+            yield second
+            yield from it
+
+        if mode[0] == "running":
+            yield from self._exec_running(stream())
+        else:
+            yield from self._exec_bounded(stream(), mode[1])
+
+    def _exec_bounded(self, stream, k: int) -> Iterator[ColumnarBatch]:
+        """Bounded-context streaming: each batch is computed over
+        [prev K-row tail | batch | next K-row head] and only the middle
+        rows are emitted — frames/offsets never reach further than K rows.
+        Input stream must be (partition, order)-sorted across batches
+        (the planner inserts the sort)."""
+        from spark_rapids_tpu.exec.sort import _slice_rows
+        from spark_rapids_tpu.columnar.batch import bucket_capacity
+
+        kcap = bucket_capacity(k, 16)
+
+        def head(b):
+            return _slice_rows(b, jnp.int32(0),
+                               jnp.minimum(b.num_rows, k), kcap,
+                               self._byte_caps(b))
+
+        def tail(b):
+            start = jnp.maximum(b.num_rows - k, 0)
+            return _slice_rows(b, start, jnp.minimum(b.num_rows, k), kcap,
+                               self._byte_caps(b))
+
+        def rechunked():
+            # every non-final chunk must hold >= k rows, or the one-neighbor
+            # context window could miss rows (mid-stream out-of-core merge
+            # pieces can be tiny); host-sync row counts are cheap here
+            pending: List[ColumnarBatch] = []
+            pending_rows = 0
+            for b in stream:
+                pending.append(b)
+                pending_rows += b.row_count()
+                if pending_rows >= k:
+                    yield (pending[0] if len(pending) == 1
+                           else concat_jit(pending))
+                    pending, pending_rows = [], 0
+            if pending:
+                yield pending[0] if len(pending) == 1 else concat_jit(pending)
+
+        prev_tail = None
+        cur = None
+        for nxt in rechunked():
+            if cur is not None:
+                yield self._emit_bounded(prev_tail, cur, head(nxt))
+                prev_tail = tail(cur)
+            cur = nxt
+        yield self._emit_bounded(prev_tail, cur, None)
+
+    def _byte_caps(self, b: ColumnarBatch):
+        return tuple(c.data.shape[0] if c.offsets is not None else 0
+                     for c in b.columns)
+
+    def _emit_bounded(self, prev_tail, cur, next_head) -> ColumnarBatch:
+        from spark_rapids_tpu.exec.sort import _slice_rows
+
+        parts = [p for p in (prev_tail, cur, next_head) if p is not None]
+        ext = parts[0] if len(parts) == 1 else concat_jit(parts)
         with self.timer("windowTimeNs"):
-            yield self._run(whole)
+            out = self._run_presorted(ext)
+        start = (prev_tail.num_rows if prev_tail is not None
+                 else jnp.int32(0))
+        return _slice_rows(out, start, cur.num_rows, cur.capacity,
+                           self._byte_caps(out))
+
+    def _exec_running(self, stream) -> Iterator[ColumnarBatch]:
+        """Carried-state streaming (GpuRunningWindowExec analog): each batch
+        computes its windows locally, then rows continuing the previous
+        batch's last partition are fixed up with the carried state."""
+        carry = None
+        for b in stream:
+            if carry is None:
+                carry = self._init_carry(b)
+            with self.timer("windowTimeNs"):
+                out, carry = self._run_streaming(b, carry)
+            yield out
+
+    def _init_carry(self, batch: ColumnarBatch):
+        """Zero carry: key slots (data, valid) per partition+order key and
+        one (value, valid) state slot per window function."""
+        self._prepare()
+        cs = self.child.output_schema
+        keys = []
+        for p in self._part_bound:
+            keys.append((jnp.zeros(1, T.numpy_dtype(p.dtype)),
+                         jnp.zeros(1, jnp.bool_)))
+        orders = []
+        for ob, _a, _n in self._order_bound:
+            orders.append((jnp.zeros(1, T.numpy_dtype(ob.dtype)),
+                          jnp.zeros(1, jnp.bool_)))
+        funcs = []
+        for f, frame, _name in self._bound_wins:
+            dt = (jnp.float64 if f.dtype in T.FRACTIONAL_TYPES
+                  else jnp.int64)
+            funcs.append((jnp.zeros(1, dt), jnp.zeros(1, jnp.bool_)))
+        return {"valid": jnp.zeros(1, jnp.bool_), "keys": tuple(keys),
+                "orders": tuple(orders), "funcs": tuple(funcs),
+                "rn": jnp.zeros(1, jnp.int64), "rank": jnp.zeros(1, jnp.int64),
+                "dense": jnp.zeros(1, jnp.int64)}
+
+    def _run_streaming(self, batch, carry):
+        key = ("stream", batch.capacity)
+        cache = getattr(self, "_stream_jits", None)
+        if cache is None:
+            cache = self._stream_jits = {}
+        if key not in cache:
+            cache[key] = jax.jit(self._streaming_compute)
+        return cache[key](batch, carry)
+
+    def _streaming_compute(self, batch, carry):
+        out = self._compute(batch, presorted=True)
+        cap = batch.capacity
+        n = batch.num_rows
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        active = batch.active_mask()
+        ctx = EV.EvalContext(batch)
+        # input is globally (partition, order)-sorted: geometry recomputed
+        # directly in input order
+        kvals = []
+        for p in self._part_bound:
+            v = EV.eval_expr(p, ctx)
+            kvals.append((v.data, v.validity))
+        ovals = []
+        for ob, _a, _nf in self._order_bound:
+            v = EV.eval_expr(ob, ctx)
+            ovals.append((v.data, v.validity))
+        prev = jnp.concatenate([idx[:1], idx[:-1]])
+
+        def neq_prev(pairs):
+            ne = jnp.zeros(cap, jnp.bool_)
+            for d, va in pairs:
+                ne = ne | (d != d[prev]) | (va != va[prev])
+            return ne
+
+        seg_start_flag = (~active) | (idx == 0) | neq_prev(kvals)
+        seg_id = jnp.cumsum(seg_start_flag.astype(jnp.int32)) - 1
+        in_seg0 = (seg_id == 0) & active
+
+        def key_match(pairs, slots):
+            ok = carry["valid"][0]
+            for (d, va), (cd, cv) in zip(pairs, slots):
+                row0_d, row0_v = d[0], va[0]
+                ok = ok & ((row0_v & cv[0] & (row0_d == cd[0]))
+                           | (~row0_v & ~cv[0]))
+            return ok
+
+        cont_part = key_match(kvals, carry["keys"])
+        cont_peer = cont_part & key_match(ovals, carry["orders"])
+        cont_rows = in_seg0 & cont_part
+        run_start_flag = seg_start_flag | neq_prev(ovals)
+        run_id = jnp.cumsum(run_start_flag.astype(jnp.int32)) - 1
+        in_run0 = (run_id == 0) & active
+
+        base = len(self.child.output_schema)
+        cols = list(out.columns)
+        new_funcs = []
+        last = jnp.clip(n - 1, 0, cap - 1)
+        c_rn = jnp.where(carry["valid"][0] & cont_part, carry["rn"][0], 0)
+        for j, (f, frame, _name) in enumerate(self._bound_wins):
+            c = cols[base + j]
+            cval, cvalid = carry["funcs"][j]
+            cv0 = cvalid[0] & cont_part
+            if isinstance(f, W.RowNumber):
+                data = jnp.where(cont_rows, c.data.astype(jnp.int64) + c_rn,
+                                 c.data.astype(jnp.int64))
+                c = DeviceColumn(c.dtype, data.astype(c.data.dtype),
+                                 c.validity)
+                new_funcs.append((data[last][None].astype(jnp.int64),
+                                  active[last][None]))
+            elif isinstance(f, W.Rank):
+                d64 = c.data.astype(jnp.int64)
+                shifted = jnp.where(
+                    cont_rows,
+                    jnp.where(cont_peer & in_run0,
+                              jnp.where(carry["valid"][0],
+                                        carry["rank"][0], d64),
+                              d64 + c_rn),
+                    d64)
+                c = DeviceColumn(c.dtype, shifted.astype(c.data.dtype),
+                                 c.validity)
+                new_funcs.append((shifted[last][None], active[last][None]))
+            elif isinstance(f, W.DenseRank):
+                d64 = c.data.astype(jnp.int64)
+                c_dense = jnp.where(carry["valid"][0] & cont_part,
+                                    carry["dense"][0], 0)
+                adj = jnp.where(cont_peer, c_dense - 1, c_dense)
+                shifted = jnp.where(cont_rows, d64 + jnp.maximum(adj, 0),
+                                    d64)
+                c = DeviceColumn(c.dtype, shifted.astype(c.data.dtype),
+                                 c.validity)
+                new_funcs.append((shifted[last][None], active[last][None]))
+            elif isinstance(f, E.Count):
+                d64 = c.data.astype(jnp.int64)
+                add = jnp.where(cv0, cval[0], 0)
+                shifted = jnp.where(cont_rows, d64 + add, d64)
+                c = DeviceColumn(c.dtype, shifted.astype(c.data.dtype),
+                                 c.validity)
+                new_funcs.append((shifted[last][None], active[last][None]))
+            elif isinstance(f, E.Sum):
+                st = c.data.dtype
+                add = jnp.where(cv0, cval[0].astype(st), jnp.zeros((), st))
+                data = jnp.where(cont_rows & c.validity, c.data + add,
+                                 jnp.where(cont_rows & ~c.validity & cv0,
+                                           add, c.data))
+                valid = c.validity | (cont_rows & cv0)
+                c = DeviceColumn(c.dtype, jnp.where(valid, data,
+                                                    jnp.zeros((), st)), valid)
+                new_funcs.append((data[last][None].astype(
+                    jnp.float64 if f.dtype in T.FRACTIONAL_TYPES
+                    else jnp.int64), (valid[last] & active[last])[None]))
+            elif isinstance(f, (E.Min, E.Max)):
+                st = c.data.dtype
+                op = jnp.minimum if isinstance(f, E.Min) else jnp.maximum
+                cvs = cval[0].astype(st)
+                data = jnp.where(
+                    cont_rows & c.validity & cv0, op(c.data, cvs),
+                    jnp.where(cont_rows & ~c.validity & cv0, cvs, c.data))
+                valid = c.validity | (cont_rows & cv0)
+                c = DeviceColumn(c.dtype, jnp.where(valid, data,
+                                                    jnp.zeros((), st)), valid)
+                new_funcs.append((data[last][None].astype(
+                    jnp.float64 if f.dtype in T.FRACTIONAL_TYPES
+                    else jnp.int64), (valid[last] & active[last])[None]))
+            else:  # pragma: no cover - gated by plan_stream_mode
+                new_funcs.append((cval, cvalid))
+            cols[base + j] = c
+
+        # new carry from the last live row (empty batch keeps the old)
+        nonempty = n > 0
+
+        def upd(new, old):
+            return jnp.where(nonempty, new, old)
+
+        rn_col = None
+        for j, (f, _fr, _nm) in enumerate(self._bound_wins):
+            if isinstance(f, W.RowNumber):
+                rn_col = cols[base + j].data.astype(jnp.int64)
+        if rn_col is None:
+            # track row_number implicitly for rank shifting
+            local_rn = idx - _segmented_scan(
+                jnp.where(seg_start_flag, idx, -1), seg_start_flag,
+                jnp.maximum) + 1
+            rn_col = jnp.where(cont_rows, local_rn + c_rn,
+                               local_rn).astype(jnp.int64)
+        rank_val = carry["rank"]
+        dense_val = carry["dense"]
+        for j, (f, _fr, _nm) in enumerate(self._bound_wins):
+            if isinstance(f, W.Rank):
+                rank_val = upd(cols[base + j].data.astype(jnp.int64)[last][None],
+                               carry["rank"])
+            if isinstance(f, W.DenseRank):
+                dense_val = upd(cols[base + j].data.astype(
+                    jnp.int64)[last][None], carry["dense"])
+        new_carry = {
+            "valid": upd(active[last][None], carry["valid"]),
+            "keys": tuple(
+                (upd(d[last][None], cd), upd(va[last][None], cv))
+                for (d, va), (cd, cv) in zip(kvals, carry["keys"])),
+            "orders": tuple(
+                (upd(d[last][None], cd), upd(va[last][None], cv))
+                for (d, va), (cd, cv) in zip(ovals, carry["orders"])),
+            "funcs": tuple(
+                (upd(nv, carry["funcs"][j][0]),
+                 upd(nvv, carry["funcs"][j][1]))
+                for j, (nv, nvv) in enumerate(new_funcs)),
+            "rn": upd(rn_col[last][None], carry["rn"]),
+            "rank": rank_val,
+            "dense": dense_val,
+        }
+        return ColumnarBatch(cols, batch.num_rows), new_carry
 
     # -- traced computation ------------------------------------------------
-    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+    def _compute(self, batch: ColumnarBatch,
+                 presorted: bool = False) -> ColumnarBatch:
         cap = batch.capacity
         ctx = EV.EvalContext(batch)
         key_cols: List[DeviceColumn] = []
@@ -139,7 +521,10 @@ class WindowExec(UnaryExec):
             v = EV.eval_expr(ob, ctx)
             key_cols.append(_to_col(ob.dtype, v))
             specs.append(K.SortSpec(len(key_cols) - 1, asc, nf))
-        if key_cols:
+        if key_cols and presorted:
+            sbatch = batch
+            skeys = ColumnarBatch(key_cols, batch.num_rows)
+        elif key_cols:
             key_batch = ColumnarBatch(key_cols, batch.num_rows)
             order = K.sort_indices(key_batch, specs)
             sbatch = K.gather_batch(batch, order, batch.num_rows)

@@ -148,6 +148,13 @@ def _is_nested(dt: T.DataType) -> bool:
     return isinstance(dt, (T.StructType, T.MapType, T.ArrayType))
 
 
+def _struct_has_varwidth(dt: T.DataType) -> bool:
+    if isinstance(dt, T.StructType):
+        return any(not f.dtype.fixed_width or _struct_has_varwidth(f.dtype)
+                   for f in dt.fields)
+    return False
+
+
 def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
     """Reasons this expression can't run on device (empty = supported)."""
     reasons: List[str] = []
@@ -475,6 +482,15 @@ class Overrides:
                 pair = T.Schema(list(node.left.schema) + list(node.right.schema))
                 for r in check_expr(node.condition, pair):
                     meta.will_not_work(r)
+            # join gathers can duplicate rows; var-width STRUCT CHILDREN
+            # have no per-child output byte bound yet (top-level strings and
+            # map entries do) — such payloads stay on CPU
+            for s in (node.left.schema, node.right.schema):
+                for f in s:
+                    if _struct_has_varwidth(f.dtype):
+                        meta.will_not_work(
+                            f"struct column {f.name} with var-width fields "
+                            "not on device in joins")
 
     # -- convert -----------------------------------------------------------
     def _rewrite_distinct(self, plan: L.LogicalPlan) -> L.LogicalPlan:
@@ -699,9 +715,20 @@ class Overrides:
             else:
                 exchange = ShuffleExchangeExec(SinglePartitioner(), child)
             child = exchange
-        # window computation is per batch: require one batch per partition
-        # (the batch-spanning specializations are the running-window exec's
-        # job; reference GpuWindowExecMeta.scala:262-299)
+        # batch-streaming window groups (running / bounded-context — the
+        # GpuRunningWindowExec / GpuBatchedBoundedWindowExec analogs,
+        # GpuWindowExecMeta.scala:262-299) take a (partition, order)-sorted
+        # STREAM of batches: out-of-core sort upstream, no single-batch
+        # coalesce, so a window partition never has to fit in one batch.
+        mode = WindowExec.plan_stream_mode(node.window_exprs,
+                                           child.output_schema)
+        if mode is not None:
+            from spark_rapids_tpu.exec.sort import SortExec
+            orders = ([SortOrder(p) for p in spec.partition_by]
+                      + list(spec.order_by))
+            child = SortExec(orders, child, out_of_core=True)
+            return WindowExec(node.window_exprs, child, streaming=True)
+        # remaining frame shapes compute over one batch per partition
         child = CoalesceBatchesExec(child, require_single=True)
         return WindowExec(node.window_exprs, child)
 

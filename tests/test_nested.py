@@ -77,6 +77,7 @@ def test_get_struct_field():
         return df.select(E.GetStructField(col("s"), "a").alias("a"),
                          E.GetStructField(col("s"), "b").alias("b"),
                          E.GetStructField(col("s"), "d").alias("d"))
+    assert_device(b(from_arrow(nested_table(), RapidsConf({}))))
     dev, cpu = both(b)
     assert dev == cpu
     assert dev[1] == {"a": None, "b": None, "d": None}  # null struct row
@@ -115,6 +116,7 @@ def test_element_at_map_and_array():
                          E.ElementAt(col("m"), col("k")).alias("mk"),
                          E.ElementAt(col("arr"), lit(2)).alias("a2"),
                          E.ElementAt(col("arr"), lit(-1)).alias("alast"))
+    assert_device(b(from_arrow(nested_table(), RapidsConf({}))))
     dev, cpu = both(b)
     assert dev == cpu
     assert dev[0]["m1"] == 10.5
@@ -207,3 +209,15 @@ def test_nested_unsupported_exprs_fall_back():
     rows2 = (from_arrow(t, conf).group_by("v")
              .agg(E.First(col("s")).alias("fs")).sort("v").collect())
     assert rows2[0]["fs"] == {"a": 1}
+
+
+def test_nested_multibatch_concat():
+    # struct/map columns through multi-batch coalesce/concat paths
+    conf = RapidsConf({})
+    df = (from_arrow(nested_table(), conf, batch_rows=2)
+          .filter(E.GreaterThan(col("v"), lit(0)))
+          .select(col("s"), col("m"), col("v")))
+    rows = df.sort("v").collect()
+    assert len(rows) == 5
+    assert rows[0]["s"] == {"a": 1, "b": "x", "d": 1.5}
+    assert rows[4]["m"] == [(1, 11.0), (3, 33.0), (5, 55.0)]
