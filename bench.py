@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -195,6 +196,11 @@ def _rows_match(a, b, rel=1e-6):
     return True
 
 
+def _mark(msg):
+    print(f"[bench] {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr,
+          flush=True)
+
+
 def main():
     import jax
     from spark_rapids_tpu.bench import tpch
@@ -231,6 +237,7 @@ def main():
             plans[qn] = builders[qn](d).physical_plan()
         return plans
 
+    _mark("tpch plans+uploads")
     h_plans = [build_plans(tabs, dev_conf, tpch.DF_QUERIES, h_names, 1 << 24)
                for tabs in copies_h]
 
@@ -243,6 +250,7 @@ def main():
     # ---- correctness gates (copy 0, row-for-row) ------------------------
     from spark_rapids_tpu.columnar.batch import batch_to_arrow
 
+    _mark("tpch correctness gates")
     cpu_h = _cpu_tpch(*[base_h[k] for k in
                         ("lineitem", "orders", "customer", "supplier",
                          "nation", "region")])
@@ -273,6 +281,7 @@ def main():
         assert row["n_name"] == e.n_name
         assert abs(row["revenue"] - e.revenue) <= 1e-6 * abs(e.revenue)
 
+    _mark("tpch cpu baseline")
     # CPU baseline timing (TPC-H)
     cpu_times = []
     for _ in range(3):
@@ -283,6 +292,7 @@ def main():
     cpu_h_s = min(cpu_times)
 
     # ---- TPC-DS sources + plans -----------------------------------------
+    _mark("tpcds gen+plans")
     base_ds = ds_tables(SF_DS)
     copies_ds = [base_ds] + [
         {k: _permute(v, 500 + 11 * c + i) for i, (k, v) in
@@ -294,6 +304,7 @@ def main():
                 for tabs in copies_ds]
 
     # TPC-DS correctness vs the CPU engine + CPU engine baseline timing
+    _mark("tpcds correctness + cpu baseline")
     cpu_ds_s = 0.0
     for qn in TPCDS_QUERIES:
         d = {k: from_arrow(v, cpu_conf) for k, v in base_ds.items()}
@@ -306,6 +317,7 @@ def main():
                     for r in batch_to_arrow(b, node.output_schema).to_pylist()]
         assert _rows_match(dev_rows, cpu_rows), f"tpcds {qn} mismatch"
 
+    _mark("warmup")
     # ---- timed runs ------------------------------------------------------
     def timed(plan_copies, names, depth, rotate):
         times = []
@@ -330,11 +342,13 @@ def main():
         for qn in TPCDS_QUERIES:
             fence([run_plan(plans[qn])[1]])
 
+    _mark("timed runs")
     h_fresh = timed(h_plans, h_names, DEPTH, rotate=True)
     h_reused = timed(h_plans, h_names, DEPTH, rotate=False)
     ds_fresh = timed(ds_plans, TPCDS_QUERIES, DEPTH, rotate=True)
     ds_reused = timed(ds_plans, TPCDS_QUERIES, DEPTH, rotate=False)
 
+    _mark("roofline")
     roofline = _measure_roofline()
 
     def q_bytes(table, cols):

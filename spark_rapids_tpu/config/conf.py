@@ -272,6 +272,154 @@ AQE_SKEW_THRESHOLD_BYTES = conf(
     doc="Minimum size for a join partition to be considered skewed.")
 
 
+# ---------------------------------------------------------------------------
+# Round-5 perf/feature knobs (VERDICT r4 item 10: the knobs perf sweeps need)
+# ---------------------------------------------------------------------------
+
+SHRINK_TO_LIVE_ENABLED = conf(
+    "spark.rapids.tpu.sql.batch.shrinkToLive.enabled", default=True,
+    doc="Re-bucket filter/join/aggregate outputs down to the live row "
+        "count's power-of-two capacity so downstream kernels run at the "
+        "smaller static shape (device cost scales with capacity).")
+
+SHRINK_TO_LIVE_MIN_CAPACITY = conf(
+    "spark.rapids.tpu.sql.batch.shrinkToLive.minCapacity", default=1 << 20,
+    doc="Smallest batch capacity the shrink pass considers; below this the "
+        "host sync costs more than the shrink saves.")
+
+WINDOW_STREAMING_ENABLED = conf(
+    "spark.rapids.tpu.sql.window.streaming.enabled", default=True,
+    doc="Stream window groups across batches (running-state carry / "
+        "bounded neighbor context) instead of coalescing each partition "
+        "into one batch (reference: GpuRunningWindowExec / "
+        "GpuBatchedBoundedWindowExec).")
+
+WINDOW_MAX_BOUNDED_CONTEXT = conf(
+    "spark.rapids.tpu.sql.window.streaming.maxContextRows", default=1024,
+    doc="Largest bounded-frame extent / lead-lag offset handled by the "
+        "batch-streaming window path; larger frames coalesce to one batch.")
+
+SORT_OOC_TARGET_ROWS = conf(
+    "spark.rapids.tpu.sql.sort.outOfCore.targetRows", default=1 << 17,
+    doc="Output batch row target for the out-of-core sort merge "
+        "(reference: GpuSortExec targetSize).")
+
+LEXSORT_VARIADIC_MAX = conf(
+    "spark.rapids.tpu.sql.sort.variadicMaxOperands", default=6,
+    doc="Max sort-key words for the single fused variadic device sort; "
+        "beyond this the LSD carry-chain (one fixed-size compile per key) "
+        "is used. Compile time grows superlinearly with operand count.")
+
+JOIN_DENSE_MAX_DOMAIN = conf(
+    "spark.rapids.tpu.sql.join.denseKey.maxDomain", default=1 << 25,
+    doc="Largest integer key domain for the dense direct-address join "
+        "table (one int32 slot per possible key).")
+
+JOIN_UNIQUE_MAX_SLOTS = conf(
+    "spark.rapids.tpu.sql.join.uniqueTable.maxSlots", default=16,
+    doc="Bucket-scan width cap for the bucketed unique-key join table; "
+        "build sides needing more slots use the general sorted-hash join.")
+
+SCAN_ROW_GROUP_PRUNING = conf(
+    "spark.rapids.tpu.sql.parquet.rowGroupPruning.enabled", default=True,
+    doc="Prune parquet row groups with min/max statistics against pushed "
+        "predicates (reference: GpuParquetScan predicate pushdown).")
+
+SCAN_COMBINE_WINDOW = conf(
+    "spark.rapids.tpu.sql.parquet.reader.combineWindow", default=4,
+    doc="Files decoded per threadpool window in the multithreaded parquet "
+        "reader before device upload (reference: MULTITHREADED reader "
+        "combine settings).")
+
+WRITER_ASYNC_ENABLED = conf(
+    "spark.rapids.tpu.sql.write.async.enabled", default=True,
+    doc="Throttled async output writes (reference: AsyncOutputStream + "
+        "TrafficController).")
+
+WRITER_ASYNC_MAX_IN_FLIGHT = conf(
+    "spark.rapids.tpu.sql.write.async.maxInFlightBytes", default=256 << 20,
+    doc="Host bytes allowed in flight for async writes before producers "
+        "block (reference: HostMemoryThrottle).")
+
+SHUFFLE_TARGET_BATCH_BYTES = conf(
+    "spark.rapids.tpu.shuffle.targetBatchBytes", default=128 << 20,
+    doc="Post-shuffle coalesce target for merged device uploads "
+        "(reference: GpuShuffleCoalesceExec target size).")
+
+CLUSTER_HEARTBEAT_INTERVAL_S = conf(
+    "spark.rapids.tpu.cluster.heartbeat.intervalSeconds", default=2.0,
+    doc="Executor heartbeat period for the multi-process cluster "
+        "(reference: RapidsShuffleHeartbeatManager interval).")
+
+CLUSTER_HEARTBEAT_TIMEOUT_S = conf(
+    "spark.rapids.tpu.cluster.heartbeat.timeoutSeconds", default=10.0,
+    doc="Missed-heartbeat window after which an executor is declared dead "
+        "and its tasks are rescheduled on survivors.")
+
+CLUSTER_TASK_RETRIES = conf(
+    "spark.rapids.tpu.cluster.task.maxRetries", default=2,
+    doc="Times a failed/orphaned cluster task is re-run on another "
+        "executor before the query fails (Spark task-retry analog).")
+
+REGEX_MAX_STATES = conf(
+    "spark.rapids.tpu.sql.regex.maxDfaStates", default=4096,
+    doc="DFA state budget for device regex compilation; patterns "
+        "exceeding it fall back to CPU (reference: "
+        "RegexComplexityEstimator).")
+
+TZ_DB_ENABLED = conf(
+    "spark.rapids.tpu.sql.timezone.db.enabled", default=True,
+    doc="Device timezone-transition table for non-UTC timestamp "
+        "expressions (reference: GpuTimeZoneDB).")
+
+FILECACHE_ENABLED = conf(
+    "spark.rapids.tpu.filecache.enabled", default=False,
+    doc="Local range cache for remote scan byte ranges (reference: "
+        "spark.rapids.filecache.enabled).")
+
+FILECACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.filecache.maxBytes", default=8 << 30,
+    doc="Local disk budget for the file range cache.")
+
+DELTA_DV_ENABLED = conf(
+    "spark.rapids.tpu.delta.deletionVectors.read.enabled", default=True,
+    doc="Apply Delta deletion vectors during device scans.")
+
+BLOOM_JOIN_ENABLED = conf(
+    "spark.rapids.tpu.sql.join.bloomFilter.enabled", default=True,
+    doc="Runtime bloom-filter pushdown for selective joins (reference: "
+        "BloomFilterMightContain runtime filters).")
+
+BLOOM_JOIN_BITS = conf(
+    "spark.rapids.tpu.sql.join.bloomFilter.bits", default=1 << 23,
+    doc="Bloom filter size in bits for runtime join filters.")
+
+GATHER_FUSION_ENABLED = conf(
+    "spark.rapids.tpu.sql.kernel.fusedGather.enabled", default=True,
+    internal=True,
+    doc="Pack fixed-width lanes into one matrix per gather op (the r5 "
+        "packed-matrix gather); disable only to debug kernel issues.")
+
+
+_ACTIVE: "Optional[RapidsConf]" = None
+
+
+def set_active(conf_obj: "RapidsConf") -> None:
+    """Install the process-wide active conf (called by Overrides.apply so
+    exec-layer code without a threaded conf — shrink pass, kernel caps —
+    sees session settings; the reference similarly re-reads RapidsConf per
+    plan, GpuOverrides.scala:4748)."""
+    global _ACTIVE
+    _ACTIVE = conf_obj
+
+
+def get_active() -> "RapidsConf":
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = RapidsConf()
+    return _ACTIVE
+
+
 class RapidsConf:
     """Immutable snapshot of configuration values.
 

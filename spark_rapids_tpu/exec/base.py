@@ -118,8 +118,12 @@ class TpuExec:
                 from spark_rapids_tpu.utils.sync import fence
                 fence(batch)
             if self.shrink_output:
-                from spark_rapids_tpu.columnar.batch import shrink_to_live
-                batch = shrink_to_live(batch)
+                from spark_rapids_tpu.config import conf as _C
+                cfg = _C.get_active()
+                if _C.SHRINK_TO_LIVE_ENABLED.get(cfg):
+                    from spark_rapids_tpu.columnar.batch import shrink_to_live
+                    batch = shrink_to_live(
+                        batch, _C.SHRINK_TO_LIVE_MIN_CAPACITY.get(cfg))
             op_time.add(time.perf_counter_ns() - t0)
             self.metrics["numOutputBatches"].add(1)
             self._pending_rows.append(batch.num_rows)

@@ -160,6 +160,16 @@ class HashJoinExec(BinaryExec):
     # sync is the (dup_any, max_bucket) pair read once per build side.
     MAX_UNIQUE_SLOTS = 16  # bucket-scan width cap (2x-load tables stay tiny)
 
+    @property
+    def _max_unique_slots(self) -> int:
+        from spark_rapids_tpu.config import conf as _C
+        return _C.JOIN_UNIQUE_MAX_SLOTS.get(_C.get_active())
+
+    @property
+    def _dense_max_domain(self) -> int:
+        from spark_rapids_tpu.config import conf as _C
+        return _C.JOIN_DENSE_MAX_DOMAIN.get(_C.get_active())
+
     def _prepare_table(self, build: ColumnarBatch):
         """Build the bucketed table; returns (tbl, slots) for the unique
         probe, or a ``JoinHashes`` view of the SAME sorted layout when keys
@@ -173,7 +183,7 @@ class HashJoinExec(BinaryExec):
         slots = 1
         while slots < max(int(mb), 1):
             slots *= 2
-        if bool(dup) or slots > self.MAX_UNIQUE_SLOTS:
+        if bool(dup) or slots > self._max_unique_slots:
             # the (h1,h2)-sorted layout IS a valid JoinHashes (sorted by
             # hash, invalid rows pushed to the end)
             return K.JoinHashes(tbl.h1s, tbl.order, tbl.valid)
@@ -248,7 +258,7 @@ class HashJoinExec(BinaryExec):
             return None
         stats = jax.device_get(_dense_key_stats(build, self._rkeys[0]))
         kmin, kmax, n_valid = (int(stats[0]), int(stats[1]), int(stats[2]))
-        if n_valid == 0 or kmin < 0 or kmax >= self.DENSE_MAX_DOMAIN:
+        if n_valid == 0 or kmin < 0 or kmax >= self._dense_max_domain:
             return None
         size = bucket_capacity(kmax + 1, 16)
         tbl, dup_any = _dense_build_table(build, self._rkeys[0], size)

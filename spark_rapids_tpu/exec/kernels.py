@@ -444,7 +444,13 @@ def sortable_keys(
 # while RUNTIME is one fused pass (~0.17s at 16M for 3 operands on v5e) vs
 # ~0.4-0.6s per chained pass (gather + sort). Above the cap the chained
 # fallback bounds compile cost at O(n) fixed-size compiles.
+# (spark.rapids.tpu.sql.sort.variadicMaxOperands overrides per session.)
 LEXSORT_VARIADIC_MAX = 6
+
+
+def _lexsort_variadic_max() -> int:
+    from spark_rapids_tpu.config import conf as _C
+    return _C.LEXSORT_VARIADIC_MAX.get(_C.get_active())
 
 
 def lexsort_chain(keys: Sequence[jax.Array]) -> jax.Array:
@@ -476,7 +482,7 @@ def lexsort_chain(keys: Sequence[jax.Array]) -> jax.Array:
         flat.extend(passes(k))
     n = flat[0].shape[0]
     row_ids = jnp.arange(n, dtype=jnp.int32)
-    if len(flat) <= LEXSORT_VARIADIC_MAX:
+    if len(flat) <= _lexsort_variadic_max():
         operands = tuple(reversed(flat)) + (row_ids,)
         out = jax.lax.sort(operands, num_keys=len(flat), is_stable=True)
         return out[-1]

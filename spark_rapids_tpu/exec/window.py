@@ -127,7 +127,12 @@ class WindowExec(UnaryExec):
             if self._prepared else "TpuWindow"
 
     # -- streaming classification -----------------------------------------
-    MAX_BOUNDED_CONTEXT = 1024  # rows of carried neighbor context
+    # rows of carried neighbor context
+    # (spark.rapids.tpu.sql.window.streaming.maxContextRows)
+    @staticmethod
+    def _max_bounded_context() -> int:
+        from spark_rapids_tpu.config import conf as _C
+        return _C.WINDOW_MAX_BOUNDED_CONTEXT.get(_C.get_active())
 
     @staticmethod
     def plan_stream_mode(window_exprs, child_schema):
@@ -200,7 +205,7 @@ class WindowExec(UnaryExec):
             except (TypeError, KeyError, NotImplementedError):
                 return None
             return ("running", 0)
-        if bnd_ok and k <= WindowExec.MAX_BOUNDED_CONTEXT:
+        if bnd_ok and k <= WindowExec._max_bounded_context():
             return ("bounded", max(k, 1))
         return None
 

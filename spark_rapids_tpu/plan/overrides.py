@@ -566,6 +566,10 @@ class Overrides:
     def apply(self, plan: L.LogicalPlan) -> TpuExec:
         from spark_rapids_tpu.exec import base as _base
 
+        # session settings visible to exec-layer code without a threaded
+        # conf (shrink pass, kernel caps) — the reference similarly
+        # re-reads RapidsConf per plan (GpuOverrides.scala:4748)
+        C.set_active(self.conf)
         _base.set_sync_metrics(self.conf[C.METRICS_SYNC])
         if C.SQL_ENABLED.get(self.conf):
             plan = self._rewrite_distinct(plan)
@@ -722,11 +726,14 @@ class Overrides:
         # coalesce, so a window partition never has to fit in one batch.
         mode = WindowExec.plan_stream_mode(node.window_exprs,
                                            child.output_schema)
-        if mode is not None:
+        if (mode is not None
+                and C.WINDOW_STREAMING_ENABLED.get(self.conf)):
             from spark_rapids_tpu.exec.sort import SortExec
             orders = ([SortOrder(p) for p in spec.partition_by]
                       + list(spec.order_by))
-            child = SortExec(orders, child, out_of_core=True)
+            child = SortExec(
+                orders, child, out_of_core=True,
+                target_rows=C.SORT_OOC_TARGET_ROWS.get(self.conf))
             return WindowExec(node.window_exprs, child, streaming=True)
         # remaining frame shapes compute over one batch per partition
         child = CoalesceBatchesExec(child, require_single=True)
