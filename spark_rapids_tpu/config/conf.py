@@ -341,9 +341,9 @@ WRITER_ASYNC_MAX_IN_FLIGHT = conf(
     doc="Host bytes allowed in flight for async writes before producers "
         "block (reference: HostMemoryThrottle).")
 
-SHUFFLE_TARGET_BATCH_BYTES = conf(
-    "spark.rapids.tpu.shuffle.targetBatchBytes", default=128 << 20,
-    doc="Post-shuffle coalesce target for merged device uploads "
+SHUFFLE_TARGET_BATCH_ROWS = conf(
+    "spark.rapids.tpu.shuffle.targetBatchRows", default=1 << 20,
+    doc="Post-shuffle coalesce row target for merged device uploads "
         "(reference: GpuShuffleCoalesceExec target size).")
 
 CLUSTER_HEARTBEAT_INTERVAL_S = conf(
@@ -362,10 +362,11 @@ CLUSTER_TASK_RETRIES = conf(
         "executor before the query fails (Spark task-retry analog).")
 
 REGEX_MAX_STATES = conf(
-    "spark.rapids.tpu.sql.regex.maxDfaStates", default=4096,
+    "spark.rapids.tpu.sql.regex.maxDfaStates", default=96,
     doc="DFA state budget for device regex compilation; patterns "
         "exceeding it fall back to CPU (reference: "
-        "RegexComplexityEstimator).")
+        "RegexComplexityEstimator). The default matches the device "
+        "kernel's transition-table size.")
 
 TZ_DB_ENABLED = conf(
     "spark.rapids.tpu.sql.timezone.db.enabled", default=True,
@@ -381,18 +382,10 @@ FILECACHE_MAX_BYTES = conf(
     "spark.rapids.tpu.filecache.maxBytes", default=8 << 30,
     doc="Local disk budget for the file range cache.")
 
-DELTA_DV_ENABLED = conf(
-    "spark.rapids.tpu.delta.deletionVectors.read.enabled", default=True,
-    doc="Apply Delta deletion vectors during device scans.")
-
-BLOOM_JOIN_ENABLED = conf(
-    "spark.rapids.tpu.sql.join.bloomFilter.enabled", default=True,
-    doc="Runtime bloom-filter pushdown for selective joins (reference: "
-        "BloomFilterMightContain runtime filters).")
-
 BLOOM_JOIN_BITS = conf(
     "spark.rapids.tpu.sql.join.bloomFilter.bits", default=1 << 23,
-    doc="Bloom filter size in bits for runtime join filters.")
+    doc="Default bloom filter size in bits when building runtime join "
+        "filters (exec/bloom.py).")
 
 GATHER_FUSION_ENABLED = conf(
     "spark.rapids.tpu.sql.kernel.fusedGather.enabled", default=True,

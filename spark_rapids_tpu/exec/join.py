@@ -107,7 +107,6 @@ class HashJoinExec(BinaryExec):
                 + (f" cond={self.condition!r}" if self.condition is not None else ""))
 
     # -- execution ---------------------------------------------------------
-    DENSE_MAX_DOMAIN = 1 << 25  # 128MB int32 lookup table cap
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._prepare()
@@ -158,7 +157,6 @@ class HashJoinExec(BinaryExec):
     # with STATIC output shapes: out_cap = probe capacity, no per-batch
     # candidate-count host sync, one compile per probe bucket. The ONLY
     # sync is the (dup_any, max_bucket) pair read once per build side.
-    MAX_UNIQUE_SLOTS = 16  # bucket-scan width cap (2x-load tables stay tiny)
 
     @property
     def _max_unique_slots(self) -> int:

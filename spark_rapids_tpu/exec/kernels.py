@@ -228,6 +228,12 @@ def gather_columns(
 
     Semantics identical to mapping `gather_column` over `cols`.
     """
+    from spark_rapids_tpu.config import conf as _C
+    if not _C.GATHER_FUSION_ENABLED.get(_C.get_active()):
+        return [gather_column(c, indices, row_valid,
+                              out_byte_capacities[i]
+                              if out_byte_capacities else None)
+                for i, c in enumerate(cols)]
     safe_idx = jnp.where(row_valid, indices, 0).astype(jnp.int32)
     fixed = [i for i, c in enumerate(cols)
              if c.offsets is None and c.children is None]
@@ -444,10 +450,7 @@ def sortable_keys(
 # while RUNTIME is one fused pass (~0.17s at 16M for 3 operands on v5e) vs
 # ~0.4-0.6s per chained pass (gather + sort). Above the cap the chained
 # fallback bounds compile cost at O(n) fixed-size compiles.
-# (spark.rapids.tpu.sql.sort.variadicMaxOperands overrides per session.)
-LEXSORT_VARIADIC_MAX = 6
-
-
+# (spark.rapids.tpu.sql.sort.variadicMaxOperands is the live value.)
 def _lexsort_variadic_max() -> int:
     from spark_rapids_tpu.config import conf as _C
     return _C.LEXSORT_VARIADIC_MAX.get(_C.get_active())

@@ -32,6 +32,16 @@ from spark_rapids_tpu.exec.base import LeafExec
 from spark_rapids_tpu.exprs import expr as E
 
 
+def _rg_pruning_on() -> bool:
+    from spark_rapids_tpu.config import conf as _C
+    return _C.SCAN_ROW_GROUP_PRUNING.get(_C.get_active())
+
+
+def _combine_window() -> int:
+    from spark_rapids_tpu.config import conf as _C
+    return _C.SCAN_COMBINE_WINDOW.get(_C.get_active())
+
+
 def windowed_map(pool, fn, items, window: int):
     """pool.map with a bounded in-flight window: keeps reads overlapped with
     consumption without materializing every decoded table."""
@@ -160,7 +170,8 @@ class FileScanBase(LeafExec):
             with cf.ThreadPoolExecutor(self.reader_threads) as pool:
                 yield from self.upload_batched(
                     windowed_map(pool, read, items,
-                                 window=self.reader_threads * 2))
+                                 window=max(self.reader_threads,
+                                            _combine_window())))
         else:  # COALESCING
             whole = pa.concat_tables(read(it) for it in items)
             yield from self.upload_batched(iter([whole]))
@@ -292,7 +303,8 @@ class ParquetScanExec(FileScanBase):
             keep = []
             for rg in range(md.num_row_groups):
                 self.metrics["numRowGroups"].add(1)
-                if self.predicate is not None and self._prune(md, rg):
+                if (self.predicate is not None and _rg_pruning_on()
+                        and self._prune(md, rg)):
                     self.metrics["numPrunedRowGroups"].add(1)
                     continue
                 if self.dynamic_filters and self._dyn_prune(md, rg):

@@ -38,7 +38,15 @@ import numpy as np
 
 from spark_rapids_tpu.exprs.segscan import segmented_compose
 
-MAX_DFA_STATES = 96
+MAX_DFA_STATES = 96  # fallback; live value: sql.regex.maxDfaStates
+
+
+def _max_dfa_states() -> int:
+    from spark_rapids_tpu.config import conf as _C
+    try:
+        return _C.REGEX_MAX_STATES.get(_C.get_active())
+    except Exception:
+        return MAX_DFA_STATES
 MAX_COUNTED_REPEAT = 64
 
 
@@ -466,9 +474,9 @@ def _to_dfa(nfa: _NFA, start: int, end: int) -> DFA:
                         nxt.add(t)
             closed = nfa.eps_closure(frozenset(nxt)) if nxt else frozenset()
             if closed not in sets:
-                if len(sets) >= MAX_DFA_STATES:
+                if len(sets) >= _max_dfa_states():
                     raise RegexUnsupported(
-                        f"DFA exceeds {MAX_DFA_STATES} states"
+                        f"DFA exceeds {_max_dfa_states()} states"
                     )
                 sets[closed] = len(sets)
                 order.append(closed)

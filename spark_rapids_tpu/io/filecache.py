@@ -19,7 +19,10 @@ from typing import Optional
 class FileCache:
     """LRU byte-range cache backed by a local directory."""
 
-    def __init__(self, cache_dir: str, max_bytes: int = 4 << 30):
+    def __init__(self, cache_dir: str, max_bytes: int = None):
+        if max_bytes is None:
+            from spark_rapids_tpu.config import conf as _C
+            max_bytes = _C.FILECACHE_MAX_BYTES.get(_C.get_active())
         self.cache_dir = cache_dir
         self.max_bytes = max_bytes
         os.makedirs(cache_dir, exist_ok=True)

@@ -257,6 +257,9 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
                         and not _is_wide(lt0.element)
                         and not _is_wide(bound.right.dtype)):
                     reasons.append("array_contains type not on device")
+            if isinstance(bound, (E.FromUTCTimestamp, E.ToUTCTimestamp)):
+                if not C.TZ_DB_ENABLED.get(C.get_active()):
+                    reasons.append("timezone db disabled")
             # probe regex compilability (reference: RegexParser transpiler
             # bail-outs -> willNotWorkOnGpu); patterns outside the DFA
             # subset fall back to CPU

@@ -134,6 +134,20 @@ def _worker_main(worker_id: str, ctrl) -> None:
 
     ctrl.send(("register", worker_id, server.address[0], server.address[1]))
     plans = {}  # payload id -> physical plan (cache across tasks)
+    confs = {}  # payload id -> RapidsConf (re-activated per task: the
+    # process-wide active conf must match the plan being EXECUTED, not the
+    # last plan built)
+
+    def plan_for(payload):
+        from spark_rapids_tpu.config import conf as _C
+        from spark_rapids_tpu.config.conf import RapidsConf
+
+        if payload not in plans:
+            conf_items = pickle.loads(payload)[1]
+            confs[payload] = RapidsConf(conf_items)
+            plans[payload] = _build_plan(payload)
+        _C.set_active(confs[payload])
+        return plans[payload]
 
     try:
         while True:
@@ -144,9 +158,7 @@ def _worker_main(worker_id: str, ctrl) -> None:
             try:
                 if kind == "map":
                     _, task_id, payload, shuffle_id, parts = msg
-                    if payload not in plans:
-                        plans[payload] = _build_plan(payload)
-                    _, exchange = _find_agg_exchange(plans[payload])
+                    _, exchange = _find_agg_exchange(plan_for(payload))
                     child = exchange.children[0]
                     if shuffle_id not in regs:
                         regs[shuffle_id] = manager.register(
@@ -163,9 +175,8 @@ def _worker_main(worker_id: str, ctrl) -> None:
                 elif kind == "reduce":
                     (_, task_id, payload, shuffle_id, reduce_id,
                      sources) = msg
-                    if payload not in plans:
-                        plans[payload] = _build_plan(payload)
-                    final_agg, exchange = _find_agg_exchange(plans[payload])
+                    final_agg, exchange = _find_agg_exchange(
+                        plan_for(payload))
                     schema = exchange.children[0].output_schema
                     blocks: List[bytes] = []
                     for host, port, mids in sources:
@@ -228,13 +239,16 @@ class TcpShuffleCluster:
     RapidsExecutorPlugin instances; SURVEY.md §3.1)."""
 
     def __init__(self, n_workers: int = 2):
+        from spark_rapids_tpu.config import conf as _C
         from spark_rapids_tpu.shuffle.heartbeat import ShuffleHeartbeatManager
 
-        self.heartbeats = ShuffleHeartbeatManager(timeout_s=60.0)
+        self.heartbeats = ShuffleHeartbeatManager(
+            timeout_s=_C.CLUSTER_HEARTBEAT_TIMEOUT_S.get(_C.get_active()))
         ctx = mp.get_context("spawn")
         self._procs = []
         self._pipes: Dict[str, object] = {}
         self._addrs: Dict[str, Tuple[str, int]] = {}
+        self._proc_by: Dict[str, object] = {}
         for i in range(n_workers):
             wid = f"exec-{i}"
             parent, child = ctx.Pipe()
@@ -242,6 +256,7 @@ class TcpShuffleCluster:
                             daemon=True)
             p.start()
             self._procs.append(p)
+            self._proc_by[wid] = p
             self._pipes[wid] = parent
         for wid, pipe in self._pipes.items():
             kind, w, host, port = pipe.recv()
@@ -250,6 +265,7 @@ class TcpShuffleCluster:
             self._addrs[wid] = (host, port)
         self._next_shuffle = 0
         self._next_task = 0
+        self._dead: set = set()
         self._lock = threading.Lock()
 
     # sid uniqueness across run_query calls keeps worker block stores from
@@ -264,9 +280,108 @@ class TcpShuffleCluster:
             self._next_task += 1
             return self._next_task
 
+    # -- fault handling ----------------------------------------------------
+    def _alive_workers(self) -> List[str]:
+        out = []
+        for wid in sorted(self._pipes):
+            if wid in self._dead:
+                continue
+            p = self._proc_by[wid]
+            if not p.is_alive():
+                self._on_dead(wid)
+                continue
+            out.append(wid)
+        return out
+
+    def _on_dead(self, wid: str) -> None:
+        """Executor loss (reference: the plugin hard-exits executors on
+        fatal device errors so the scheduler replaces them and task retry
+        re-runs their work, Plugin.scala:560-568)."""
+        if wid in self._dead:
+            return
+        self._dead.add(wid)
+        # drop the peer from discovery immediately (the timed sweep would
+        # also catch it once heartbeats stop)
+        self.heartbeats.deregister(wid)
+
+    def _recv(self, wid: str):
+        """Receive one message from a worker; None = the worker died."""
+        import time as _t
+
+        pipe = self._pipes[wid]
+        while True:
+            if pipe.poll(0.2):
+                try:
+                    return pipe.recv()
+                except (EOFError, OSError):
+                    self._on_dead(wid)
+                    return None
+            if not self._proc_by[wid].is_alive():
+                # drain a final message racing the death
+                if pipe.poll(0.05):
+                    try:
+                        return pipe.recv()
+                    except Exception:
+                        pass
+                self._on_dead(wid)
+                return None
+            _t.sleep(0)
+
+    def _run_maps(self, payload, sid: int, parts_todo, owner) -> None:
+        """Run (or re-run) map partitions until each has a live owner —
+        Spark lineage recompute: blocks on a dead executor are lost, their
+        partitions re-execute on survivors."""
+        from spark_rapids_tpu.config import conf as _C
+
+        retries = _C.CLUSTER_TASK_RETRIES.get(_C.get_active())
+        todo = set(parts_todo)
+        attempts = 0
+        last_error = None
+        while todo:
+            alive = self._alive_workers()
+            if not alive:
+                raise RuntimeError("all executors lost")
+            assignment: Dict[str, List[int]] = {}
+            for i, p in enumerate(sorted(todo)):
+                assignment.setdefault(alive[i % len(alive)], []).append(p)
+            pending = []
+            for wid, parts in assignment.items():
+                tid = self._task_id()
+                try:
+                    self._pipes[wid].send(("map", tid, payload, sid, parts))
+                except (BrokenPipeError, OSError):
+                    self._on_dead(wid)
+                    continue  # parts stay in todo for the next round
+                pending.append((tid, wid, parts))
+            for tid, wid, parts in pending:
+                msg = self._recv(wid)
+                if msg is None:
+                    continue  # parts stay in todo; next round reassigns
+                kind, _rtid, *rest = msg
+                if kind == "error":
+                    last_error = f"map task failed on {wid}: {rest[-1]}"
+                    self._mark_alive(wid)
+                    continue  # parts stay in todo: retry up to the budget
+                assert kind == "map_done"
+                self._mark_alive(wid)
+                for p in parts:
+                    todo.discard(p)
+                    owner[p] = wid
+            attempts += 1
+            if todo and attempts > retries:
+                raise RuntimeError(
+                    f"map partitions {sorted(todo)} failed after "
+                    f"{attempts} attempts"
+                    + (f"; last error: {last_error}" if last_error else ""))
+
     def run_query(self, df) -> pa.Table:
-        """Execute the DataFrame's planned query across the cluster."""
+        """Execute the DataFrame's planned query across the cluster.
+
+        Executor death at ANY point is recovered: dead workers' map blocks
+        are recomputed on survivors (lineage) and their reduce tasks are
+        rescheduled, up to spark.rapids.tpu.cluster.task.maxRetries."""
         from spark_rapids_tpu.columnar.batch import batch_from_arrow
+        from spark_rapids_tpu.config import conf as _C
         from spark_rapids_tpu.exec.base import BatchSourceExec
 
         conf_items = dict(df.conf._values) if df.conf is not None else {}
@@ -279,57 +394,62 @@ class TcpShuffleCluster:
         n_maps = exchange.children[0].num_partitions()
         n_reduce = exchange.partitioner.num_partitions
 
-        # peers come from the heartbeat manager — the driver-mediated
-        # discovery path (reference: RapidsShuffleHeartbeatManager)
-        addrs = {eid: (host, port)
-                 for eid, host, port in self.heartbeats.peers()}
-        workers = sorted(addrs)
-
-        # -- map stage ----------------------------------------------------
+        # -- map stage (with lineage recompute on executor loss) ----------
         owner: Dict[int, str] = {}
-        pending = {}
-        for i, wid in enumerate(workers):
-            parts = [p for p in range(n_maps) if p % len(workers) == i]
-            if not parts:
-                continue
-            tid = self._task_id()
-            self._pipes[wid].send(("map", tid, payload, sid, parts))
-            pending[tid] = (wid, parts)
-            for p in parts:
-                owner[p] = wid
-        for tid in list(pending):
-            wid, parts = pending[tid]
-            kind, rtid, *rest = self._pipes[wid].recv()
-            if kind == "error":
-                raise RuntimeError(f"map task failed on {wid}: {rest[-1]}")
-            assert kind == "map_done"
-            self._mark_alive(wid)
+        self._run_maps(payload, sid, range(n_maps), owner)
 
         # -- reduce stage -------------------------------------------------
-        by_worker_mids: Dict[str, List[int]] = {}
-        for p, wid in owner.items():
-            by_worker_mids.setdefault(wid, []).append(p)
-        sources = [(addrs[wid][0], addrs[wid][1], sorted(mids))
-                   for wid, mids in sorted(by_worker_mids.items())]
-        rpending = {}
-        for r in range(n_reduce):
-            wid = workers[r % len(workers)]
-            tid = self._task_id()
-            self._pipes[wid].send(
-                ("reduce", tid, payload, sid, r, sources))
-            rpending.setdefault(wid, []).append(tid)
+        retries = _C.CLUSTER_TASK_RETRIES.get(_C.get_active())
         tables: List[pa.Table] = []
-        for wid, tids in rpending.items():
-            for _ in tids:
-                msg = self._pipes[wid].recv()
+        reduces_todo = set(range(n_reduce))
+        attempts = 0
+        last_error = None
+        while reduces_todo:
+            # any map owner lost since? recompute those blocks first
+            lost = [p for p, wid in owner.items() if wid in self._dead
+                    or not self._proc_by[wid].is_alive()]
+            if lost:
+                self._run_maps(payload, sid, lost, owner)
+            by_worker_mids: Dict[str, List[int]] = {}
+            for p, wid in owner.items():
+                by_worker_mids.setdefault(wid, []).append(p)
+            sources = [(self._addrs[wid][0], self._addrs[wid][1],
+                        sorted(mids))
+                       for wid, mids in sorted(by_worker_mids.items())]
+            alive = self._alive_workers()
+            if not alive:
+                raise RuntimeError("all executors lost")
+            pending = []
+            for i, r in enumerate(sorted(reduces_todo)):
+                wid = alive[i % len(alive)]
+                tid = self._task_id()
+                try:
+                    self._pipes[wid].send(
+                        ("reduce", tid, payload, sid, r, sources))
+                except (BrokenPipeError, OSError):
+                    self._on_dead(wid)
+                    continue
+                pending.append((tid, wid, r))
+            for tid, wid, r in pending:
+                msg = self._recv(wid)
+                if msg is None:
+                    continue  # r stays todo; sources may need recompute
                 if msg[0] == "error":
-                    raise RuntimeError(
-                        f"reduce task failed on {wid}: {msg[-1]}")
+                    last_error = f"reduce task failed on {wid}: {msg[-1]}"
+                    self._mark_alive(wid)
+                    continue  # r stays todo: retry up to the budget
                 assert msg[0] == "reduce_done"
                 self._mark_alive(wid)
+                reduces_todo.discard(r)
                 blob = msg[3]
                 if blob:
                     tables.append(pa.ipc.open_stream(blob).read_all())
+            attempts += 1
+            if reduces_todo and attempts > retries:
+                raise RuntimeError(
+                    f"reduce partitions {sorted(reduces_todo)} failed "
+                    f"after {attempts} attempts"
+                    + (f"; last error: {last_error}" if last_error else ""))
 
         # -- driver tail --------------------------------------------------
         if tables:

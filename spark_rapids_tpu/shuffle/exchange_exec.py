@@ -25,10 +25,14 @@ from spark_rapids_tpu.shuffle.partition import Partitioner
 class ShuffleExchangeExec(UnaryExec):
     def __init__(self, partitioner: Partitioner, child: TpuExec,
                  manager: Optional[ShuffleManager] = None,
-                 target_batch_rows: int = 1 << 20):
+                 target_batch_rows: int = None):
         super().__init__(child)
         self.partitioner = partitioner
         self.manager = manager or get_manager()
+        if target_batch_rows is None:
+            from spark_rapids_tpu.config import conf as _C
+            target_batch_rows = _C.SHUFFLE_TARGET_BATCH_ROWS.get(
+                _C.get_active())
         self.target_batch_rows = target_batch_rows
         self._reg = None
         self._written = False

@@ -61,6 +61,11 @@ class ShuffleHeartbeatManager:
                    if p.seq > last_seen_seq and p.executor_id != executor_id]
             return self._seq, new, me is not None
 
+    def deregister(self, executor_id: str) -> None:
+        """Drop a peer immediately (driver observed its process die)."""
+        with self._lock:
+            self._peers.pop(executor_id, None)
+
     def sweep_lost(self) -> List[str]:
         """Drop peers that missed heartbeats; returns their ids."""
         now = time.monotonic()

