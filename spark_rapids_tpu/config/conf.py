@@ -384,8 +384,8 @@ FILECACHE_MAX_BYTES = conf(
 
 BLOOM_JOIN_BITS = conf(
     "spark.rapids.tpu.sql.join.bloomFilter.bits", default=1 << 23,
-    doc="Default bloom filter size in bits when building runtime join "
-        "filters (exec/bloom.py).")
+    doc="Bloom filter size in bits for runtime join filters "
+        "(resolved via exec/bloom.default_bits() outside jit).")
 
 GATHER_FUSION_ENABLED = conf(
     "spark.rapids.tpu.sql.kernel.fusedGather.enabled", default=True,

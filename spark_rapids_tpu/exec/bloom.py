@@ -49,16 +49,20 @@ def _positions(h: jax.Array, num_bits: int, num_hashes: int):
     return out
 
 
+def default_bits() -> int:
+    """Session bloom size (spark.rapids.tpu.sql.join.bloomFilter.bits).
+    Resolve OUTSIDE jit: reading it at trace time would bake the first
+    session's value into the cached kernel."""
+    from spark_rapids_tpu.config import conf as _C
+    return _C.BLOOM_JOIN_BITS.get(_C.get_active())
+
+
 @partial(jax.jit, static_argnums=(1, 2, 3))
 def build_bloom_filter(batch: ColumnarBatch, key_cols: Sequence[int],
-                       num_bits: int = None, num_hashes: int = 3
-                       ) -> jax.Array:
+                       num_bits: int, num_hashes: int = 3) -> jax.Array:
     """BloomFilterAggregate: set k bits per live row (one idempotent
     scatter per hash). Merging partial filters across batches/partitions is
     elementwise OR."""
-    if num_bits is None:
-        from spark_rapids_tpu.config import conf as _C
-        num_bits = _C.BLOOM_JOIN_BITS.get(_C.get_active())
     h = K.hash_keys(batch, list(key_cols))
     live = batch.active_mask()
     bits = jnp.zeros(num_bits, jnp.bool_)
@@ -73,9 +77,6 @@ def might_contain(batch: ColumnarBatch, key_cols: Sequence[int],
                   bits: jax.Array, num_bits: int,
                   num_hashes: int) -> jax.Array:
     """BloomFilterMightContain: True when every derived bit is set."""
-    if num_bits is None:
-        from spark_rapids_tpu.config import conf as _C
-        num_bits = _C.BLOOM_JOIN_BITS.get(_C.get_active())
     h = K.hash_keys(batch, list(key_cols))
     out = jnp.ones(batch.capacity, jnp.bool_)
     for pos in _positions(h, num_bits, num_hashes):

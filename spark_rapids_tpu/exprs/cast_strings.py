@@ -1,0 +1,548 @@
+"""Device string<->value casts (Spark-exact where stated).
+
+Reference: GpuCast.scala:288,1713 + the jni CastStrings kernels
+(SURVEY.md §2.11 item 2). TPU-first design: parsing gathers the first
+PARSE_WINDOW bytes of every row into a (cap, W) matrix ONCE, then every
+step is an elementwise column sweep over the static window (no per-row
+loops, no data-dependent shapes); formatting builds a fixed-width digit
+matrix and emits variable-length rows with the offsets+byte-gather pattern
+shared with the string kernels.
+
+Implemented device-exact:
+- long/int/short/byte -> string, bool -> string
+- decimal(<=18) and decimal128 -> string (sign, scale insertion, zeros)
+- date -> string (yyyy-MM-dd, years 1..9999)
+- timestamp -> string (yyyy-MM-dd HH:mm:ss[.ffffff], trailing zeros
+  trimmed, UTC)
+- string -> integral (trimmed, optional sign; overflow/invalid -> null)
+- string -> bool (Spark's accepted literal set)
+- string -> date (yyyy[-M[-d]], trimmed; invalid -> null)
+- string -> timestamp (yyyy-M-d[ H:m:s[.f{1..6}]], 'T' separator ok,
+  trailing 'Z'/'UTC' ok, UTC session zone; invalid -> null)
+- string -> float/double (decimal + exponent forms, Infinity/NaN; parsed
+  by f64 accumulation — values round to within 1 ulp of Java's
+  correctly-rounded parse; the TPU backend's f64 is a double-double, so
+  bit-exactness is not representable on-device anyway)
+
+NOT on device (planner gates these to CPU): float/double -> string
+(Java shortest-round-trip formatting), ANSI-mode string casts (per-row
+errors), string -> decimal.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import ColVal
+from spark_rapids_tpu.exprs.strings import StringVal, make_offsets, row_ids
+
+PARSE_WINDOW = 32  # bytes of each row examined by parsing casts
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+
+def _emit(mat: jnp.ndarray, lens: jnp.ndarray, start: jnp.ndarray,
+          validity) -> StringVal:
+    """(cap, W) byte matrix + per-row [start, start+len) -> StringVal."""
+    cap, W = mat.shape
+    lens = jnp.where(validity, lens, 0).astype(jnp.int32)
+    offsets = make_offsets(lens)
+    out_bytes = cap * W
+    j = jnp.arange(out_bytes, dtype=jnp.int32)
+    rows = jnp.clip(row_ids(offsets, out_bytes), 0, cap - 1)
+    rel = j - offsets[rows]
+    b = mat[rows, jnp.clip(start[rows] + rel, 0, W - 1)]
+    in_range = j < offsets[-1]
+    return StringVal(jnp.where(in_range, b, jnp.uint8(0)), offsets, validity)
+
+
+def _window(sv: StringVal, cap: int) -> tuple:
+    """PARSE_WINDOW bytes of each TRIMMED row -> (mat, length, too_long).
+
+    Trims Spark-style (UTF8String.trimAll: chars <= 0x20 at both ends).
+    The trim bounds come from ONE global pass over the byte space
+    (segment min/max of content positions per row), so arbitrarily much
+    surrounding whitespace never costs window bytes; only rows whose
+    trimmed CONTENT exceeds the window flag too_long (no accepted literal
+    does)."""
+    W = PARSE_WINDOW
+    nbytes = sv.data.shape[0]
+    lens = (sv.offsets[1:] - sv.offsets[:-1]).astype(jnp.int32)
+    byte_rows = jnp.clip(row_ids(sv.offsets, nbytes), 0, cap - 1)
+    j = jnp.arange(nbytes, dtype=jnp.int32)
+    in_any = j < sv.offsets[-1]
+    content = in_any & (sv.data > 0x20)
+    first = jax.ops.segment_min(jnp.where(content, j, nbytes), byte_rows,
+                                num_segments=cap, indices_are_sorted=True)
+    last = jax.ops.segment_max(jnp.where(content, j, -1), byte_rows,
+                               num_segments=cap, indices_are_sorted=True)
+    any_content = last >= 0
+    tlen = jnp.where(any_content, last - first + 1, 0).astype(jnp.int32)
+    too_long = tlen > W
+    start = jnp.where(any_content, first, 0).astype(jnp.int32)
+    pos = start[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    in_row = jnp.arange(W, dtype=jnp.int32)[None, :] < tlen[:, None]
+    mat = jnp.where(in_row,
+                    sv.data[jnp.clip(pos, 0, max(nbytes - 1, 0))],
+                    jnp.uint8(0))
+    tlen = jnp.minimum(tlen, W)
+    return mat, tlen, too_long
+
+
+_DIG0 = jnp.uint8(ord("0"))
+
+
+def _digits_i64(x: jnp.ndarray) -> tuple:
+    """|x| -> (cap, 20) ASCII digit matrix (most significant first) + length
+    of the significant part. Works on uint64 magnitudes."""
+    cap = x.shape[0]
+    digs = []
+    v = x
+    for _ in range(20):
+        digs.append((v % jnp.uint64(10)).astype(jnp.uint8) + _DIG0)
+        v = v // jnp.uint64(10)
+    mat = jnp.stack(digs[::-1], axis=1)  # (cap, 20) MSD first
+    nz = mat != _DIG0
+    first = jnp.argmax(nz, axis=1)
+    any_nz = jnp.any(nz, axis=1)
+    ndig = jnp.where(any_nz, 20 - first, 1).astype(jnp.int32)
+    return mat, ndig
+
+
+def _abs_u64(x: jnp.ndarray) -> jnp.ndarray:
+    xi = x.astype(jnp.int64)
+    neg = xi < 0
+    return jnp.where(neg, (-xi).astype(jnp.uint64), xi.astype(jnp.uint64))
+
+
+# ---------------------------------------------------------------------------
+# value -> string
+# ---------------------------------------------------------------------------
+
+
+def long_to_string(data, validity) -> StringVal:
+    """Integral -> string (Java Long.toString; INT64_MIN included)."""
+    xi = data.astype(jnp.int64)
+    neg = xi < 0
+    mag = jnp.where(neg, jnp.uint64(0) - xi.astype(jnp.uint64),
+                    xi.astype(jnp.uint64))
+    mat, ndig = _digits_i64(mag)
+    cap = mat.shape[0]
+    out = jnp.full((cap, 21), _DIG0, jnp.uint8)
+    # layout: ['-'] + digits, right-aligned digits at [21-ndig, 21)
+    out = out.at[:, 1:].set(mat)
+    lens = ndig + neg.astype(jnp.int32)
+    start = jnp.where(neg, 20 - ndig, 21 - ndig).astype(jnp.int32)
+    out = out.at[jnp.arange(cap), jnp.clip(start, 0, 20)].set(
+        jnp.where(neg, jnp.uint8(ord("-")), out[jnp.arange(cap),
+                                                jnp.clip(start, 0, 20)]))
+    return _emit(out, lens, start, validity)
+
+
+def bool_to_string(data, validity) -> StringVal:
+    cap = data.shape[0]
+    tmpl = jnp.asarray(np.frombuffer(b"falsetrue", np.uint8))
+    mat = jnp.broadcast_to(tmpl, (cap, 9))
+    tv = data.astype(jnp.bool_)
+    start = jnp.where(tv, 5, 0).astype(jnp.int32)
+    lens = jnp.where(tv, 4, 5).astype(jnp.int32)
+    return _emit(mat, lens, start, validity)
+
+
+def decimal_to_string(lo, hi, scale: int, validity) -> StringVal:
+    """decimal(p, s) unscaled (hi, lo) limbs -> Spark string form.
+
+    hi is None for <=18-digit decimals. Emits sign, integral digits (at
+    least '0'), and exactly ``scale`` fraction digits ('1.20', '0.05',
+    '-0.00' renders as Spark does: sign of the unscaled value)."""
+    from spark_rapids_tpu.exec import int128 as I128
+
+    if hi is None:
+        xi = lo.astype(jnp.int64)
+        neg = xi < 0
+        mag = jnp.where(neg, jnp.uint64(0) - xi.astype(jnp.uint64),
+                        xi.astype(jnp.uint64))
+        digs = []
+        v = mag
+        for _ in range(20):
+            digs.append((v % jnp.uint64(10)).astype(jnp.uint8))
+            v = v // jnp.uint64(10)
+        ndigits = 20
+    else:
+        neg = I128.is_neg(hi, lo)
+        ah, al = I128.abs_(hi, lo)
+        digs = []
+        # 39 digits via repeated divmod by 10 on limbs (static unroll)
+        for _ in range(39):
+            ah, al, r = I128._udivmod_small(ah, al, jnp.full_like(al, 10))
+            digs.append(r.astype(jnp.uint8))
+        ndigits = 39
+    # digs[k] = digit at 10^k. layout: sign, int part, '.', fraction
+    cap = digs[0].shape[0]
+    n_int_digits_arr = []
+    # significant integral digits = highest k >= scale with digit != 0
+    sig = jnp.zeros(cap, jnp.int32)
+    for k in range(scale, ndigits):
+        sig = jnp.where(digs[k] != 0, k - scale + 1, sig)
+    int_digits = jnp.maximum(sig, 1)
+    frac = scale
+    W = ndigits + 3  # sign + digits + dot
+    out = jnp.zeros((cap, W), jnp.uint8)
+    lens = int_digits + (frac + 1 if frac else 0) + neg.astype(jnp.int32)
+    # write right-to-left: fraction digits, dot, integral digits, sign
+    posn = W  # exclusive end
+    col = W
+    for k in range(frac):
+        col -= 1
+        out = out.at[:, col].set(digs[k] + _DIG0)
+    if frac:
+        col -= 1
+        out = out.at[:, col].set(jnp.uint8(ord(".")))
+    for k in range(frac, ndigits):
+        col -= 1
+        j = k - frac
+        out = out.at[:, col].set(
+            jnp.where(j < int_digits, digs[k] + _DIG0, out[:, col]))
+    start = (W - lens).astype(jnp.int32)
+    rng = jnp.arange(cap)
+    out = out.at[rng, jnp.clip(start, 0, W - 1)].set(
+        jnp.where(neg, jnp.uint8(ord("-")),
+                  out[rng, jnp.clip(start, 0, W - 1)]))
+    return _emit(out, lens, start, validity)
+
+
+def _civil_from_days(z):
+    """days since 1970-01-01 -> (y, m, d) (proleptic Gregorian; Howard
+    Hinnant's civil_from_days, pure integer arithmetic)."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _put2(out, col, v):
+    out = out.at[:, col].set((v // 10).astype(jnp.uint8) + _DIG0)
+    return out.at[:, col + 1].set((v % 10).astype(jnp.uint8) + _DIG0)
+
+
+def date_to_string(days, validity) -> StringVal:
+    """date -> 'yyyy-MM-dd' (years 1..9999; Spark's common range)."""
+    y, m, d = _civil_from_days(days)
+    cap = days.shape[0]
+    out = jnp.zeros((cap, 10), jnp.uint8)
+    yy = jnp.clip(y, 0, 9999)
+    out = _put2(out, 0, yy // 100)
+    out = _put2(out, 2, yy % 100)
+    out = out.at[:, 4].set(jnp.uint8(ord("-")))
+    out = _put2(out, 5, m)
+    out = out.at[:, 7].set(jnp.uint8(ord("-")))
+    out = _put2(out, 8, d)
+    return _emit(out, jnp.full(cap, 10, jnp.int32),
+                 jnp.zeros(cap, jnp.int32), validity)
+
+
+def timestamp_to_string(micros, validity) -> StringVal:
+    """timestamp (UTC micros) -> 'yyyy-MM-dd HH:mm:ss[.ffffff]' with
+    trailing fraction zeros trimmed (Spark/Java format)."""
+    us = micros.astype(jnp.int64)
+    days = jnp.floor_divide(us, 86_400_000_000)
+    rem = us - days * 86_400_000_000
+    y, m, d = _civil_from_days(days)
+    secs = rem // 1_000_000
+    frac = (rem % 1_000_000).astype(jnp.int64)
+    hh = secs // 3600
+    mm = (secs // 60) % 60
+    ss = secs % 60
+    cap = us.shape[0]
+    W = 26
+    out = jnp.zeros((cap, W), jnp.uint8)
+    yy = jnp.clip(y, 0, 9999)
+    out = _put2(out, 0, yy // 100)
+    out = _put2(out, 2, yy % 100)
+    out = out.at[:, 4].set(jnp.uint8(ord("-")))
+    out = _put2(out, 5, m)
+    out = out.at[:, 7].set(jnp.uint8(ord("-")))
+    out = _put2(out, 8, d)
+    out = out.at[:, 10].set(jnp.uint8(ord(" ")))
+    out = _put2(out, 11, hh)
+    out = out.at[:, 13].set(jnp.uint8(ord(":")))
+    out = _put2(out, 14, mm)
+    out = out.at[:, 16].set(jnp.uint8(ord(":")))
+    out = _put2(out, 17, ss)
+    out = out.at[:, 19].set(jnp.uint8(ord(".")))
+    fd = []
+    v = frac
+    for _ in range(6):
+        fd.append((v % 10).astype(jnp.uint8))
+        v = v // 10
+    for k in range(6):
+        out = out.at[:, 20 + k].set(fd[5 - k] + _DIG0)
+    # fraction length = 6 minus trailing zero count (0 -> no fraction)
+    tz = jnp.zeros(cap, jnp.int32)
+    run = jnp.ones(cap, jnp.bool_)
+    for k in range(6):
+        z = fd[k] == 0
+        run = run & z
+        tz = tz + run.astype(jnp.int32)
+    frac_len = 6 - tz
+    lens = jnp.where(frac > 0, 20 + frac_len, 19).astype(jnp.int32)
+    return _emit(out, lens, jnp.zeros(cap, jnp.int32), validity)
+
+
+# ---------------------------------------------------------------------------
+# string -> value
+# ---------------------------------------------------------------------------
+
+
+def string_to_integral(sv: StringVal, cap: int, dst: T.DataType) -> ColVal:
+    """Trimmed optional-sign decimal integer; invalid/overflow -> null.
+
+    Spark also accepts a trailing fraction that it truncates ('1.5' -> 1
+    is NOT accepted for integral casts in modern Spark: '1.5' -> null for
+    cast to int from string; Java Long.parseLong semantics + trim)."""
+    mat, tlen, too_long = _window(sv, cap)
+    W = PARSE_WINDOW
+    idx = jnp.arange(W, dtype=jnp.int32)[None, :]
+    neg = mat[:, 0] == ord("-")
+    signed = neg | (mat[:, 0] == ord("+"))
+    dstart = signed.astype(jnp.int32)
+    in_num = (idx >= dstart[:, None]) & (idx < tlen[:, None])
+    is_dig = (mat >= ord("0")) & (mat <= ord("9"))
+    ok = (tlen > dstart) & jnp.all(~in_num | is_dig, axis=1) & ~too_long
+    # accumulate in uint64 with overflow detection
+    acc = jnp.zeros(cap, jnp.uint64)
+    ovf = jnp.zeros(cap, jnp.bool_)
+    for k in range(W):
+        active = in_num[:, k]
+        d = (mat[:, k] - ord("0")).astype(jnp.uint64)
+        new = acc * jnp.uint64(10) + d
+        ovf = ovf | (active & (new < acc))  # mul/add wrap
+        ovf = ovf | (active & (acc > jnp.uint64((2**64 - 1) // 10)))
+        acc = jnp.where(active, new, acc)
+    # range check for the destination type
+    info = jnp.iinfo(T.numpy_dtype(dst))
+    lim = jnp.where(neg, jnp.uint64(-(info.min + 1)) + jnp.uint64(1),
+                    jnp.uint64(info.max))
+    ok = ok & ~ovf & (acc <= lim)
+    sval = acc.astype(jnp.int64)
+    sval = jnp.where(neg, -sval, sval)
+    return ColVal(sval.astype(T.numpy_dtype(dst)),
+                  sv.validity & ok)
+
+
+_TRUE = [b"true", b"t", b"yes", b"y", b"1"]
+_FALSE = [b"false", b"f", b"no", b"n", b"0"]
+
+
+def string_to_bool(sv: StringVal, cap: int) -> ColVal:
+    mat, tlen, too_long = _window(sv, cap)
+    lower = jnp.where((mat >= ord("A")) & (mat <= ord("Z")),
+                      mat + 32, mat)
+
+    def is_lit(lit: bytes):
+        m = tlen == len(lit)
+        for k, ch in enumerate(lit):
+            m = m & (lower[:, k] == ch)
+        return m
+
+    t = jnp.zeros(cap, jnp.bool_)
+    f = jnp.zeros(cap, jnp.bool_)
+    for lit in _TRUE:
+        t = t | is_lit(lit)
+    for lit in _FALSE:
+        f = f | is_lit(lit)
+    return ColVal(t, sv.validity & (t | f) & ~too_long)
+
+
+def _parse_uint_field(mat, lo, hi):
+    """Parse digits mat[:, lo:hi-ish] given per-row [lo, hi) positions."""
+    W = mat.shape[1]
+    idx = jnp.arange(W, dtype=jnp.int32)[None, :]
+    sel = (idx >= lo[:, None]) & (idx < hi[:, None])
+    is_dig = (mat >= ord("0")) & (mat <= ord("9"))
+    ok = jnp.all(~sel | is_dig, axis=1) & (hi > lo)
+    val = jnp.zeros(mat.shape[0], jnp.int64)
+    for k in range(W):
+        active = sel[:, k]
+        val = jnp.where(active, val * 10 + (mat[:, k] - ord("0")), val)
+    return val, ok
+
+
+def _find_byte(mat, ch, start, end):
+    """Per-row first position of ``ch`` in [start, end); end if absent."""
+    W = mat.shape[1]
+    idx = jnp.arange(W, dtype=jnp.int32)[None, :]
+    hit = (mat == ch) & (idx >= start[:, None]) & (idx < end[:, None])
+    pos = jnp.where(jnp.any(hit, axis=1),
+                    jnp.argmax(hit, axis=1).astype(jnp.int32), end)
+    return pos
+
+
+def _parse_date_part(mat, tlen, end):
+    """yyyy[-M[-d]] within [0, end) -> (days, ok)."""
+    cap = mat.shape[0]
+    zeros = jnp.zeros(cap, jnp.int32)
+    d1 = _find_byte(mat, ord("-"), jnp.maximum(zeros, 1), end)
+    y, oky = _parse_uint_field(mat, zeros, d1)
+    has_m = d1 < end
+    d2 = _find_byte(mat, ord("-"), d1 + 1, end)
+    m, okm = _parse_uint_field(mat, d1 + 1, d2)
+    has_d = d2 < end
+    d, okd = _parse_uint_field(mat, d2 + 1, end)
+    m = jnp.where(has_m, m, 1)
+    d = jnp.where(has_d, d, 1)
+    okm = jnp.where(has_m, okm, True)
+    okd = jnp.where(has_d, okd, True)
+    ok = (oky & okm & okd & (y >= 1) & (y <= 9999)
+          & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+          & (d1 >= 1) & (d1 <= 4 + 1))
+    # reject day > month length via round trip
+    days = _days_from_civil(y, m, d)
+    ry, rm, rd = _civil_from_days(days)
+    ok = ok & (ry == y) & (rm == m) & (rd == d)
+    return days, ok
+
+
+def string_to_date(sv: StringVal, cap: int) -> ColVal:
+    mat, tlen, too_long = _window(sv, cap)
+    days, ok = _parse_date_part(mat, tlen, tlen)
+    return ColVal(days.astype(jnp.int32), sv.validity & ok & ~too_long)
+
+
+def string_to_timestamp(sv: StringVal, cap: int) -> ColVal:
+    """yyyy-M-d[ |T][H:m:s[.f{1..6}]][Z|UTC] -> UTC micros."""
+    mat, tlen, too_long = _window(sv, cap)
+    zeros = jnp.zeros(cap, jnp.int32)
+    # optional trailing zone: 'Z' or 'UTC'
+    endz = tlen
+    is_z = (jnp.take_along_axis(
+        mat, jnp.clip(tlen - 1, 0, PARSE_WINDOW - 1)[:, None],
+        axis=1)[:, 0] == ord("Z")) & (tlen >= 1)
+    endz = jnp.where(is_z, tlen - 1, endz)
+    u0 = jnp.take_along_axis(mat, jnp.clip(tlen - 3, 0, 31)[:, None], 1)[:, 0]
+    u1 = jnp.take_along_axis(mat, jnp.clip(tlen - 2, 0, 31)[:, None], 1)[:, 0]
+    u2 = jnp.take_along_axis(mat, jnp.clip(tlen - 1, 0, 31)[:, None], 1)[:, 0]
+    is_utc = (tlen >= 3) & (u0 == ord("U")) & (u1 == ord("T")) & (u2 == ord("C"))
+    endz = jnp.where(is_utc, tlen - 3, endz)
+    # date/time split at ' ' or 'T'
+    sp = _find_byte(mat, ord(" "), zeros, endz)
+    tt = _find_byte(mat, ord("T"), zeros, endz)
+    sep = jnp.minimum(sp, tt)
+    has_time = sep < endz
+    dend = jnp.where(has_time, sep, endz)
+    days, okd = _parse_date_part(mat, tlen, dend)
+    # time H:m:s[.f]
+    c1 = _find_byte(mat, ord(":"), sep + 1, endz)
+    c2 = _find_byte(mat, ord(":"), c1 + 1, endz)
+    dot = _find_byte(mat, ord("."), c2 + 1, endz)
+    h, okh = _parse_uint_field(mat, sep + 1, c1)
+    mi, okmi = _parse_uint_field(mat, c1 + 1, c2)
+    s, oks = _parse_uint_field(mat, c2 + 1, jnp.minimum(dot, endz))
+    f, okf = _parse_uint_field(mat, dot + 1, endz)
+    flen = jnp.clip(endz - (dot + 1), 0, 9)
+    has_frac = dot < endz
+    f = jnp.where(has_frac, f, 0)
+    okf = jnp.where(has_frac, okf & (flen >= 1) & (flen <= 6), True)
+    # scale fraction to micros
+    mult = jnp.select([flen == k for k in range(1, 7)],
+                      [jnp.int64(10 ** (6 - k)) for k in range(1, 7)],
+                      jnp.int64(0))
+    micros_frac = f * mult
+    okt = (okh & okmi & oks & okf & (h >= 0) & (h <= 23)
+           & (mi >= 0) & (mi <= 59) & (s >= 0) & (s <= 59)
+           & (c1 < endz) & (c2 < endz))
+    okt = jnp.where(has_time, okt, True)
+    h = jnp.where(has_time, h, 0)
+    mi = jnp.where(has_time, mi, 0)
+    s = jnp.where(has_time, s, 0)
+    micros_frac = jnp.where(has_time, micros_frac, 0)
+    us = (days * 86_400_000_000
+          + h * 3_600_000_000 + mi * 60_000_000 + s * 1_000_000
+          + micros_frac)
+    return ColVal(us.astype(jnp.int64),
+                  sv.validity & okd & okt & ~too_long)
+
+
+def string_to_float(sv: StringVal, cap: int, dst: T.DataType) -> ColVal:
+    """[+-]?digits[.digits][eE[+-]digits] | Infinity | NaN.
+
+    f64 accumulation parse: within 1 ulp of Java's correctly-rounded
+    result (documented divergence; the device f64 is a double-double)."""
+    mat, tlen, too_long = _window(sv, cap)
+    W = PARSE_WINDOW
+    idx = jnp.arange(W, dtype=jnp.int32)[None, :]
+    zeros = jnp.zeros(cap, jnp.int32)
+    neg = mat[:, 0] == ord("-")
+    signed = neg | (mat[:, 0] == ord("+"))
+    p0 = signed.astype(jnp.int32)
+
+    def lit(word: bytes, lower_ok=False):
+        m = tlen - p0 == len(word)
+        for k, ch in enumerate(word):
+            col = jnp.take_along_axis(mat, jnp.clip(p0 + k, 0, W - 1)[:, None],
+                                      1)[:, 0]
+            cc = col
+            m = m & (cc == ch)
+        return m
+
+    is_inf = lit(b"Infinity")
+    is_nan = lit(b"NaN")
+    # exponent split
+    e1 = _find_byte(mat, ord("e"), p0, tlen)
+    e2 = _find_byte(mat, ord("E"), p0, tlen)
+    epos = jnp.minimum(e1, e2)
+    has_exp = epos < tlen
+    dot = _find_byte(mat, ord("."), p0, jnp.minimum(epos, tlen))
+    mend = jnp.minimum(epos, tlen)
+    ip, oki = _parse_uint_field(mat, p0, jnp.minimum(dot, mend))
+    fp, okf = _parse_uint_field(mat, dot + 1, mend)
+    fdigs = jnp.clip(mend - (dot + 1), 0, 18)
+    has_dot = dot < mend
+    has_int = jnp.minimum(dot, mend) > p0
+    has_frac = has_dot & (mend > dot + 1)
+    oki = jnp.where(has_int, oki, True)
+    okf = jnp.where(has_frac, okf, True)
+    # exponent
+    es_col = jnp.take_along_axis(mat, jnp.clip(epos + 1, 0, W - 1)[:, None],
+                                 1)[:, 0]
+    eneg = es_col == ord("-")
+    esigned = eneg | (es_col == ord("+"))
+    ev, oke = _parse_uint_field(mat, epos + 1 + esigned.astype(jnp.int32),
+                                tlen)
+    oke = jnp.where(has_exp, oke & (tlen > epos + 1 + esigned), True)
+    ev = jnp.where(has_exp, jnp.where(eneg, -ev, ev), 0)
+    ok = (oki & okf & oke & (has_int | has_frac) & ~too_long
+          & (tlen > p0))
+    val = (ip.astype(jnp.float64)
+           + fp.astype(jnp.float64) / (10.0 ** fdigs.astype(jnp.float64)))
+    exp = jnp.clip(ev, -400, 400).astype(jnp.float64)
+    val = val * jnp.power(jnp.float64(10.0), exp)
+    val = jnp.where(is_inf, jnp.float64(jnp.inf), val)
+    val = jnp.where(is_nan, jnp.float64(jnp.nan), val)
+    ok = ok | ((is_inf | is_nan) & ~too_long)
+    val = jnp.where(neg, -val, val)
+    out = val.astype(T.numpy_dtype(dst))
+    return ColVal(out, sv.validity & ok)

@@ -344,6 +344,10 @@ def cast_val(cv: Val, src: T.DataType, dst: T.DataType, ansi: bool,
              capacity: int) -> Val:
     if src == dst:
         return cv
+    if dst in (T.STRING, T.BINARY) and not isinstance(cv, StringVal):
+        return _cast_to_string(cv, src)
+    if isinstance(cv, StringVal):
+        return _cast_from_string(cv, dst, capacity)
     if isinstance(cv, WideVal) or _is_wide(dst):
         return _cast_wide(cv, src, dst)
     assert isinstance(cv, ColVal), f"device cast from {src} not supported"
@@ -379,6 +383,48 @@ def cast_val(cv: Val, src: T.DataType, dst: T.DataType, ansi: bool,
     if dst in (T.FLOAT, T.DOUBLE):
         return ColVal(data.astype(T.numpy_dtype(dst)), valid)
     raise NotImplementedError(f"cast {src} -> {dst}")
+
+
+def _cast_to_string(cv: Val, src: T.DataType) -> StringVal:
+    """value -> string on device (reference GpuCast.scala:1713 + jni
+    CastStrings; float->string stays on CPU — gated in check_expr)."""
+    from spark_rapids_tpu.exprs import cast_strings as CS
+
+    if isinstance(cv, WideVal):
+        assert isinstance(src, T.DecimalType)
+        return CS.decimal_to_string(cv.lo, cv.hi, src.scale, cv.validity)
+    data, valid = cv.data, cv.validity
+    if isinstance(src, T.DecimalType):
+        return CS.decimal_to_string(data, None, src.scale, valid)
+    if src == T.BOOLEAN:
+        return CS.bool_to_string(data, valid)
+    if src in T.INTEGRAL_TYPES:
+        return CS.long_to_string(data, valid)
+    if src == T.DATE:
+        return CS.date_to_string(data, valid)
+    if src == T.TIMESTAMP:
+        return CS.timestamp_to_string(data, valid)
+    raise NotImplementedError(f"cast {src} -> string not on device")
+
+
+def _cast_from_string(cv: "StringVal", dst: T.DataType, capacity: int) -> Val:
+    """string -> value on device (reference GpuCast.scala:288 + jni
+    CastStrings; string->decimal and ANSI-mode stay on CPU)."""
+    from spark_rapids_tpu.exprs import cast_strings as CS
+
+    if dst in (T.STRING, T.BINARY):
+        return cv
+    if dst in T.INTEGRAL_TYPES:
+        return CS.string_to_integral(cv, capacity, dst)
+    if dst == T.BOOLEAN:
+        return CS.string_to_bool(cv, capacity)
+    if dst == T.DATE:
+        return CS.string_to_date(cv, capacity)
+    if dst == T.TIMESTAMP:
+        return CS.string_to_timestamp(cv, capacity)
+    if dst in (T.FLOAT, T.DOUBLE):
+        return CS.string_to_float(cv, capacity, dst)
+    raise NotImplementedError(f"cast string -> {dst} not on device")
 
 
 def _float_or_int_to_int(data, valid, dst: T.DataType) -> ColVal:

@@ -53,16 +53,14 @@ class TrafficController:
     def __init__(self, max_in_flight_bytes: Optional[int] = None):
         if max_in_flight_bytes is None:
             from spark_rapids_tpu.config import conf as _C
-            cfg = _C.get_active()
-            max_in_flight_bytes = (
-                _C.WRITER_ASYNC_MAX_IN_FLIGHT.get(cfg)
-                if _C.WRITER_ASYNC_ENABLED.get(cfg) else 0)
-        self.throttle = HostMemoryThrottle(max_in_flight_bytes or (512 << 20))
+            max_in_flight_bytes = _C.WRITER_ASYNC_MAX_IN_FLIGHT.get(
+                _C.get_active())
+        self.throttle = HostMemoryThrottle(max_in_flight_bytes)
         self._tasks = 0
         self._tlock = threading.Lock()
 
     @classmethod
-    def initialize(cls, max_in_flight_bytes: int = 512 << 20):
+    def initialize(cls, max_in_flight_bytes: Optional[int] = None):
         with cls._lock:
             if cls._instance is None:
                 cls._instance = cls(max_in_flight_bytes)

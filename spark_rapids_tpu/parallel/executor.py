@@ -351,6 +351,11 @@ class MeshExecutor:
             return self._mark(node, self._lower_agg(node))
         if isinstance(node, BroadcastHashJoinExec):
             return self._mark(node, self._lower_bhj(node))
+        from spark_rapids_tpu.exec.misc import LocalLimitExec
+        from spark_rapids_tpu.exec.sort import SortExec
+        if (isinstance(node, LocalLimitExec)
+                and isinstance(node.children[0], SortExec)):
+            return self._mark(node, self._lower_local_topn(node))
         raise NotLowerable(type(node).__name__)
 
     def _mark(self, node: TpuExec, low: _Lowered) -> _Lowered:
@@ -476,6 +481,10 @@ class MeshExecutor:
             b = child.fn(ctx)
             if isinstance(part, HashPartitioner):
                 pid = part.partition_ids(b)
+            elif isinstance(part, SinglePartitioner):
+                # global stage: every row to device 0 (the windowed
+                # exchange + merge_fn keeps the receive state bounded)
+                pid = jnp.zeros(b.capacity, jnp.int32)
             else:
                 pid = (jnp.arange(b.capacity, dtype=jnp.int32)
                        + part.start) % part.num_partitions
@@ -529,21 +538,100 @@ class MeshExecutor:
 
         return _Lowered(fn, template, merged.cap)
 
+    def _lower_bhj_bucketed(self, node, build, prep) -> _Lowered:
+        """Broadcast join over the bucketed unique-key table
+        (kernels.build_join_table): string/multi-key dimension joins lower
+        onto the mesh with the table arrays replicated to every device and
+        the fully-traced _unique_probe per batch (VERDICT r4 item 6)."""
+        import jax.numpy as jnp
+
+        tbl, slots = prep
+        probe = self._lower_child(node.children[0])
+        # replicate table arrays + build columns
+        ridx = len(self._repl_host)
+        build_flat, build_meta = _flatten_batch_arrays(build)
+        self._repl_host.extend(build_flat)
+        t_idx = len(self._repl_host)
+        self._repl_host.extend([np.asarray(tbl.order), np.asarray(tbl.h1s),
+                                np.asarray(tbl.h2s), np.asarray(tbl.valid),
+                                np.asarray(tbl.starts)])
+        lg_b = tbl.lg_b
+        out_cap = probe.cap
+        # pre-seed string byte caps (host-side; traced path cannot sync)
+        for cap in (out_cap, _TEMPLATE_CAP):
+            caps = {}
+            for i, c in enumerate(build.columns):
+                if c.offsets is not None:
+                    ml = int(jax.device_get(
+                        jnp.max(c.offsets[1:] - c.offsets[:-1])))
+                    caps[i] = bucket_capacity(max(cap * max(ml, 1), 8), 8)
+            cache = getattr(node, "_dense_bcache", None)
+            if cache is None:
+                cache = node._dense_bcache = {}
+            cache[("tbl", 0, cap)] = caps
+        from spark_rapids_tpu.exec.kernels import JoinTable
+
+        def tbl_of(ctx):
+            return JoinTable(ctx.repl[t_idx], ctx.repl[t_idx + 1],
+                             ctx.repl[t_idx + 2], ctx.repl[t_idx + 3],
+                             ctx.repl[t_idx + 4], lg_b)
+
+        template, _ = node._join_batch_unique(
+            probe.template, build, (tbl, slots),
+            jnp.zeros(build.capacity, jnp.bool_), 0)
+
+        def fn(ctx):
+            b = probe.fn(ctx)
+            bb = _rebuild_batch_arrays(ctx.repl, ridx, build_meta, build)
+            out, _ = node._join_batch_unique(
+                b, bb, (tbl_of(ctx), slots),
+                jnp.zeros(bb.capacity, jnp.bool_), 0)
+            return out
+
+        return _Lowered(fn, template, out_cap)
+
+    def _lower_local_topn(self, node) -> _Lowered:
+        """LocalLimit(Sort(child)): per-device sort + static-N head — the
+        distributed half of take_ordered_and_project. The host tail
+        (gather + final merge sort + global limit) then works over
+        n_dev * N rows only (reference: GpuTakeOrderedAndProjectExec)."""
+        from spark_rapids_tpu.exec.sort import SortExec, _slice_rows
+
+        sort_node = node.children[0]
+        assert isinstance(sort_node, SortExec)
+        child = self._lower_child(sort_node.children[0])
+        for c in child.template.columns:
+            if c.offsets is not None:
+                raise NotLowerable("plain string column in mesh top-N")
+        sort_node._prepare()
+        specs = tuple(sort_node._specs)
+        limit = int(node.limit)
+        out_cap = bucket_capacity(max(limit, 1), self.min_local_cap)
+        if out_cap > child.cap:
+            out_cap = child.cap
+        byte_caps = tuple(0 for _ in child.template.columns)
+
+        from spark_rapids_tpu.exec.sort import _sort_run
+
+        def run(b):
+            srt = _sort_run(b, specs)
+            n = jnp.minimum(srt.num_rows, limit)
+            return _slice_rows(srt, jnp.int32(0), n, out_cap, byte_caps)
+
+        template = run(child.template)
+
+        def fn(ctx):
+            return run(child.fn(ctx))
+
+        self.dist_nodes.append("SortExec")
+        return _Lowered(fn, template, out_cap)
+
     def _lower_bhj(self, node) -> _Lowered:
         if node.join_type not in ("inner", "left", "left_semi", "left_anti"):
             raise NotLowerable(
                 f"broadcast {node.join_type} join needs cross-device "
                 "matched-tracking")
         node._prepare()
-        # schema-level dense precheck BEFORE executing the build side, so a
-        # clearly-ineligible join (string/multi/non-int keys) does not pay
-        # for a build it will immediately discard
-        if len(node._rkeys) != 1:
-            raise NotLowerable("multi-key join probe is not traced yet")
-        bdt = node.right.output_schema[node._rkeys[0]].dtype
-        pdt = node.left.output_schema[node._lkeys[0]].dtype
-        if bdt not in (T.INT, T.LONG) or pdt not in (T.INT, T.LONG):
-            raise NotLowerable("non-int join key: dense probe ineligible")
         # build side on the host (it is small by CBO choice), replicated
         self.host_nodes.append(type(node.children[1]).__name__ + "(build)")
         build_batches = list(node.right.execute_all())
@@ -558,8 +646,15 @@ class MeshExecutor:
         build = batch_from_arrow(btbl, min_bucket=16)
         dense = node._prepare_dense(build)
         if dense is None:
+            # unique-key bucketed table (string/multi/wide-domain keys):
+            # the r4 fully-traced probe — lowerable the same way as dense
+            prep = node._prepare_table(build)
+            # NB: JoinHashes (duplicate keys) is a NamedTuple — only a
+            # PLAIN (tbl, slots) pair means the bucketed unique path
+            if type(prep) is tuple:
+                return self._lower_bhj_bucketed(node, build, prep)
             raise NotLowerable(
-                "general (non-dense) join probe is not traced yet")
+                "duplicate-key general join probe is not traced yet")
         probe = self._lower_child(node.children[0])
 
         # register build arrays + dense table as replicated inputs

@@ -1279,9 +1279,215 @@ def _obj_cmp(a, b, op):
     return np.array([f(x, y) for x, y in zip(a, b)])
 
 
+def _cpu_cast_from_string(d, m, dst: T.DataType):
+    """String parsing casts, Python-exact (the oracle for the device
+    kernels in exprs/cast_strings.py — same documented literal subset)."""
+    import datetime
+
+    n = len(d)
+    m = m.copy()
+    out = []
+
+    def invalid(i):
+        m[i] = False
+        return 0
+
+    for i in range(n):
+        if not m[i]:
+            out.append(0)
+            continue
+        s = str(d[i])
+        t = s.strip("".join(chr(c) for c in range(0x21)))
+        if dst in T.INTEGRAL_TYPES:
+            info = np.iinfo(T.numpy_dtype(dst))
+            body = t[1:] if t[:1] in "+-" else t
+            if not body or not body.isascii() or not body.isdigit():
+                out.append(invalid(i))
+                continue
+            v = int(t)
+            out.append(v if info.min <= v <= info.max else invalid(i))
+        elif dst == T.BOOLEAN:
+            lo = t.lower()
+            if lo in ("true", "t", "yes", "y", "1"):
+                out.append(True)
+            elif lo in ("false", "f", "no", "n", "0"):
+                out.append(False)
+            else:
+                out.append(invalid(i))
+        elif dst == T.DATE:
+            parts = t.split("-")
+            try:
+                if not 1 <= len(parts) <= 3 or len(parts[0]) > 5:
+                    raise ValueError
+                y = int(parts[0])
+                mo = int(parts[1]) if len(parts) > 1 else 1
+                dd = int(parts[2]) if len(parts) > 2 else 1
+                if any(p.strip() != p or not p
+                       or p[:1] in "+-" for p in parts):
+                    raise ValueError
+                out.append((datetime.date(y, mo, dd)
+                            - datetime.date(1970, 1, 1)).days)
+            except (ValueError, TypeError):
+                out.append(invalid(i))
+        elif dst == T.TIMESTAMP:
+            tt = t
+            if tt.endswith("UTC"):
+                tt = tt[:-3]
+            elif tt.endswith("Z"):
+                tt = tt[:-1]
+            sep = None
+            for c in (" ", "T"):
+                if c in tt:
+                    sep = c
+                    break
+            try:
+                dpart, tpart = (tt.split(sep, 1) if sep else (tt, ""))
+                parts = dpart.split("-")
+                if not 1 <= len(parts) <= 3:
+                    raise ValueError
+                if any(p.strip() != p or not p or p[:1] in "+-"
+                       for p in parts):
+                    raise ValueError
+                y = int(parts[0])
+                mo = int(parts[1]) if len(parts) > 1 else 1
+                dd = int(parts[2]) if len(parts) > 2 else 1
+                frac = 0
+                h = mi = ss = 0
+                if tpart:
+                    if "." in tpart:
+                        tpart, fs = tpart.split(".", 1)
+                        if not (1 <= len(fs) <= 6 and fs.isdigit()):
+                            raise ValueError
+                        frac = int(fs) * 10 ** (6 - len(fs))
+                    hms = tpart.split(":")
+                    if len(hms) != 3:
+                        raise ValueError
+                    if any(p.strip() != p or not p or p[:1] in "+-"
+                           for p in hms):
+                        raise ValueError
+                    h, mi, ss = (int(x) for x in hms)
+                    if not (0 <= h <= 23 and 0 <= mi <= 59 and 0 <= ss <= 59):
+                        raise ValueError
+                days = (datetime.date(y, mo, dd)
+                        - datetime.date(1970, 1, 1)).days
+                out.append(days * 86_400_000_000 + h * 3_600_000_000
+                           + mi * 60_000_000 + ss * 1_000_000 + frac)
+            except (ValueError, TypeError):
+                out.append(invalid(i))
+        elif dst in (T.FLOAT, T.DOUBLE):
+            body = t[1:] if t[:1] in "+-" else t
+            sign = -1.0 if t[:1] == "-" else 1.0
+            if body == "Infinity":
+                out.append(sign * float("inf"))
+                continue
+            if body == "NaN":
+                out.append(float("nan"))
+                continue
+            import re as _re
+            if not _re.fullmatch(
+                    r"(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?", body):
+                out.append(invalid(i))
+                continue
+            try:
+                out.append(float(t))
+            except (ValueError, OverflowError):
+                out.append(invalid(i))
+        else:
+            raise NotImplementedError(f"cpu cast string->{dst}")
+    if dst in (T.FLOAT, T.DOUBLE):
+        arr = np.array(out, T.numpy_dtype(dst))
+    elif dst == T.BOOLEAN:
+        arr = np.array(out, np.bool_)
+    elif dst == T.TIMESTAMP:
+        arr = np.array(out, np.int64)
+    elif dst == T.DATE:
+        arr = np.array(out, np.int32)
+    else:
+        arr = np.array(out, T.numpy_dtype(dst))
+    return arr, m
+
+
+def _java_double_str(x: float) -> str:
+    """Java Double.toString (Spark's float->string): decimal form for
+    1e-3 <= |x| < 1e7, else scientific 'd.dddEe'; always a fraction digit."""
+    import math
+
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0.0:
+        return "-0.0" if math.copysign(1.0, x) < 0 else "0.0"
+    mag = abs(x)
+    if 1e-3 <= mag < 1e7:
+        s = repr(x)
+        if "e" in s or "E" in s:  # repr may use sci form near boundaries
+            f = float(s)
+            s = f"{f:f}".rstrip("0")
+            if s.endswith("."):
+                s += "0"
+        if "." not in s:
+            s += ".0"
+        return s
+    # scientific: repr's shortest round-trip digits, repositioned by pure
+    # string manipulation (NO float arithmetic — a divide would perturb
+    # the digits and break round-tripping)
+    sgn = "-" if x < 0 else ""
+    sr = repr(mag)
+    if "e" in sr:
+        mant, exp = sr.split("e")
+        e = int(exp)
+        digits = mant.replace(".", "")  # repr mantissa has 1 lead digit
+    else:
+        ip, _, fp = sr.partition(".")
+        all_digits = ip + fp
+        k = len(all_digits) - len(all_digits.lstrip("0"))
+        digits = all_digits[k:].rstrip("0") or "0"
+        e = len(ip) - 1 - k
+    frac = digits[1:].rstrip("0") or "0"
+    return f"{sgn}{digits[0]}.{frac}E{e}"
+
+
+def _cpu_cast_to_string(d, m, src: T.DataType):
+    import datetime
+
+    out = []
+    for i in range(len(d)):
+        if not m[i]:
+            out.append("")
+            continue
+        v = d[i]
+        if src == T.BOOLEAN:
+            out.append("true" if v else "false")
+        elif src == T.DATE:
+            dt = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+            out.append(dt.isoformat())
+        elif src == T.TIMESTAMP:
+            us = int(v)
+            days, rem = divmod(us, 86_400_000_000)
+            dt = datetime.date(1970, 1, 1) + datetime.timedelta(days=days)
+            secs, frac = divmod(rem, 1_000_000)
+            h, r = divmod(secs, 3600)
+            mi, ss = divmod(r, 60)
+            s = f"{dt.isoformat()} {h:02d}:{mi:02d}:{ss:02d}"
+            if frac:
+                s += ("." + f"{frac:06d}").rstrip("0")
+            out.append(s)
+        elif src in (T.FLOAT, T.DOUBLE):
+            out.append(_java_double_str(float(v)))
+        else:
+            out.append(str(int(v)))
+    return np.array(out, dtype=object), m
+
+
 def _cpu_cast(d, m, src: T.DataType, dst: T.DataType):
     if src == dst:
         return d, m
+    if src in (T.STRING, T.BINARY) and dst not in (T.STRING, T.BINARY) \
+            and not isinstance(dst, T.DecimalType):
+        return _cpu_cast_from_string(d, m, dst)
+    if dst in (T.STRING, T.BINARY) and not isinstance(src, T.DecimalType):
+        return _cpu_cast_to_string(d, m, src)
     if isinstance(dst, T.DecimalType):
         # mirrors device _cast_to_decimal (exprs/eval.py:309)
         bound = 10 ** dst.precision

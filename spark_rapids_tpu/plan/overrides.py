@@ -174,9 +174,6 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
                     reasons.append(
                         f"{type(bound).__name__} not on device for "
                         "decimal128")
-                if isinstance(bound, E.Cast) and bound.to in (
-                        T.STRING, T.BINARY):
-                    reasons.append("decimal128 cast to string not on device")
                 if isinstance(bound, E.Cast) and isinstance(
                         bound.to, T.DecimalType) and isinstance(
                         bound.children[0].dtype, T.DecimalType):
@@ -184,6 +181,20 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
                     if drop > 18:
                         reasons.append(
                             "decimal128 scale reduction > 18 not on device")
+            # cast combos without a device kernel (reference: the CPU
+            # fallback notes in GpuCast docs): float->string needs Java
+            # shortest-round-trip formatting; string->decimal and ANSI
+            # string casts stay on the CPU engine
+            if isinstance(bound, E.Cast):
+                cdt = bound.children[0].dtype
+                if cdt in (T.FLOAT, T.DOUBLE) and bound.to in (
+                        T.STRING, T.BINARY):
+                    reasons.append("float to string cast not on device")
+                if cdt in (T.STRING, T.BINARY):
+                    if isinstance(bound.to, T.DecimalType):
+                        reasons.append("string to decimal cast not on device")
+                    if bound.ansi:
+                        reasons.append("ANSI string cast not on device")
             # string ordering comparisons are CPU-only in round 1
             if isinstance(bound, (E.LessThan, E.LessThanOrEqual,
                                   E.GreaterThan, E.GreaterThanOrEqual)):

@@ -21,6 +21,13 @@ if not TPU_LANE:
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     )
+    # CPU lanes use a machine-local compile cache: the shared persistent
+    # cache can hold CPU AOT kernels compiled under OTHER host feature
+    # flags, which segfault (SIGILL) when loaded here
+    # (docs/perf_notes_r03.md; observed again in r5's slow-lane run)
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join("/tmp", f"srtpu_xla_cpu_{os.uname().nodename}"))
 
 import jax  # noqa: E402
 
