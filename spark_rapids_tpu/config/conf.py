@@ -331,11 +331,6 @@ SCAN_COMBINE_WINDOW = conf(
         "reader before device upload (reference: MULTITHREADED reader "
         "combine settings).")
 
-WRITER_ASYNC_ENABLED = conf(
-    "spark.rapids.tpu.sql.write.async.enabled", default=True,
-    doc="Throttled async output writes (reference: AsyncOutputStream + "
-        "TrafficController).")
-
 WRITER_ASYNC_MAX_IN_FLIGHT = conf(
     "spark.rapids.tpu.sql.write.async.maxInFlightBytes", default=256 << 20,
     doc="Host bytes allowed in flight for async writes before producers "

@@ -39,7 +39,11 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.column import ColVal
 from spark_rapids_tpu.exprs.strings import StringVal, make_offsets, row_ids
 
-PARSE_WINDOW = 32  # bytes of each row examined by parsing casts
+# Bytes of each row examined by parsing casts. Trimmed literals longer
+# than this return NULL on BOTH engines (the CPU oracle enforces the same
+# bound) — a documented engine limit, generous for every Spark-accepted
+# numeric/datetime literal.
+PARSE_WINDOW = 64
 
 
 # ---------------------------------------------------------------------------
@@ -185,7 +189,6 @@ def decimal_to_string(lo, hi, scale: int, validity) -> StringVal:
         ndigits = 39
     # digs[k] = digit at 10^k. layout: sign, int part, '.', fraction
     cap = digs[0].shape[0]
-    n_int_digits_arr = []
     # significant integral digits = highest k >= scale with digit != 0
     sig = jnp.zeros(cap, jnp.int32)
     for k in range(scale, ndigits):
@@ -196,7 +199,6 @@ def decimal_to_string(lo, hi, scale: int, validity) -> StringVal:
     out = jnp.zeros((cap, W), jnp.uint8)
     lens = int_digits + (frac + 1 if frac else 0) + neg.astype(jnp.int32)
     # write right-to-left: fraction digits, dot, integral digits, sign
-    posn = W  # exclusive end
     col = W
     for k in range(frac):
         col -= 1
@@ -378,12 +380,14 @@ def string_to_bool(sv: StringVal, cap: int) -> ColVal:
 
 
 def _parse_uint_field(mat, lo, hi):
-    """Parse digits mat[:, lo:hi-ish] given per-row [lo, hi) positions."""
+    """Parse digits mat[:, lo:hi) given per-row positions. Fields longer
+    than 15 digits are invalid (keeps the int64 accumulator exact — every
+    legitimate date/time/exponent field is far shorter)."""
     W = mat.shape[1]
     idx = jnp.arange(W, dtype=jnp.int32)[None, :]
     sel = (idx >= lo[:, None]) & (idx < hi[:, None])
     is_dig = (mat >= ord("0")) & (mat <= ord("9"))
-    ok = jnp.all(~sel | is_dig, axis=1) & (hi > lo)
+    ok = jnp.all(~sel | is_dig, axis=1) & (hi > lo) & (hi - lo <= 15)
     val = jnp.zeros(mat.shape[0], jnp.int64)
     for k in range(W):
         active = sel[:, k]

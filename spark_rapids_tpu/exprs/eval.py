@@ -906,6 +906,13 @@ def eval_expr(expr: E.Expression, ctx: EvalContext) -> Val:
         out = ((q + take_hi.astype(jnp.int64)) * s).astype(
             T.numpy_dtype(expr.dtype))
         return ColVal(out, c.validity)
+    if isinstance(expr, E.GetJsonObject):
+        from spark_rapids_tpu.exprs import json_device as JD
+
+        s = eval_expr(expr.child, ctx)
+        assert isinstance(s, StringVal)
+        return JD.get_json_object(s, expr.path, cap)
+
     if isinstance(expr, E.GetStructField):
         v = eval_expr(expr.child, ctx)
         st = expr.child.dtype

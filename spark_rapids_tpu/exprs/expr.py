@@ -1619,11 +1619,9 @@ class Kurtosis(_VarianceBase):
 class GetJsonObject(_Unary):
     """get_json_object(json_str, path): JSONPath subset ($.a.b[0], $['a'])
     returning the matched value as a string (scalars unquoted, containers
-    re-serialized compactly). CPU-engine expression (reference: jni
-    JSONUtils GpuGetJsonObject; a device byte-level JSON scanner is future
-    work)."""
-
-    device_supported = False
+    re-serialized compactly). Device impl: exprs/json_device.py byte-level
+    scanner (reference: jni JSONUtils GpuGetJsonObject); paths outside the
+    supported grammar fall back to CPU (check_expr)."""
 
     def __init__(self, child: Expression, path: str):
         super().__init__(child)

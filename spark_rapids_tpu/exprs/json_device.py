@@ -1,0 +1,257 @@
+"""Device get_json_object: a byte-level JSON scanner as segmented scans.
+
+Reference: jni JSONUtils / GpuGetJsonObject (SURVEY.md §2.11 item 2).
+TPU-first design: the JSON structure of EVERY row is computed in a few
+global passes over the flat byte space (string-mode parity with
+backslash-escape handling, brace/bracket depth, next/previous
+non-whitespace maps — all segmented cumulative ops), then each static
+path step narrows a per-row [lo, hi) byte range with a handful of
+segment_min reductions. No per-row loops, no data-dependent shapes.
+
+Output semantics match the CPU oracle (plan/cpu.py GpuGetJsonObject
+analog): strings unquoted + \\" \\\\ \\/ unescaped, true/false/numbers as
+text, containers with structural whitespace stripped (the compact
+re-serialization), JSON null / missing path / non-container lookups ->
+SQL NULL. Documented divergences: \\uXXXX escapes are passed through
+verbatim (the oracle decodes them) and non-canonical number spellings
+keep their original text ('1.50' stays '1.50'); both follow the raw-copy
+behavior of the reference's kernel rather than a JSON round trip.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.exprs.strings import StringVal, make_offsets, row_ids
+
+
+def parse_path(path: str):
+    """$.name / ['name'] / [idx] steps; None when unsupported."""
+    if not path.startswith("$"):
+        return None
+    steps: List[Tuple[str, Union[bytes, int]]] = []
+    i = 1
+    while i < len(path):
+        if path[i] == ".":
+            j = i + 1
+            while j < len(path) and path[j] not in ".[":
+                j += 1
+            key = path[i + 1: j]
+            if not key or '"' in key or "\\" in key:
+                return None
+            steps.append(("key", key.encode()))
+            i = j
+        elif path[i] == "[":
+            j = path.find("]", i)
+            if j < 0:
+                return None
+            tok = path[i + 1: j]
+            if tok[:1] in ("'", '"'):
+                if len(tok) < 2 or tok[-1] != tok[0]:
+                    return None
+                steps.append(("key", tok[1:-1].encode()))
+            else:
+                try:
+                    steps.append(("index", int(tok)))
+                except ValueError:
+                    return None
+            i = j + 1
+        else:
+            return None
+    return steps
+
+
+def get_json_object(sv: StringVal, path: str, cap: int) -> StringVal:
+    steps = parse_path(path)
+    assert steps is not None, "unsupported path gated by the planner"
+    data = sv.data
+    offsets = sv.offsets
+    nbytes = data.shape[0]
+    j = jnp.arange(nbytes, dtype=jnp.int32)
+    rows = jnp.clip(row_ids(offsets, nbytes), 0, cap - 1)
+    row_start = offsets[:-1][rows]
+    row_end = offsets[1:][rows]
+    in_any = j < offsets[-1]
+
+    # --- escape/string structure (one pass each) -------------------------
+    bs = (data == ord("\\")) & in_any
+    # last non-backslash position before/at i (cummax, resets never)
+    lastnb = jax.lax.associative_scan(jnp.maximum,
+                                      jnp.where(~bs, j, -1))
+    lastnb = jnp.maximum(lastnb, row_start - 1)  # runs don't cross rows
+    runlen = j - lastnb  # consecutive backslashes ending at i (incl i)
+    prev_run = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                runlen[:-1]]) * jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), bs[:-1].astype(jnp.int32)])
+    escaped = (prev_run % 2) == 1
+    quote = (data == ord('"')) & ~escaped & in_any
+    qcs = jnp.cumsum(quote.astype(jnp.int32))
+    qbase = qcs[jnp.clip(row_start, 0, nbytes - 1)] - jnp.where(
+        quote[jnp.clip(row_start, 0, nbytes - 1)], 1, 0)
+    q_before = qcs - quote.astype(jnp.int32) - qbase  # quotes strictly < i
+    in_str = (q_before % 2) == 1  # true INSIDE a string (not at its quotes)
+    struct = ~in_str & ~quote & in_any  # structural, non-quote bytes
+
+    # --- depth -----------------------------------------------------------
+    opens = struct & ((data == ord("{")) | (data == ord("[")))
+    closes = struct & ((data == ord("}")) | (data == ord("]")))
+    delta = opens.astype(jnp.int32) - closes.astype(jnp.int32)
+    dcs = jnp.cumsum(delta)
+    dbase = dcs[jnp.clip(row_start, 0, nbytes - 1)] - delta[
+        jnp.clip(row_start, 0, nbytes - 1)]
+    depth_after = dcs - dbase
+    depth_before = depth_after - delta
+
+    # --- non-whitespace neighbor maps ------------------------------------
+    ws = struct & ((data == ord(" ")) | (data == ord("\t"))
+                   | (data == ord("\n")) | (data == ord("\r")))
+    nonws = in_any & ~ws
+    # previous non-ws position < i (within row)
+    pnw = jax.lax.associative_scan(jnp.maximum, jnp.where(nonws, j, -1))
+    prev_nonws = jnp.concatenate([jnp.full(1, -1, jnp.int32), pnw[:-1]])
+    prev_nonws = jnp.where(prev_nonws >= row_start, prev_nonws, -1)
+
+    def seg_min_where(mask, lo, hi):
+        """Per-row min position with mask over [lo, hi); nbytes if none."""
+        m = mask & (j >= lo[rows]) & (j < hi[rows])
+        return jax.ops.segment_min(jnp.where(m, j, nbytes), rows,
+                                   num_segments=cap,
+                                   indices_are_sorted=True)
+
+    # --- walk the path ----------------------------------------------------
+    row_end_r = offsets[1:].astype(jnp.int32)
+    # initial value range: the row with leading whitespace skipped
+    first_nw = jax.ops.segment_min(jnp.where(nonws, j, nbytes), rows,
+                                   num_segments=cap,
+                                   indices_are_sorted=True)
+    lo = jnp.clip(first_nw, 0, nbytes).astype(jnp.int32)
+    hi = row_end_r
+    base = jnp.zeros(cap, jnp.int32)
+    ok = sv.validity & (first_nw < nbytes)
+
+    for kind, arg in steps:
+        if kind == "key":
+            key = np.frombuffer(arg, np.uint8)
+            L = len(key)
+            # candidate: structural quote at depth base+1 whose preceding
+            # non-ws is '{' or ',' (key position), spelling the key and
+            # followed by '"' then (ws*) ':'
+            cand = quote & (depth_before == base[rows] + 1)
+            pprev = jnp.clip(prev_nonws, 0, nbytes - 1)
+            prev_ch = data[pprev]
+            cand = cand & (prev_nonws >= 0) & (
+                (prev_ch == ord("{")) | (prev_ch == ord(",")))
+            for i2, ch in enumerate(key):
+                pos = jnp.clip(j + 1 + i2, 0, nbytes - 1)
+                cand = cand & (data[pos] == ch) & (j + 1 + i2 < row_end)
+            endq = jnp.clip(j + 1 + L, 0, nbytes - 1)
+            cand = cand & quote[endq] & (j + 1 + L < row_end)
+            p = seg_min_where(cand, lo, hi)
+            found = p < nbytes
+            p_c = jnp.clip(p, 0, nbytes - 1)
+            # colon after closing quote (ws allowed)
+            colon = seg_min_where(nonws, p_c + L + 2, row_end_r)
+            colon_c = jnp.clip(colon, 0, nbytes - 1)
+            found = found & (colon < nbytes) & (data[colon_c] == ord(":"))
+            vstart = seg_min_where(nonws, colon_c + 1, row_end_r)
+            # value end: next ',' at depth base+1 or the object's '}'
+            ends = struct & (
+                ((data == ord(",")) & (depth_before == base[rows] + 1))
+                | ((data == ord("}")) & (depth_after == base[rows])))
+            vend = seg_min_where(ends, jnp.clip(vstart, 0, nbytes - 1),
+                                 hi)
+            ok = ok & found & (vstart < nbytes) & (vend < nbytes)
+            lo = jnp.clip(vstart, 0, nbytes - 1).astype(jnp.int32)
+            hi = jnp.clip(vend, 0, nbytes).astype(jnp.int32)
+            base = base + 1
+        else:
+            n = arg
+            lo_c = jnp.clip(lo, 0, nbytes - 1)
+            is_arr = data[lo_c] == ord("[")
+            seps = struct & (data == ord(",")) & (
+                depth_before == base[rows] + 1)
+            scs = jnp.cumsum((seps & (j >= lo[rows]) & (j < hi[rows])
+                              ).astype(jnp.int32))
+            total = jnp.where(
+                hi > lo + 1,
+                scs[jnp.clip(hi - 1, 0, nbytes - 1)] - scs[lo_c], 0)
+            # empty array: '[' then ws* then ']'
+            first_inner = seg_min_where(nonws, lo_c + 1, hi)
+            fi_c = jnp.clip(first_inner, 0, nbytes - 1)
+            empty = (first_inner < nbytes) & (data[fi_c] == ord("]"))
+            n_elems = jnp.where(empty, 0, total + 1)
+            idx = (n_elems + n if n < 0
+                   else jnp.full(cap, n, jnp.int32))
+            ok = ok & is_arr & (idx >= 0) & (idx < n_elems)
+            # element start: after '[' (idx=0) or after the idx-th ','
+            kth = seps & (j >= lo[rows]) & (j < hi[rows]) & (
+                (scs - scs[lo_c][rows]) == idx[rows])
+            sep_pos = jax.ops.segment_min(jnp.where(kth, j, nbytes), rows,
+                                          num_segments=cap,
+                                          indices_are_sorted=True)
+            estart_from = jnp.where(idx == 0, lo + 1,
+                                    jnp.clip(sep_pos, 0, nbytes - 1) + 1)
+            vstart = seg_min_where(nonws, estart_from, row_end_r)
+            ends = struct & (
+                ((data == ord(",")) & (depth_before == base[rows] + 1))
+                | ((data == ord("]")) & (depth_after == base[rows])))
+            vend = seg_min_where(ends, jnp.clip(vstart, 0, nbytes - 1), hi)
+            ok = ok & (vstart < nbytes) & (vend < nbytes)
+            lo = jnp.clip(vstart, 0, nbytes - 1).astype(jnp.int32)
+            hi = jnp.clip(vend, 0, nbytes).astype(jnp.int32)
+            base = base + 1
+
+    # --- trim trailing ws of the selected range --------------------------
+    last_nonws = jax.ops.segment_max(
+        jnp.where(nonws & (j >= lo[rows]) & (j < hi[rows]), j, -1), rows,
+        num_segments=cap, indices_are_sorted=True)
+    hi = jnp.where(last_nonws >= 0, last_nonws + 1, lo)
+    ok = ok & (hi > lo)
+
+    # --- classify value --------------------------------------------------
+    lo_c = jnp.clip(lo, 0, nbytes - 1)
+    first_ch = data[lo_c]
+    is_string = first_ch == ord('"')
+    # JSON null -> SQL NULL
+    ln = hi - lo
+    is_null = (ln == 4)
+    for i2, ch in enumerate(b"null"):
+        is_null = is_null & (data[jnp.clip(lo + i2, 0, nbytes - 1)] == ch)
+    ok = ok & ~is_null
+
+    # emit bytes: per-byte keep mask over the selected ranges
+    in_sel = (j >= lo[rows]) & (j < hi[rows]) & ok[rows]
+    sel_str = is_string[rows]
+    # strings: drop the surrounding quotes and escape backslashes
+    drop = sel_str & ((j == lo[rows]) | (j == hi[rows] - 1))
+    esc_bs = bs & ~escaped  # a backslash that STARTS an escape pair
+    drop = drop | (sel_str & esc_bs)
+    # containers/scalars: drop structural whitespace (compact form)
+    drop = drop | (~sel_str & ws)
+    keep = in_sel & ~drop
+    # JSON control escapes inside strings: the kept byte after a dropped
+    # escape backslash is substituted (\n -> newline etc.); \uXXXX passes
+    # through verbatim (documented divergence)
+    after_esc = jnp.concatenate([jnp.zeros(1, jnp.bool_),
+                                 (sel_str & esc_bs)[:-1]])
+    sub = data
+    for src_ch, dst_ch in ((ord("n"), 10), (ord("t"), 9), (ord("r"), 13),
+                           (ord("b"), 8), (ord("f"), 12)):
+        sub = jnp.where(after_esc & (data == src_ch), jnp.uint8(dst_ch),
+                        sub)
+    lens = jax.ops.segment_sum(keep.astype(jnp.int32), rows,
+                               num_segments=cap, indices_are_sorted=True)
+    out_off = make_offsets(lens)
+    kcs = jnp.cumsum(keep.astype(jnp.int32))
+    rank_excl = kcs - keep.astype(jnp.int32)  # keeps strictly before i
+    rs_c = jnp.clip(row_start, 0, nbytes - 1)
+    row_base_rank = kcs[rs_c] - keep[rs_c].astype(jnp.int32)
+    dst = rank_excl - row_base_rank + out_off[rows]
+    out = jnp.zeros(nbytes, jnp.uint8)
+    out = out.at[jnp.where(keep, jnp.clip(dst, 0, nbytes - 1),
+                           nbytes)].set(sub, mode="drop")
+    return StringVal(out, out_off, sv.validity & ok)

@@ -461,10 +461,12 @@ class MeshExecutor:
     def _lower_exchange(self, node, merge_fn=None,
                         merge_template=None) -> _Lowered:
         from spark_rapids_tpu.shuffle.partition import (HashPartitioner,
-                                                        RoundRobinPartitioner)
+                                                        RoundRobinPartitioner,
+                                                        SinglePartitioner)
 
         part = node.partitioner
-        if not isinstance(part, (HashPartitioner, RoundRobinPartitioner)):
+        if not isinstance(part, (HashPartitioner, RoundRobinPartitioner,
+                                 SinglePartitioner)):
             raise NotLowerable(
                 f"{type(part).__name__} exchange is a host stage boundary")
         child = self._lower_child(node.children[0])
@@ -532,9 +534,21 @@ class MeshExecutor:
             merge_template=lambda t: node._merge_pass(t))
         self.dist_nodes.append("ShuffleExchangeExec")
         template = node._final_project(merged.template)
+        from spark_rapids_tpu.shuffle.partition import SinglePartitioner
+
+        global_single = (node._n_keys == 0
+                         and isinstance(ex.partitioner, SinglePartitioner))
+        axis = self.axis
 
         def fn(ctx):
-            return node._final_project(merged.fn(ctx))
+            out = node._final_project(merged.fn(ctx))
+            if global_single:
+                # a 0-key aggregate emits exactly ONE row even over empty
+                # input; only device 0 (the single partition) may emit it
+                is_root = jax.lax.axis_index(axis) == 0
+                out = ColumnarBatch(out.columns,
+                                    jnp.where(is_root, out.num_rows, 0))
+            return out
 
         return _Lowered(fn, template, merged.cap)
 

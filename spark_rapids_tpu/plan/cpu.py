@@ -777,6 +777,27 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
         return out, ma & mb
     if isinstance(expr, E.GetJsonObject):
         import json as _json
+
+        class _Raw(str):
+            """number literal kept as raw text (the device kernel and the
+            reference's JSONUtils copy raw bytes, no re-serialization)"""
+
+        def _ser(v):
+            if isinstance(v, _Raw):
+                return str(v)
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if v is None:
+                return "null"
+            if isinstance(v, str):
+                return _json.dumps(v)
+            if isinstance(v, list):
+                return "[" + ",".join(_ser(x) for x in v) + "]"
+            if isinstance(v, dict):
+                return "{" + ",".join(
+                    f"{_json.dumps(k)}:{_ser(x)}" for k, x in v.items()) + "}"
+            return _json.dumps(v)
+
         s_, m = ev(expr.child)
         out, mm = [], m.copy()
 
@@ -821,19 +842,22 @@ def cpu_eval(expr: E.Expression, table: pa.Table,
 
         for i, x in enumerate(s_):
             try:
-                obj = _json.loads(x)
+                obj = _json.loads(x, parse_float=_Raw, parse_int=_Raw,
+                                  parse_constant=_Raw)
                 v, ok = walk(obj, expr.path)
             except (ValueError, TypeError):
                 ok = False
             if not ok or v is None:
                 out.append("")
                 mm[i] = False
+            elif isinstance(v, _Raw):
+                out.append(str(v))
             elif isinstance(v, str):
                 out.append(v)
             elif isinstance(v, bool):
                 out.append("true" if v else "false")
             else:
-                out.append(_json.dumps(v, separators=(",", ":")))
+                out.append(_ser(v))
         return np.array(out, dtype=object), mm
     if isinstance(expr, E.JsonToStructsText):
         import json as _json
@@ -1298,6 +1322,9 @@ def _cpu_cast_from_string(d, m, dst: T.DataType):
             continue
         s = str(d[i])
         t = s.strip("".join(chr(c) for c in range(0x21)))
+        if len(t) > 64:  # PARSE_WINDOW bound, shared with the device kernel
+            out.append(invalid(i))
+            continue
         if dst in T.INTEGRAL_TYPES:
             info = np.iinfo(T.numpy_dtype(dst))
             body = t[1:] if t[:1] in "+-" else t
@@ -1343,7 +1370,7 @@ def _cpu_cast_from_string(d, m, dst: T.DataType):
             try:
                 dpart, tpart = (tt.split(sep, 1) if sep else (tt, ""))
                 parts = dpart.split("-")
-                if not 1 <= len(parts) <= 3:
+                if not 1 <= len(parts) <= 3 or len(parts[0]) > 5:
                     raise ValueError
                 if any(p.strip() != p or not p or p[:1] in "+-"
                        for p in parts):
@@ -1385,7 +1412,7 @@ def _cpu_cast_from_string(d, m, dst: T.DataType):
                 continue
             import re as _re
             if not _re.fullmatch(
-                    r"(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?", body):
+                    r"(\d+(\.\d*)?|\.\d+)([eE][+-]?\d{1,15})?", body):
                 out.append(invalid(i))
                 continue
             try:

@@ -61,6 +61,7 @@ _DEVICE_EXPRS = (
     E.Murmur3Hash, E.XxHash64,
     E.Greatest, E.Least, E.NullIf, E.Nvl2,
     E.GetStructField, E.CreateNamedStruct, E.MapKeys, E.Size,
+    E.GetJsonObject,
     E.ElementAt, E.ArrayContains,
     E.BitwiseAnd, E.BitwiseOr, E.BitwiseXor, E.BitwiseNot,
     E.ShiftLeft, E.ShiftRight, E.ShiftRightUnsigned,
@@ -271,6 +272,12 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
             if isinstance(bound, (E.FromUTCTimestamp, E.ToUTCTimestamp)):
                 if not C.TZ_DB_ENABLED.get(C.get_active()):
                     reasons.append("timezone db disabled")
+            if isinstance(bound, E.GetJsonObject):
+                from spark_rapids_tpu.exprs import json_device as JD
+
+                if JD.parse_path(bound.path) is None:
+                    reasons.append(
+                        f"json path {bound.path!r} not on device")
             # probe regex compilability (reference: RegexParser transpiler
             # bail-outs -> willNotWorkOnGpu); patterns outside the DFA
             # subset fall back to CPU

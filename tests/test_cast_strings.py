@@ -169,3 +169,14 @@ def test_round_trip_through_device():
     t = pa.table({"s": pa.array(["42", "-7", "0", None])})
     rows = both(t, E.Cast(E.Cast(col("s"), T.LONG), T.STRING).alias("r"))
     assert [r["r"] for r in rows] == ["42", "-7", "0", None]
+
+
+def test_long_literals_engine_limit():
+    # trimmed content > 64 bytes -> NULL on BOTH engines (documented limit);
+    # <= 64 with heavy padding parses
+    pad42 = "0" * 32 + "42"                    # 34 bytes: valid
+    huge = "0" * 70 + "7"                      # 71 bytes: both NULL
+    spaces = " " * 100 + "5" + " " * 100       # whitespace never counts
+    t = pa.table({"s": pa.array([pad42, huge, spaces])})
+    rows = both(t, E.Cast(col("s"), T.LONG).alias("l"))
+    assert [r["l"] for r in rows] == [42, None, 5]
