@@ -241,24 +241,20 @@ class HashAggregateExec(UnaryExec):
             for e in self._group_bound)
         self._prepared = True
 
-        jit = jax.jit
+        from spark_rapids_tpu.exec.jit_cache import shared_jit
 
-        @jit
-        def first_pass(batch):
-            return self._first_pass(batch)
+        # the key must capture EVERYTHING the traced closures depend on:
+        # exprs, mode, input schema, and the fused pre-filter
+        base_key = ("agg", repr(self.group_exprs), repr(self.agg_exprs),
+                    self.mode, repr(self.child.output_schema),
+                    repr(self.pre_filter))
+        self._first_pass_fn = shared_jit(
+            base_key + ("first",), lambda: self._first_pass)
+        self._merge_pass_fn = shared_jit(
+            base_key + ("merge",), lambda: self._merge_pass)
 
-        @jit
-        def merge_pass(batch):
-            return self._merge_pass(batch)
-
-        self._first_pass_fn = first_pass
-        self._merge_pass_fn = merge_pass
-
-        @jit
-        def final_project(batch):
-            return self._final_project(batch)
-
-        self._final_project_fn = final_project
+        self._final_project_fn = shared_jit(
+            base_key + ("final",), lambda: self._final_project)
 
     # -- schemas -----------------------------------------------------------
     def _buffer_schema(self) -> T.Schema:

@@ -1,0 +1,34 @@
+"""Process-wide jit sharing across operator instances.
+
+Operators bind per-instance ``@jax.jit`` closures; two instances of the
+same operator with an IDENTICAL bound program (common: the TPC-DS tracker
+re-plans every query, CTE reuse, both engines of a differential test)
+would each re-trace and re-load the compiled executable from the
+persistent cache — measured ~0.3–1s per kernel through this platform's
+disk cache, dominating small-scale queries (docs/perf_notes_r05.md).
+
+``shared_jit(key, make)`` returns ONE jit per semantic key per process:
+the key must capture everything that changes the traced program (bound
+expression reprs include column ordinals and dtypes, so
+(op, repr(bound), ansi) is sufficient for projection-like operators).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+import jax
+
+_CACHE: Dict[tuple, Callable] = {}
+_LOCK = threading.Lock()
+
+
+def shared_jit(key: tuple, make: Callable[[], Callable]) -> Callable:
+    fn = _CACHE.get(key)
+    if fn is None:
+        with _LOCK:
+            fn = _CACHE.get(key)
+            if fn is None:
+                fn = _CACHE[key] = jax.jit(make())
+    return fn

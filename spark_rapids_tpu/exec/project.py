@@ -36,7 +36,14 @@ class ProjectExec(UnaryExec):
                 EV.bind_projection(self.exprs, self.child.output_schema)
             )
             self._schema = EV.output_schema(self._bound)
-            self._run = EV.compile_bound_projection(self._bound, self._ansi)
+            from spark_rapids_tpu.exec.jit_cache import shared_jit
+
+            bound = self._bound
+            ansi = self._ansi
+            self._run = shared_jit(
+                ("project", tuple(map(repr, bound)), ansi,
+                 repr(self.child.output_schema)),
+                lambda: (lambda batch: EV.project_batch(batch, bound, ansi)))
         return self._bound
 
     @property
@@ -68,16 +75,23 @@ class FilterExec(UnaryExec):
     def _bind(self):
         if self._bound is None:
             self._bound = E.resolve(self.condition, self.child.output_schema)
+            from spark_rapids_tpu.exec.jit_cache import shared_jit
 
-            @jax.jit
-            def run(batch):
-                ctx = EV.EvalContext(batch, self._ansi)
-                pred = EV.eval_expr(self._bound, ctx)
-                keep = pred.data & pred.validity
-                idx, n = K.filter_indices(keep, batch.active_mask())
-                return K.gather_batch(batch, idx, n)
+            bound = self._bound
+            ansi = self._ansi
 
-            self._run = run
+            def make():
+                def run(batch):
+                    ctx = EV.EvalContext(batch, ansi)
+                    pred = EV.eval_expr(bound, ctx)
+                    keep = pred.data & pred.validity
+                    idx, n = K.filter_indices(keep, batch.active_mask())
+                    return K.gather_batch(batch, idx, n)
+                return run
+
+            self._run = shared_jit(
+                ("filter", repr(bound), ansi,
+                 repr(self.child.output_schema)), make)
         return self._bound
 
     def node_description(self) -> str:
