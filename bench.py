@@ -54,8 +54,8 @@ SF_H = float(os.environ.get("BENCH_SF_H", 2.0))    # TPC-H: 12M lineitem rows
 SF_DS = float(os.environ.get("BENCH_SF_DS", 1.0))  # TPC-DS: 2.88M store_sales
 COPIES_H = 3     # pre-staged permuted input copies (TPC-H)
 COPIES_DS = 2
-RUNS = int(os.environ.get("BENCH_RUNS", 5))
-DEPTH = int(os.environ.get("BENCH_DEPTH", 4))  # pipelined iters per timed run
+RUNS = int(os.environ.get("BENCH_RUNS", 3))
+DEPTH = int(os.environ.get("BENCH_DEPTH", 3))  # pipelined iters per timed run
 TPCDS_QUERIES = ["q3", "q7", "q42", "q52", "q96"]
 
 
