@@ -351,6 +351,42 @@ def main():
     _mark("roofline")
     roofline = _measure_roofline()
 
+    # ---- per-query profile artifacts (docs/observability.md) ------------
+    # Untimed pass on freshly planned copies so per-node metrics reflect
+    # exactly one execution (the timed plans have accumulated RUNS*DEPTH
+    # iterations); traceCapture gives each dump a Perfetto-loadable trace.
+    _mark("profile dumps")
+    from spark_rapids_tpu.obs import profile_for
+
+    prof_conf = RapidsConf({"spark.rapids.tpu.profile.traceCapture": True})
+    prof_dir = os.environ.get("BENCH_PROFILE_DIR", ".")
+    os.makedirs(prof_dir, exist_ok=True)
+    profile_files, trace_files = [], []
+    specs = ([("tpch", qn, base_h, tpch.DF_QUERIES, 1 << 24)
+              for qn in h_names]
+             + [("tpcds", qn, base_ds, DSQ.QUERIES, 1 << 22)
+                for qn in TPCDS_QUERIES])
+    for suite, qn, tabs, builders, batch_rows in specs:
+        node = build_plans(tabs, prof_conf, builders, [qn], batch_rows)[qn]
+        prof = profile_for(node)
+        fence([run_plan(node)[1]])
+        if prof is None:
+            continue
+        prof.finish(node)
+        ppath = os.path.join(prof_dir, f"profile_{suite}_{qn}.json")
+        with open(ppath, "w") as f:
+            json.dump({**prof.to_dict(),
+                       "explain_analyze": prof.explain_analyze()},
+                      f, indent=1, default=str)
+        profile_files.append(ppath)
+        trace_files.append(prof.dump_chrome_trace(
+            os.path.join(prof_dir, f"trace_{suite}_{qn}.json")))
+    from spark_rapids_tpu.obs import write_textfile
+    prom_path = write_textfile(os.path.join(prof_dir, "metrics_bench.prom"))
+    from tools.trace_viewer_check import check_file
+    bad_traces = {p: errs for p in trace_files if (errs := check_file(p))}
+    assert not bad_traces, f"invalid chrome traces: {bad_traces}"
+
     def q_bytes(table, cols):
         return sum(table.column(c).nbytes for c in cols)
 
@@ -395,6 +431,9 @@ def main():
         "tpch_bytes_per_iter_GB": round(bytes_h / 1e9, 3),
         "queries": {"tpch": h_names, "tpcds": TPCDS_QUERIES,
                     "sf": {"tpch": SF_H, "tpcds": SF_DS}},
+        "profiles": profile_files,
+        "traces": trace_files,
+        "prometheus": prom_path,
     }))
     print(json.dumps({
         "metric": "tpch4_sf2_plus_tpcds5_sf1_rows_per_sec",

@@ -154,7 +154,8 @@ PARQUET_READER_THREADS = conf(
 METRICS_LEVEL = conf(
     "spark.rapids.tpu.sql.metrics.level", default="MODERATE",
     doc="Operator metrics verbosity: ESSENTIAL, MODERATE, DEBUG "
-        "(reference: GpuExec.scala:41 metrics levels).")
+        "(reference: GpuExec.scala:41 metrics levels). Metrics above the "
+        "level are not collected (docs/observability.md metric catalog).")
 
 METRICS_SYNC = conf(
     "spark.rapids.tpu.sql.metrics.sync", default=False,
@@ -163,7 +164,21 @@ METRICS_SYNC = conf(
         "tiny device->host readback per batch per operator; enable for "
         "profiling, not throughput runs. (The real-TPU platform's "
         "block_until_ready returns at dispatch; only a dependent host "
-        "readback drains compute — utils/sync.py.)")
+        "readback drains compute — utils/sync.py.) See "
+        "docs/observability.md.")
+
+PROFILE_ENABLED = conf(
+    "spark.rapids.tpu.profile.enabled", default=True,
+    doc="Install a QueryProfile per planned query: operator metrics, task "
+        "metrics, and memory/shuffle/filecache gauge deltas aggregated into "
+        "one breakdown readable via DataFrame.explain_analyze() / "
+        "QueryProfile.to_dict() (docs/observability.md).")
+
+PROFILE_TRACE = conf(
+    "spark.rapids.tpu.profile.traceCapture", default=False,
+    doc="Also capture in-process trace events for the query window so "
+        "QueryProfile.chrome_trace() carries real per-operator batch spans "
+        "(small per-batch overhead; docs/observability.md).")
 
 ANSI_ENABLED = conf(
     "spark.rapids.tpu.sql.ansi.enabled", default=False,

@@ -21,6 +21,31 @@ ESSENTIAL = 0
 MODERATE = 1
 DEBUG = 2
 
+_LEVEL_NAMES = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE, "DEBUG": DEBUG}
+
+# Active metrics verbosity (spark.rapids.tpu.sql.metrics.level, applied by
+# plan/overrides.py at plan time, GpuExec.scala:41 analog). Metrics declared
+# ABOVE this level are registered as disabled placeholders: operator code
+# can still add into them without existence checks, but collect_metrics /
+# QueryProfile skip them and timers around them short-circuit.
+_METRICS_LEVEL = MODERATE
+
+
+def set_metrics_level(level) -> None:
+    global _METRICS_LEVEL
+    if isinstance(level, str):
+        name = level.strip().upper()
+        if name not in _LEVEL_NAMES:
+            raise ValueError(
+                f"unknown metrics level {level!r}: expected one of "
+                f"{sorted(_LEVEL_NAMES)}")
+        level = _LEVEL_NAMES[name]
+    _METRICS_LEVEL = int(level)
+
+
+def get_metrics_level() -> int:
+    return _METRICS_LEVEL
+
 # When True, every operator fences (forces execution + 1-element readback of)
 # each batch it produces before yielding, so opTime metrics measure real
 # execution rather than async dispatch. Because a child operator fences its
@@ -39,12 +64,14 @@ def set_sync_metrics(enabled: bool) -> None:
 class Metric:
     """Accumulating metric, summed across partitions (GpuMetric analog)."""
 
-    __slots__ = ("name", "level", "value")
+    __slots__ = ("name", "level", "value", "enabled")
 
-    def __init__(self, name: str, level: int = MODERATE):
+    def __init__(self, name: str, level: int = MODERATE,
+                 enabled: bool = True):
         self.name = name
         self.level = level
         self.value = 0
+        self.enabled = enabled
 
     def add(self, v) -> None:
         self.value += v
@@ -60,11 +87,14 @@ class MetricsTimer:
         self.metric = metric
 
     def __enter__(self):
-        self._t0 = time.perf_counter_ns()
+        if self.metric is not None and self.metric.enabled:
+            self._t0 = time.perf_counter_ns()
+        else:
+            self._t0 = None
         return self
 
     def __exit__(self, *exc):
-        if self.metric is not None:
+        if self._t0 is not None:
             self.metric.add(time.perf_counter_ns() - self._t0)
         return False
 
@@ -105,8 +135,10 @@ class TpuExec:
 
     # -- execution ---------------------------------------------------------
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.utils import tracing
         it = self.do_execute(partition)
         op_time = self.metrics["opTime"]
+        name = type(self).__name__
         while True:
             t0 = time.perf_counter_ns()
             try:
@@ -124,7 +156,13 @@ class TpuExec:
                     from spark_rapids_tpu.columnar.batch import shrink_to_live
                     batch = shrink_to_live(
                         batch, _C.SHRINK_TO_LIVE_MIN_CAPACITY.get(cfg))
-            op_time.add(time.perf_counter_ns() - t0)
+            t1 = time.perf_counter_ns()
+            op_time.add(t1 - t0)
+            # per-batch operator span for the Chrome trace exporter; only
+            # recorded while a capture window (Profiler / QueryProfile with
+            # trace capture) is open, so the steady state pays one flag read
+            tracing.record_event(name, t0, t1 - t0,
+                                 args={"partition": partition})
             self.metrics["numOutputBatches"].add(1)
             self._pending_rows.append(batch.num_rows)
             if len(self._pending_rows) >= 64:
@@ -146,7 +184,7 @@ class TpuExec:
 
     # -- metrics / explain -------------------------------------------------
     def _register_metric(self, name: str, level: int = MODERATE) -> Metric:
-        m = Metric(name, level)
+        m = Metric(name, level, enabled=level <= _METRICS_LEVEL)
         self.metrics[name] = m
         return m
 
@@ -163,18 +201,23 @@ class TpuExec:
             lines.append(c.explain(indent + 1))
         return "\n".join(lines)
 
+    def metrics_snapshot(self) -> Dict[str, int]:
+        """This node's enabled metric values (pending device row scalars
+        folded in first)."""
+        if self._pending_rows:
+            self.metrics["numOutputRows"].add(
+                sum(int(n) for n in self._pending_rows)
+            )
+            self._pending_rows.clear()
+        return {m.name: m.value for m in self.metrics.values() if m.enabled}
+
     def collect_metrics(self) -> Dict[str, int]:
         out = {}
 
         def walk(node: "TpuExec"):
             name = type(node).__name__
-            if node._pending_rows:
-                node.metrics["numOutputRows"].add(
-                    sum(int(n) for n in node._pending_rows)
-                )
-                node._pending_rows.clear()
-            for m in node.metrics.values():
-                out[f"{name}.{m.name}"] = out.get(f"{name}.{m.name}", 0) + m.value
+            for k, v in node.metrics_snapshot().items():
+                out[f"{name}.{k}"] = out.get(f"{name}.{k}", 0) + v
             for c in node.children:
                 walk(c)
 

@@ -13,7 +13,17 @@ import collections
 import hashlib
 import os
 import threading
-from typing import Optional
+import weakref
+from typing import List, Optional
+
+# Live caches, for process-level metrics exposition (obs/): the reference
+# surfaces filecache hit/miss through GpuMetric (GpuMetric:84-95); here the
+# obs layer aggregates over every live instance.
+_instances: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def instances() -> "List[FileCache]":
+    return list(_instances)
 
 
 class FileCache:
@@ -34,6 +44,7 @@ class FileCache:
         self.misses = 0
         self.hit_bytes = 0
         self.miss_bytes = 0
+        _instances.add(self)
 
     @staticmethod
     def _key(path: str, offset: int, length: int) -> str:

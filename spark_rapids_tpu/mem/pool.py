@@ -114,6 +114,8 @@ class HbmPool:
                 self.max_used = max(self.max_used, self._used)
                 return
             self.oom_count += 1
+            from spark_rapids_tpu.utils import task_metrics as TM
+            TM.add("oom_count", 1)
             raise RetryOOM(
                 f"HBM pool exhausted: need {nbytes}, used {self._used}, "
                 f"limit {self.limit}, spill freed {freed}")

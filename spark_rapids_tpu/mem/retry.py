@@ -76,6 +76,8 @@ def with_retry(
                     yield result
                     break
                 except SplitAndRetryOOM:
+                    from spark_rapids_tpu.utils import task_metrics as TM
+                    TM.add("split_and_retry_count", 1)
                     if isinstance(item, SpillableBatch):
                         with item as batch:
                             pieces = split_fn(batch)
@@ -89,6 +91,8 @@ def with_retry(
                     item = work.pop(0)
                     attempts = 0
                 except RetryOOM:
+                    from spark_rapids_tpu.utils import task_metrics as TM
+                    TM.add("retry_count", 1)
                     if attempts >= max_attempts:
                         raise
                     # the pool already spilled what it could; loop retries

@@ -10,7 +10,17 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+import weakref
+from typing import Dict, List, Optional
+
+# Live semaphores, for process-level metrics exposition (obs/): the
+# reference reports semaphore wait through GpuTaskMetrics; the obs layer
+# also aggregates totals over every live instance.
+_instances: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def instances() -> "List[TaskSemaphore]":
+    return list(_instances)
 
 
 class TaskSemaphore:
@@ -23,10 +33,14 @@ class TaskSemaphore:
         self._holders: Dict[int, int] = {}  # task_id -> acquire count
         self.total_wait_ns = 0
         self.max_waiters = 0
+        self.acquire_count = 0
+        _instances.add(self)
 
     def acquire(self, task_id: int) -> None:
+        from spark_rapids_tpu.utils import task_metrics as TM
         t0 = time.perf_counter_ns()
         with self._cv:
+            self.acquire_count += 1
             if task_id in self._holders:  # reentrant per task
                 self._holders[task_id] += 1
                 return
@@ -36,7 +50,9 @@ class TaskSemaphore:
                 self._cv.wait()
             del self._waiters[task_id]
             self._holders[task_id] = 1
-            self.total_wait_ns += time.perf_counter_ns() - t0
+            waited = time.perf_counter_ns() - t0
+            self.total_wait_ns += waited
+        TM.add("semaphore_wait_ns", waited)
 
     def _may_enter(self, task_id: int) -> bool:
         if len(self._holders) >= self._permits:

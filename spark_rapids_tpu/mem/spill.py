@@ -187,6 +187,8 @@ class SpillFramework:
             h._state = HOST
         self.pool.release(h.nbytes)
         self.spilled_to_host_count += 1
+        from spark_rapids_tpu.utils import task_metrics as TM
+        TM.add("spill_to_host_bytes", h.nbytes)
         with self._lock:
             self.host_used += h.nbytes
             over = self.host_used - self.host_limit
@@ -235,6 +237,8 @@ class SpillFramework:
             h._disk_path = path
             h._state = DISK
         self.spilled_to_disk_count += 1
+        from spark_rapids_tpu.utils import task_metrics as TM
+        TM.add("spill_to_disk_bytes", h.nbytes)
         with self._lock:
             self.host_used -= h.nbytes
         return h.nbytes
@@ -275,6 +279,8 @@ class SpillFramework:
             with self._lock:
                 self.host_used -= h.nbytes
             self.unspilled_count += 1
+            from spark_rapids_tpu.utils import task_metrics as TM
+            TM.add("read_spill_bytes", h.nbytes)
 
     def _disk_to_host_locked(self, h: SpillableBatch) -> None:
         with np.load(h._disk_path) as z:

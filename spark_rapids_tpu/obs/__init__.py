@@ -1,0 +1,31 @@
+"""Unified observability layer (SURVEY.md §5 as one subsystem).
+
+The reference treats observability as first-class: leveled GpuMetrics on
+every operator, GpuTaskMetrics per task, NVTX ranges feeding nsys, a
+driver-coordinated profiler, and "explain with metrics" in the UI. This
+package is the standalone unification of the repo's fragments:
+
+- ``profile``       QueryProfile registry: per-query snapshot/aggregate of
+                    operator metrics, task metrics, memory/shuffle/filecache
+                    gauges, and trace events; ``explain_analyze`` rendering
+- ``trace_export``  Chrome trace_event JSON for chrome://tracing / Perfetto
+- ``expose``        Prometheus text exposition of process gauges
+- ``gauges``        the gauge catalog both of the above read
+
+See docs/observability.md for the metric catalog and workflows.
+"""
+
+from spark_rapids_tpu.obs.gauges import snapshot as gauge_snapshot  # noqa: F401
+from spark_rapids_tpu.obs.profile import (  # noqa: F401
+    QueryProfile,
+    collect_node_stats,
+    get_profile,
+    last_profile,
+    profile_for,
+    recent_profiles,
+)
+from spark_rapids_tpu.obs.trace_export import to_chrome_trace  # noqa: F401
+from spark_rapids_tpu.obs.expose import (  # noqa: F401
+    render_prometheus,
+    write_textfile,
+)

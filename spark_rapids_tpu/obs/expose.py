@@ -1,0 +1,35 @@
+"""Prometheus-style text exposition of process-level gauges.
+
+Renders the gauge catalog (obs/gauges.py) in the Prometheus text exposition
+format (version 0.0.4): ``# HELP`` / ``# TYPE`` pairs followed by one
+sample line per metric, all under the ``srtpu_`` namespace. Serve the
+string from any HTTP endpoint (or write it for the node_exporter textfile
+collector) to scrape pool, spill, semaphore, shuffle, and filecache state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from spark_rapids_tpu.obs import gauges as G
+
+NAMESPACE = "srtpu"
+
+
+def render_prometheus(snapshot: Optional[Dict[str, int]] = None) -> str:
+    """The current (or given) gauge snapshot as exposition text."""
+    snap = snapshot if snapshot is not None else G.snapshot()
+    lines = []
+    for name, kind, help_text in G.CATALOG:
+        full = f"{NAMESPACE}_{name}"
+        lines.append(f"# HELP {full} {help_text}")
+        lines.append(f"# TYPE {full} {kind}")
+        lines.append(f"{full} {snap.get(name, 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def write_textfile(path: str) -> str:
+    """Write the exposition for the node_exporter textfile collector."""
+    with open(path, "w") as f:
+        f.write(render_prometheus())
+    return path

@@ -1,0 +1,102 @@
+"""Process-level gauge catalog: one place that knows how to read every
+counter the runtime maintains.
+
+Sources (the fragments the obs layer unifies):
+- ``mem/pool.py``   HbmPool accounting (used/peak/allocs/OOMs/spill requests)
+- ``mem/spill.py``  SpillFramework tiers (host bytes, spill/unspill counts)
+- ``mem/semaphore.py`` TaskSemaphore wait totals
+- ``shuffle/manager.py`` ShuffleManager bytes/blocks written
+- ``io/filecache.py``   FileCache hit/miss counters
+
+Instances are discovered through the same registries the leak sweeper uses
+(mem/cleaner.py weaksets) plus the filecache/semaphore instance sets, and
+summed across instances — the process view a scraper wants. ``snapshot()``
+is also the QueryProfile's start/end capture, diffed per query.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+# name -> (kind, help); kind is "counter" (monotonic) or "gauge" (level).
+# Counters diff meaningfully across a query window; gauges are reported as
+# start/end levels.
+CATALOG: "List[Tuple[str, str, str]]" = [
+    ("pool_limit_bytes", "gauge", "HBM accounting pool budget"),
+    ("pool_used_bytes", "gauge", "Accounted live device bytes"),
+    ("pool_max_used_bytes", "gauge", "High-water mark of accounted bytes"),
+    ("pool_alloc_total", "counter", "Pool allocation calls"),
+    ("pool_oom_total", "counter", "Retryable OOMs thrown by the pool"),
+    ("pool_spill_request_total", "counter",
+     "Times the pool asked the spill framework for bytes"),
+    ("spill_host_used_bytes", "gauge", "Host-tier bytes holding spilled batches"),
+    ("spill_to_host_total", "counter", "Device->host spill events"),
+    ("spill_to_disk_total", "counter", "Host->disk spill events"),
+    ("spill_unspill_total", "counter", "Rematerializations of spilled batches"),
+    ("semaphore_wait_ns_total", "counter",
+     "Nanoseconds tasks waited to enter the device"),
+    ("semaphore_acquire_total", "counter", "Semaphore acquire calls"),
+    ("semaphore_max_waiters", "gauge", "Peak simultaneous semaphore waiters"),
+    ("shuffle_bytes_written_total", "counter", "Serialized shuffle bytes written"),
+    ("shuffle_blocks_written_total", "counter", "Shuffle blocks written"),
+    ("filecache_hit_total", "counter", "Filecache range hits"),
+    ("filecache_miss_total", "counter", "Filecache range misses"),
+    ("filecache_hit_bytes_total", "counter", "Bytes served from the filecache"),
+    ("filecache_miss_bytes_total", "counter",
+     "Bytes read through on filecache misses"),
+    ("filecache_cached_bytes", "gauge", "Bytes currently held by filecaches"),
+]
+
+
+def snapshot() -> Dict[str, int]:
+    """Current value of every catalog gauge, summed over live instances
+    (max for high-water marks)."""
+    from spark_rapids_tpu.io import filecache as _fc
+    from spark_rapids_tpu.mem import cleaner as _cleaner
+    from spark_rapids_tpu.mem import semaphore as _sem
+
+    out = {name: 0 for name, _, _ in CATALOG}
+    with _cleaner._lock:
+        pools = list(_cleaner._pools)
+        fws = list(_cleaner._frameworks)
+        managers = list(_cleaner._managers)
+    for p in pools:
+        out["pool_limit_bytes"] += p.limit
+        out["pool_used_bytes"] += p.used
+        out["pool_max_used_bytes"] = max(out["pool_max_used_bytes"],
+                                         p.max_used)
+        out["pool_alloc_total"] += p.alloc_count
+        out["pool_oom_total"] += p.oom_count
+        out["pool_spill_request_total"] += p.spill_request_count
+    for fw in fws:
+        out["spill_host_used_bytes"] += fw.host_used
+        out["spill_to_host_total"] += fw.spilled_to_host_count
+        out["spill_to_disk_total"] += fw.spilled_to_disk_count
+        out["spill_unspill_total"] += fw.unspilled_count
+    for sem in _sem.instances():
+        out["semaphore_wait_ns_total"] += sem.total_wait_ns
+        out["semaphore_acquire_total"] += sem.acquire_count
+        out["semaphore_max_waiters"] = max(out["semaphore_max_waiters"],
+                                           sem.max_waiters)
+    for m in managers:
+        out["shuffle_bytes_written_total"] += m.bytes_written
+        out["shuffle_blocks_written_total"] += m.blocks_written
+    for fc in _fc.instances():
+        out["filecache_hit_total"] += fc.hits
+        out["filecache_miss_total"] += fc.misses
+        out["filecache_hit_bytes_total"] += fc.hit_bytes
+        out["filecache_miss_bytes_total"] += fc.miss_bytes
+        out["filecache_cached_bytes"] += fc.cached_bytes
+    return out
+
+
+def diff(start: Dict[str, int], end: Dict[str, int]) -> Dict[str, Dict]:
+    """Per-query window view: counters as deltas, gauges as start/end."""
+    out: Dict[str, Dict] = {}
+    for name, kind, _ in CATALOG:
+        s, e = start.get(name, 0), end.get(name, 0)
+        if kind == "counter":
+            out[name] = {"delta": e - s}
+        else:
+            out[name] = {"start": s, "end": e}
+    return out

@@ -1,0 +1,209 @@
+"""Per-query profile: one structured view of a query's full cost.
+
+The reference spreads a query's observability across leveled GpuMetrics on
+every operator (GpuExec.scala:41-178), GpuTaskMetrics accumulators, NVTX
+timelines, and "explain with metrics" in the Spark UI. This module is the
+standalone unification: a ``QueryProfile`` is installed per planned query
+(plan/overrides.py), snapshots every process gauge at start, and at finish
+walks the executed operator tree to capture per-node metrics, gauge deltas,
+task-metric aggregates, and the trace-event window.
+
+Products:
+- ``to_dict()``      the structured breakdown (bench dumps one per query)
+- ``explain_analyze()``  plan tree with rows/batches/opTime inline (the
+  AdaptiveSparkPlan "explain with metrics" analog)
+- ``chrome_trace()``     Perfetto/chrome://tracing-loadable trace_event JSON
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.obs import gauges as G
+from spark_rapids_tpu.utils import task_metrics as TM
+from spark_rapids_tpu.utils import tracing
+
+# Registry of recent profiles (bounded; profiles hold only plain dicts, not
+# exec trees or device buffers, so retention is cheap).
+MAX_PROFILES = 64
+_lock = threading.Lock()
+_next_id = 1
+_profiles: "collections.OrderedDict[int, QueryProfile]" = \
+    collections.OrderedDict()
+
+
+def _ns_ms(ns: int) -> float:
+    return round(ns / 1e6, 3)
+
+
+class QueryProfile:
+    """Lifecycle: ``start()`` at plan time -> query executes -> ``finish(root)``
+    once output is consumed (plan/dataframe.py wires both ends)."""
+
+    def __init__(self, description: str = "", conf=None,
+                 capture_trace: bool = False):
+        global _next_id
+        with _lock:
+            self.query_id = _next_id
+            _next_id += 1
+            _profiles[self.query_id] = self
+            while len(_profiles) > MAX_PROFILES:
+                _profiles.popitem(last=False)
+        self.description = description
+        self.conf = conf
+        self.capture_trace = capture_trace
+        self.plan_explain = ""
+        self.started = False
+        self.finished = False
+        self.wall_ns = 0
+        self.nodes: List[Dict] = []
+        self.metrics: Dict[str, int] = {}
+        self.gauges: Dict[str, Dict] = {}
+        self.task_metrics: Dict[str, int] = {}
+        self.events: List[Dict] = []
+        self._t0 = 0
+        self._gauges0: Dict[str, int] = {}
+        self._tasks0: Dict[str, int] = {}
+        self._owned_capture = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "QueryProfile":
+        self._t0 = time.perf_counter_ns()
+        self._gauges0 = G.snapshot()
+        self._tasks0 = TM.aggregate_snapshot()
+        if self.capture_trace and not tracing.capturing():
+            # open our own event window; a user-managed Profiler window
+            # stays untouched (we'd otherwise clear their events)
+            tracing.set_capture(True, clear=True)
+            self._owned_capture = True
+        self.started = True
+        return self
+
+    def attach(self, root) -> "QueryProfile":
+        """Pin this profile on an exec tree root (read back by
+        ``profile_for`` / DataFrame.to_arrow)."""
+        root._query_profile = self
+        return self
+
+    def finish(self, root=None) -> "QueryProfile":
+        """Snapshot everything; idempotent (re-finish refreshes)."""
+        self.wall_ns = time.perf_counter_ns() - self._t0
+        end = G.snapshot()
+        self.gauges = G.diff(self._gauges0, end)
+        tasks1 = TM.aggregate_snapshot()
+        self.task_metrics = {
+            f: (max(0, tasks1[f] - self._tasks0.get(f, 0))
+                if not f.startswith("max_") else tasks1[f])
+            for f in tasks1
+        }
+        if self._owned_capture:
+            tracing.set_capture(False)
+            self._owned_capture = False
+        self.events = tracing.trace_events()
+        if root is not None:
+            self.nodes = collect_node_stats(root)
+            self.metrics = root.collect_metrics()
+        self.finished = True
+        return self
+
+    # -- products ----------------------------------------------------------
+    def to_dict(self) -> Dict:
+        return {
+            "query_id": self.query_id,
+            "description": self.description,
+            "wall_ms": _ns_ms(self.wall_ns),
+            "nodes": self.nodes,
+            "metrics": self.metrics,
+            "gauges": self.gauges,
+            "task_metrics": self.task_metrics,
+            "num_trace_events": len(self.events),
+            "plan_explain": self.plan_explain,
+        }
+
+    def dump(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, default=str)
+        return path
+
+    def explain_analyze(self) -> str:
+        """Plan tree with per-node metric rows inline."""
+        lines = [f"== Query Profile #{self.query_id} "
+                 f"(wall {_ns_ms(self.wall_ns)} ms) =="]
+        for node in self.nodes:
+            pad = "  " * node["depth"]
+            prefix = "+- " if node["depth"] else ""
+            m = node["metrics"]
+            cells = []
+            if "numOutputRows" in m:
+                cells.append(f"rows={m['numOutputRows']}")
+            if "numOutputBatches" in m:
+                cells.append(f"batches={m['numOutputBatches']}")
+            if "opTime" in m:
+                cells.append(f"opTime={_ns_ms(m['opTime'])}ms")
+            for k, v in sorted(m.items()):
+                if k in ("numOutputRows", "numOutputBatches", "opTime"):
+                    continue
+                cells.append(f"{k.removesuffix('Ns')}={_ns_ms(v)}ms"
+                             if k.endswith("Ns") else f"{k}={v}")
+            lines.append(f"{pad}{prefix}{node['description']}  "
+                         f"[{' '.join(cells)}]" if cells else
+                         f"{pad}{prefix}{node['description']}")
+        return "\n".join(lines)
+
+    def chrome_trace(self) -> Dict:
+        from spark_rapids_tpu.obs import trace_export
+        return trace_export.to_chrome_trace(
+            self.events, self.nodes,
+            process_name=f"spark_rapids_tpu query {self.query_id}")
+
+    def dump_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+def collect_node_stats(root) -> List[Dict]:
+    """Pre-order walk of an exec tree -> plain per-node dicts (node id,
+    depth, parent, description, enabled metric values)."""
+    out: List[Dict] = []
+
+    def walk(node, depth: int, parent: Optional[int]):
+        nid = len(out)
+        out.append({
+            "id": nid,
+            "parent": parent,
+            "depth": depth,
+            "name": type(node).__name__,
+            "description": node.node_description(),
+            "metrics": node.metrics_snapshot(),
+        })
+        for c in node.children:
+            walk(c, depth + 1, nid)
+
+    walk(root, 0, None)
+    return out
+
+
+def profile_for(root) -> Optional[QueryProfile]:
+    """The profile installed on an exec tree root (or None)."""
+    return getattr(root, "_query_profile", None)
+
+
+def get_profile(query_id: int) -> Optional[QueryProfile]:
+    with _lock:
+        return _profiles.get(query_id)
+
+
+def recent_profiles() -> List[QueryProfile]:
+    """Registry contents, oldest first."""
+    with _lock:
+        return list(_profiles.values())
+
+
+def last_profile() -> Optional[QueryProfile]:
+    with _lock:
+        return next(reversed(_profiles.values()), None)

@@ -1,0 +1,92 @@
+"""Chrome trace_event exporter.
+
+Converts the in-process event log (utils/tracing.py — TraceRange spans and
+per-operator batch spans from exec/base.py) plus QueryProfile per-node
+summaries into the Trace Event Format JSON that chrome://tracing and
+Perfetto load directly: the standalone analog of the reference's
+nsys-timeline story (NVTX ranges -> nsys), with the browser as the viewer.
+
+Format: {"traceEvents": [...], "displayTimeUnit": "ms"}; each span is a
+complete event {"ph": "X", "name", "pid", "tid", "ts", "dur"} with ts/dur
+in MICROseconds; "M" metadata events name processes/threads.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+PID = 1  # single-process engine: one pid, threads/operators as tids
+
+
+def _meta(name: str, tid: int, value: str) -> Dict:
+    return {"ph": "M", "name": name, "pid": PID, "tid": tid,
+            "args": {"name": value}}
+
+
+def events_to_chrome(events: Iterable[Dict],
+                     process_name: str = "spark_rapids_tpu") -> List[Dict]:
+    """Map in-process events ({name, start_ns, dur_ns, thread, args?}) to
+    complete events on per-thread tracks, rebased so the trace starts at
+    ts=0."""
+    evs = list(events)
+    out: List[Dict] = [_meta("process_name", 0, process_name)]
+    if not evs:
+        return out
+    base = min(e["start_ns"] for e in evs)
+    tids: Dict[int, int] = {}
+    for e in evs:
+        thread = e.get("thread", 0)
+        if thread not in tids:
+            tids[thread] = len(tids) + 1
+            out.append(_meta("thread_name", tids[thread],
+                             f"thread-{len(tids)}"))
+        rec = {
+            "ph": "X",
+            "name": str(e["name"]),
+            "cat": "trace",
+            "pid": PID,
+            "tid": tids[thread],
+            "ts": (e["start_ns"] - base) / 1e3,
+            "dur": e["dur_ns"] / 1e3,
+        }
+        if e.get("args"):
+            rec["args"] = dict(e["args"])
+        out.append(rec)
+    return out
+
+
+def node_spans_to_chrome(nodes: Iterable[Dict],
+                         first_tid: int = 1000) -> List[Dict]:
+    """Render QueryProfile per-node summaries as one bar per operator.
+
+    Nodes carry cumulative opTime, not start timestamps, so each operator
+    gets its own track starting at ts=0 with dur=opTime — a per-operator
+    cost gantt rather than a causal timeline (the causal view is the
+    event-log track, populated when trace capture was on)."""
+    out: List[Dict] = []
+    for i, node in enumerate(nodes):
+        tid = first_tid + i
+        op_ns = node.get("metrics", {}).get("opTime", 0)
+        out.append(_meta("thread_name", tid,
+                         f"op:{node.get('name', f'node{i}')}"))
+        out.append({
+            "ph": "X",
+            "name": node.get("description", node.get("name", f"node{i}")),
+            "cat": "operator",
+            "pid": PID,
+            "tid": tid,
+            "ts": 0.0,
+            "dur": op_ns / 1e3,
+            "args": {k: v for k, v in node.get("metrics", {}).items()},
+        })
+    return out
+
+
+def to_chrome_trace(events: Iterable[Dict],
+                    nodes: Optional[Iterable[Dict]] = None,
+                    process_name: str = "spark_rapids_tpu") -> Dict:
+    """Assemble a loadable trace object; serialize with ``json.dump``."""
+    trace_events = events_to_chrome(events, process_name)
+    if nodes is not None:
+        trace_events.extend(node_spans_to_chrome(nodes))
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

@@ -175,6 +175,15 @@ class DataFrame:
                     for b in node.execute(p):
                         tables.append(batch_to_arrow(b, schema))
         finally:
+            # close out the per-query profile (plan/overrides.py installed
+            # it at plan time) before shuffle state is released
+            from spark_rapids_tpu.obs import profile_for
+
+            prof = profile_for(node)
+            if prof is not None:
+                prof.finish(node)
+            self._last_profile = prof
+
             # release shuffle files/blocks now that output is materialized
             def walk(n):
                 if isinstance(n, ShuffleExchangeExec):
@@ -189,6 +198,21 @@ class DataFrame:
 
     def collect(self) -> List[dict]:
         return self.to_arrow().to_pylist()
+
+    def last_profile(self):
+        """The QueryProfile of the most recent execution of this DataFrame
+        (None when profiling is disabled or nothing ran yet)."""
+        return getattr(self, "_last_profile", None)
+
+    def explain_analyze(self) -> str:
+        """EXPLAIN ANALYZE: execute the query, then render the physical
+        plan with per-node rows/batches/opTime inline (the reference's
+        'explain with metrics' / AdaptiveSparkPlan final-plan view)."""
+        self.to_arrow()
+        prof = self.last_profile()
+        if prof is None:  # profiling disabled: fall back to the static plan
+            return self.explain()
+        return prof.explain_analyze()
 
 
 class GroupedDataFrame:

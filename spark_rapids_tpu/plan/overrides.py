@@ -592,6 +592,7 @@ class Overrides:
         # re-reads RapidsConf per plan (GpuOverrides.scala:4748)
         C.set_active(self.conf)
         _base.set_sync_metrics(self.conf[C.METRICS_SYNC])
+        _base.set_metrics_level(self.conf[C.METRICS_LEVEL])
         if C.SQL_ENABLED.get(self.conf):
             plan = self._rewrite_distinct(plan)
         self._apply_path_rules(plan)
@@ -604,6 +605,16 @@ class Overrides:
         mode = C.EXPLAIN.get(self.conf)
         if mode != "NONE":
             print(explain(meta, mode))
+        if self.conf[C.PROFILE_ENABLED]:
+            # per-query profile: gauge baseline now, node metrics at finish
+            # (DataFrame.to_arrow, or profile_for(root).finish(root) for
+            # direct executors like bench.py)
+            from spark_rapids_tpu.obs import QueryProfile
+
+            prof = QueryProfile(description=plan.describe(), conf=self.conf,
+                                capture_trace=self.conf[C.PROFILE_TRACE])
+            prof.plan_explain = explain(meta, "ALL")
+            prof.start().attach(ex)
         return ex
 
     def _convert(self, meta: PlanMeta) -> TpuExec:

@@ -9,6 +9,7 @@ call the hooks.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Dict, Optional
@@ -42,8 +43,15 @@ class TaskMetrics:
 
 
 _local = threading.local()
-_registry: Dict[int, TaskMetrics] = {}
+_registry: Dict[int, TaskMetrics] = {}  # ACTIVE tasks only
 _reg_lock = threading.Lock()
+# Finished tasks stay queryable (profiles/tests read them after the fact)
+# but in a bounded LRU so a long-lived process never grows without limit —
+# the reference unregisters task metrics at task end (GpuTaskMetrics
+# TaskCompletionListener); here recent history is the useful extra.
+FINISHED_CAPACITY = 1024
+_finished: "collections.OrderedDict[int, TaskMetrics]" = \
+    collections.OrderedDict()
 
 
 def current() -> Optional[TaskMetrics]:
@@ -55,18 +63,48 @@ def start_task(task_id: int) -> TaskMetrics:
     _local.metrics = m
     with _reg_lock:
         _registry[task_id] = m
+        _finished.pop(task_id, None)  # re-run of a finished attempt id
     return m
 
 
 def finish_task() -> Optional[TaskMetrics]:
     m = current()
     _local.metrics = None
+    if m is not None:
+        with _reg_lock:
+            _registry.pop(m.task_id, None)
+            _finished[m.task_id] = m
+            _finished.move_to_end(m.task_id)
+            while len(_finished) > FINISHED_CAPACITY:
+                _finished.popitem(last=False)
     return m
 
 
 def get_task(task_id: int) -> Optional[TaskMetrics]:
     with _reg_lock:
-        return _registry.get(task_id)
+        m = _registry.get(task_id)
+        return m if m is not None else _finished.get(task_id)
+
+
+def registry_sizes() -> Dict[str, int]:
+    """Introspection for tests/obs: {active, finished} entry counts."""
+    with _reg_lock:
+        return {"active": len(_registry), "finished": len(_finished)}
+
+
+def aggregate_snapshot() -> Dict[str, int]:
+    """Field-wise sum over all active + retained finished tasks (the
+    QueryProfile aggregation input; diffed across a query window)."""
+    out = {f: 0 for f in TaskMetrics.FIELDS}
+    with _reg_lock:
+        tasks = list(_registry.values()) + list(_finished.values())
+    for m in tasks:
+        for f in TaskMetrics.FIELDS:
+            if f.startswith("max_"):
+                out[f] = max(out[f], getattr(m, f))
+            else:
+                out[f] += getattr(m, f)
+    return out
 
 
 def add(field: str, v: int):

@@ -6,7 +6,8 @@ driver-coordinated async profiler (profiler.scala) writing traces to a
 directory. TPU-native mapping: jax.profiler — TraceAnnotation is the NVTX
 range analog (shows up on the XPlane/TensorBoard timeline), start_trace/
 stop_trace the capture window. A lightweight in-process event log rides
-along so tests and metrics can observe ranges without a trace viewer.
+along so tests and metrics can observe ranges without a trace viewer; the
+obs/ layer exports it as a Chrome trace_event file (obs/trace_export.py).
 """
 
 from __future__ import annotations
@@ -17,6 +18,10 @@ from typing import Dict, List, Optional
 
 import jax
 
+# One lock guards BOTH the event list and the capture flag: a range that
+# observes the flag appends under the same critical section, so a capture
+# window can never tear (flag off, event still appended) and back-to-back
+# windows cannot interleave stale events.
 _events_lock = threading.Lock()
 _events: List[Dict] = []
 _capture_events = False
@@ -29,6 +34,39 @@ def trace_events(clear: bool = False) -> List[Dict]:
         if clear:
             _events.clear()
         return out
+
+
+def capturing() -> bool:
+    with _events_lock:
+        return _capture_events
+
+
+def set_capture(enabled: bool, clear: bool = False) -> None:
+    """Turn the in-process event log on/off; ``clear`` drops any events left
+    over from a previous window so windows never mix."""
+    global _capture_events
+    with _events_lock:
+        if clear:
+            _events.clear()
+        _capture_events = bool(enabled)
+
+
+def record_event(name: str, start_ns: int, dur_ns: int,
+                 args: Optional[Dict] = None) -> None:
+    """Append one event if a capture window is open (span-shaped; the
+    Chrome exporter renders it as a 'ph: X' complete event)."""
+    with _events_lock:
+        if not _capture_events:
+            return
+        ev = {
+            "name": name,
+            "start_ns": start_ns,
+            "dur_ns": dur_ns,
+            "thread": threading.get_ident(),
+        }
+        if args:
+            ev["args"] = args
+        _events.append(ev)
 
 
 class TraceRange:
@@ -52,42 +90,36 @@ class TraceRange:
     def __exit__(self, *exc):
         if self._ann is not None:
             self._ann.__exit__(*exc)
-        if _capture_events:
-            with _events_lock:
-                _events.append({
-                    "name": self.name,
-                    "start_ns": self._t0,
-                    "dur_ns": time.perf_counter_ns() - self._t0,
-                    "thread": threading.get_ident(),
-                })
+        record_event(self.name, self._t0,
+                     time.perf_counter_ns() - self._t0)
         return False
 
 
 class Profiler:
     """Capture-window profiler (profiler.scala analog): start/stop writes a
     jax profiler trace (XPlane, TensorBoard-viewable) to ``out_dir`` and
-    turns on the in-process event log for the window."""
+    turns on the in-process event log for the window. Each window starts
+    from an EMPTY event log, so consecutive windows observe only their own
+    ranges."""
 
     def __init__(self, out_dir: str):
         self.out_dir = out_dir
         self._active = False
 
     def start(self):
-        global _capture_events
         if self._active:
             return
         try:
             jax.profiler.start_trace(self.out_dir)
         except Exception:
             pass  # tracing unavailable in some environments; events still on
-        _capture_events = True
+        set_capture(True, clear=True)
         self._active = True
 
     def stop(self):
-        global _capture_events
         if not self._active:
             return
-        _capture_events = False
+        set_capture(False)
         try:
             jax.profiler.stop_trace()
         except Exception:

@@ -1,0 +1,270 @@
+"""Observability layer tests: QueryProfile aggregation, explain_analyze
+rendering, Chrome trace_event export validity, Prometheus exposition,
+metrics-level filtering, task-metrics registry bounds, and trace-window
+hygiene (docs/observability.md).
+"""
+
+import json
+import pathlib
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exec import base as B
+from spark_rapids_tpu.exprs.expr import Count, Sum, col
+from spark_rapids_tpu.obs import (
+    QueryProfile,
+    collect_node_stats,
+    gauge_snapshot,
+    get_profile,
+    render_prometheus,
+    to_chrome_trace,
+)
+from spark_rapids_tpu.plan import from_arrow
+from spark_rapids_tpu.utils import task_metrics as TM
+from spark_rapids_tpu.utils import tracing
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(_ROOT) not in sys.path:
+    sys.path.insert(0, str(_ROOT))
+from tools.trace_viewer_check import validate_trace  # noqa: E402
+
+
+def sample_table(n=500, seed=3):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 4, n), pa.int64()),
+        "v": pa.array(rng.random(n) * 10, pa.float64()),
+    })
+
+
+def _run_profiled(conf=None):
+    df = (from_arrow(sample_table(), conf)
+          .filter(col("v") > 1.0)
+          .group_by("k")
+          .agg(Sum(col("v")).alias("sv"), Count().alias("n")))
+    rows = df.collect()
+    return df, rows
+
+
+# -- QueryProfile aggregation ---------------------------------------------
+
+def test_query_profile_aggregates_everything():
+    df, rows = _run_profiled()
+    prof = df.last_profile()
+    assert prof is not None and prof.finished
+    d = prof.to_dict()
+    assert d["wall_ms"] > 0
+    # the plan tree made it in: aggregate root over the source leaf
+    names = [n["name"] for n in d["nodes"]]
+    assert any("Aggregate" in n for n in names)
+    assert d["nodes"][0]["parent"] is None
+    # root row count matches what collect() returned
+    assert d["nodes"][0]["metrics"]["numOutputRows"] == len(rows)
+    # every layer is represented in the one structured dict
+    assert any(k.endswith(".opTime") for k in d["metrics"])
+    assert "pool_used_bytes" in d["gauges"]
+    assert "filecache_hit_total" in d["gauges"]
+    assert "retry_count" in d["task_metrics"]
+    assert d["plan_explain"]  # static explain captured at plan time
+    # registered and retrievable by id
+    assert get_profile(prof.query_id) is prof
+
+
+def test_profile_disabled_by_conf():
+    conf = RapidsConf({"spark.rapids.tpu.profile.enabled": False})
+    df, _ = _run_profiled(conf)
+    assert df.last_profile() is None
+    # explain_analyze degrades to the static plan instead of raising
+    assert "Aggregate" in df.explain_analyze()
+
+
+# -- explain_analyze -------------------------------------------------------
+
+def test_explain_analyze_renders_metrics_inline():
+    df, rows = _run_profiled()
+    text = df.last_profile().explain_analyze()
+    lines = text.splitlines()
+    assert lines[0].startswith("== Query Profile #")
+    assert f"rows={len(rows)}" in lines[1]  # root line carries its rows
+    assert "opTime=" in lines[1] and "batches=" in lines[1]
+    # children are indented under the root with the explain-style prefix
+    assert any(l.lstrip().startswith("+- ") for l in lines[2:])
+    # ns-suffixed metrics are rendered as milliseconds
+    assert "Ns=" not in text
+
+
+def test_dataframe_explain_analyze_executes():
+    df, _ = _run_profiled()
+    text = df.explain_analyze()
+    assert "rows=" in text and "opTime=" in text
+
+
+# -- Chrome trace export ---------------------------------------------------
+
+def test_chrome_trace_schema_valid(tmp_path):
+    conf = RapidsConf({"spark.rapids.tpu.profile.traceCapture": True})
+    df, _ = _run_profiled(conf)
+    prof = df.last_profile()
+    assert prof.events, "trace capture was on: operator spans expected"
+    path = prof.dump_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        obj = json.load(f)
+    assert validate_trace(obj) == []
+    assert obj["displayTimeUnit"] == "ms"
+    evs = obj["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert spans and all(
+        isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        and e["dur"] >= 0 and e["name"] for e in spans)
+    # per-operator batch spans AND per-node summary spans are both present
+    assert any(e.get("args", {}).get("partition") is not None for e in spans)
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in meta)
+
+
+def test_trace_viewer_check_rejects_garbage():
+    assert validate_trace({"no": "traceEvents"})
+    assert validate_trace({"traceEvents": []})
+    bad = {"traceEvents": [{"ph": "X", "name": "a", "ts": -1, "dur": 2}]}
+    assert any("negative ts" in e for e in validate_trace(bad))
+    good = {"traceEvents": [{"ph": "X", "name": "a", "ts": 0, "dur": 2,
+                             "pid": 1, "tid": 1}]}
+    assert validate_trace(good) == []
+
+
+def test_trace_export_rebases_timestamps():
+    events = [
+        {"name": "b", "start_ns": 2_000_000, "dur_ns": 1000, "thread": 7},
+        {"name": "a", "start_ns": 1_000_000, "dur_ns": 1000, "thread": 7},
+    ]
+    obj = to_chrome_trace(events)
+    spans = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert min(e["ts"] for e in spans) == 0  # rebased to window start
+    assert {e["name"] for e in spans} == {"a", "b"}
+
+
+# -- Prometheus exposition -------------------------------------------------
+
+def test_prometheus_exposition():
+    text = render_prometheus()
+    for family in ("srtpu_pool_used_bytes", "srtpu_spill_to_host_total",
+                   "srtpu_semaphore_wait_ns_total", "srtpu_filecache_hit_total",
+                   "srtpu_shuffle_bytes_written_total"):
+        assert f"# HELP {family} " in text
+        assert f"# TYPE {family} " in text
+        assert any(l.startswith(family + " ")
+                   for l in text.splitlines()), family
+    # snapshot keys and catalog stay in lockstep
+    snap = gauge_snapshot()
+    from spark_rapids_tpu.obs.gauges import CATALOG
+    assert set(snap) == {name for name, _, _ in CATALOG}
+
+
+# -- metrics levels --------------------------------------------------------
+
+def test_metrics_level_filters_collection():
+    prev = B.get_metrics_level()
+    try:
+        conf = RapidsConf(
+            {"spark.rapids.tpu.sql.metrics.level": "ESSENTIAL"})
+        df, rows = _run_profiled(conf)
+        snap = df.last_profile().nodes[0]["metrics"]
+        assert snap["numOutputRows"] == len(rows)   # ESSENTIAL stays
+        assert "numOutputBatches" not in snap       # MODERATE filtered
+        # back to MODERATE: batches are collected again
+        df2, _ = _run_profiled(RapidsConf({}))
+        assert "numOutputBatches" in df2.last_profile().nodes[0]["metrics"]
+    finally:
+        B.set_metrics_level(prev)
+
+
+def test_metrics_level_disabled_metric_still_addable():
+    prev = B.get_metrics_level()
+    try:
+        B.set_metrics_level("ESSENTIAL")
+
+        class _Op(B.LeafExec):
+            pass
+
+        op = _Op()
+        # operator code paths add/time unconditionally; placeholders absorb
+        op.metrics["numOutputBatches"].add(5)
+        with op.timer("numOutputBatches"):
+            pass
+        assert op.metrics["numOutputBatches"].value == 5  # timer no-oped
+        assert "numOutputBatches" not in op.metrics_snapshot()
+        with pytest.raises(ValueError):
+            B.set_metrics_level("VERBOSE")
+    finally:
+        B.set_metrics_level(prev)
+
+
+# -- task-metrics registry bounds ------------------------------------------
+
+def test_task_registry_bounded():
+    base = TM.registry_sizes()["active"]
+    for i in range(TM.FINISHED_CAPACITY + 100):
+        TM.start_task(1_000_000 + i)
+        TM.add("retry_count", 1)
+        TM.finish_task()
+    sizes = TM.registry_sizes()
+    assert sizes["active"] == base          # finish_task evicts from active
+    assert sizes["finished"] <= TM.FINISHED_CAPACITY
+    # most recent attempts survive, the oldest were evicted
+    assert TM.get_task(1_000_000 + TM.FINISHED_CAPACITY + 99) is not None
+    assert TM.get_task(1_000_000) is None
+
+
+def test_task_aggregate_snapshot_sums_and_maxes():
+    TM.start_task(2_000_001)
+    TM.add("spill_to_host_bytes", 100)
+    TM.watermark("max_device_bytes", 7)
+    TM.finish_task()
+    TM.start_task(2_000_002)
+    TM.add("spill_to_host_bytes", 50)
+    TM.watermark("max_device_bytes", 3)
+    TM.finish_task()
+    agg = TM.aggregate_snapshot()
+    assert agg["spill_to_host_bytes"] >= 150   # summed
+    assert agg["max_device_bytes"] >= 7        # high-water, not summed
+
+
+# -- trace window hygiene --------------------------------------------------
+
+def test_back_to_back_windows_do_not_mix(tmp_path):
+    # stale events recorded outside any window must not leak into the next
+    tracing.set_capture(True)
+    tracing.record_event("stale", 0, 1)
+    tracing.set_capture(False)
+    with tracing.Profiler(str(tmp_path / "w1")):
+        with tracing.TraceRange("first"):
+            pass
+    w1 = [e["name"] for e in tracing.trace_events()]
+    assert "first" in w1 and "stale" not in w1
+    with tracing.Profiler(str(tmp_path / "w2")):
+        with tracing.TraceRange("second"):
+            pass
+    w2 = [e["name"] for e in tracing.trace_events(clear=True)]
+    assert "second" in w2 and "first" not in w2
+
+
+def test_record_event_off_window_dropped():
+    tracing.set_capture(False)
+    before = len(tracing.trace_events())
+    tracing.record_event("dropped", 0, 1)
+    assert len(tracing.trace_events()) == before
+
+
+def test_query_profile_owns_capture_only_when_free(tmp_path):
+    # a user-managed Profiler window must not be clobbered by a profile
+    with tracing.Profiler(str(tmp_path / "user")):
+        p = QueryProfile(capture_trace=True).start()
+        assert not p._owned_capture
+        p.finish()
+        assert tracing.capturing()  # user window still open
+    assert not tracing.capturing()
+    tracing.trace_events(clear=True)

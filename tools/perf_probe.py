@@ -13,11 +13,15 @@ Each experiment times a warm jitted computation with the honest fence
 """
 from __future__ import annotations
 
+import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from spark_rapids_tpu.utils import tracing
 
 N = 1 << 24  # 16M
 
@@ -28,10 +32,11 @@ def timeit(name, fn, *args, reps=3):
     jax.tree_util.tree_leaves(out)[0].block_until_ready()
     _fence(out)
     ts = []
-    for _ in range(reps):
+    for i in range(reps):
         t0 = time.perf_counter()
-        out = fn(*args)
-        _fence(out)
+        with tracing.TraceRange(f"{name} #{i}"):
+            out = fn(*args)
+            _fence(out)
         ts.append(time.perf_counter() - t0)
     print(f"{name:55s} min={min(ts):7.3f}s  all={[round(t,3) for t in ts]}")
     return min(ts)
@@ -45,6 +50,9 @@ def _fence(out):
 
 def main():
     print("devices:", jax.devices())
+    # every timeit rep below lands in this window; dumped as a Chrome
+    # trace at the end so experiments can be compared on one timeline
+    tracing.set_capture(True, clear=True)
     key = np.random.default_rng(0)
     xs = [jnp.asarray(key.standard_normal(N).astype(np.float32))
           for _ in range(10)]
@@ -162,6 +170,15 @@ def main():
         return consume_batch(ob)
 
     timeit("FilterExec -> sums (2 dispatches)", filter_then_sum, batch)
+
+    tracing.set_capture(False)
+    from spark_rapids_tpu.obs import to_chrome_trace
+
+    events = tracing.trace_events(clear=True)
+    out_path = os.environ.get("PROBE_TRACE", "trace_perf_probe.json")
+    with open(out_path, "w") as f:
+        json.dump(to_chrome_trace(events, process_name="perf_probe"), f)
+    print(f"chrome trace ({len(events)} spans):", out_path)
 
 
 if __name__ == "__main__":

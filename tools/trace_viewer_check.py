@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Offline validator for dumped Chrome trace_event JSON files.
+
+CI-lane stand-in for "does it load in chrome://tracing / Perfetto": checks
+the structural invariants those viewers rely on (the Trace Event Format),
+so a bench/profile dump that would render blank fails fast here instead.
+
+Usage: python tools/trace_viewer_check.py trace.json [more.json ...]
+Exit status: 0 when every file validates, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+VALID_PHASES = set("BEXiIPNODMCbnesftp(){}")
+_NUM = (int, float)
+
+
+def validate_trace(obj) -> List[str]:
+    """Structural errors in a parsed trace object (empty list = valid)."""
+    errors: List[str] = []
+    if isinstance(obj, list):
+        events = obj  # the JSON-array flavor of the format is also legal
+    elif isinstance(obj, dict):
+        events = obj.get("traceEvents")
+        if not isinstance(events, list):
+            return ["top-level object has no 'traceEvents' array"]
+    else:
+        return [f"trace must be an object or array, got {type(obj).__name__}"]
+    if not events:
+        errors.append("traceEvents is empty")
+        return errors
+    seen_span = False
+    for i, e in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(e, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = e.get("ph")
+        if not isinstance(ph, str) or ph not in VALID_PHASES:
+            errors.append(f"{where}: bad or missing ph {ph!r}")
+            continue
+        if ph == "M":
+            if "name" not in e:
+                errors.append(f"{where}: metadata event without name")
+            continue
+        if not isinstance(e.get("name"), str) or not e["name"]:
+            errors.append(f"{where}: missing event name")
+        if "pid" in e and not isinstance(e["pid"], int):
+            errors.append(f"{where}: pid must be an int")
+        if "tid" in e and not isinstance(e["tid"], int):
+            errors.append(f"{where}: tid must be an int")
+        if ph in "BEXiI":
+            ts = e.get("ts")
+            if not isinstance(ts, _NUM) or isinstance(ts, bool):
+                errors.append(f"{where}: {ph} event needs numeric ts")
+            elif ts < 0:
+                errors.append(f"{where}: negative ts {ts}")
+        if ph == "X":
+            seen_span = True
+            dur = e.get("dur")
+            if not isinstance(dur, _NUM) or isinstance(dur, bool):
+                errors.append(f"{where}: X event needs numeric dur")
+            elif dur < 0:
+                errors.append(f"{where}: negative dur {dur}")
+        if "args" in e and not isinstance(e["args"], dict):
+            errors.append(f"{where}: args must be an object")
+    if not seen_span:
+        errors.append("no complete ('X') span events in trace")
+    return errors
+
+
+def check_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError) as ex:
+        return [f"cannot load {path}: {ex}"]
+    return validate_trace(obj)
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print(__doc__)
+        return 1
+    rc = 0
+    for path in argv:
+        errors = check_file(path)
+        if errors:
+            rc = 1
+            print(f"FAIL {path}")
+            for err in errors:
+                print(f"  - {err}")
+        else:
+            print(f"OK   {path}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
