@@ -201,7 +201,32 @@ def _mark(msg):
           flush=True)
 
 
-def main():
+class _Budget:
+    """Soft wall-clock budget (--budget SECONDS).
+
+    Phases deduct their measured wall time; downstream phases consult
+    ``remaining()`` and shrink the knobs that only affect statistical
+    quality (COPIES / RUNS / DEPTH, the reused-buffer comparison runs,
+    roofline reps, profile dumps). Correctness gates are NEVER skipped or
+    shrunk, and the final driver-metric line is always emitted — a budget
+    run degrades to fewer/noisier samples, not to rc=124 with no metric.
+    """
+
+    def __init__(self, total):
+        self.total = total
+        self.t0 = time.perf_counter()
+
+    @property
+    def enabled(self):
+        return self.total is not None
+
+    def remaining(self):
+        if self.total is None:
+            return float("inf")
+        return self.total - (time.perf_counter() - self.t0)
+
+
+def main(budget_s=None):
     import jax
     from spark_rapids_tpu.bench import tpch
     from spark_rapids_tpu.bench import tpcds_queries as DSQ
@@ -212,8 +237,10 @@ def main():
 
     dev_conf = RapidsConf({})
     cpu_conf = RapidsConf({"spark.rapids.tpu.sql.enabled": False})
+    bud = _Budget(budget_s)
 
     # ---- TPC-H sources + permuted copies --------------------------------
+    t_gen = time.perf_counter()
     base_h = {
         "lineitem": tpch.gen_lineitem(SF_H, seed=7),
         "orders": tpch.gen_orders(SF_H, seed=8),
@@ -222,10 +249,20 @@ def main():
         "nation": tpch.gen_nation(),
         "region": tpch.gen_region(),
     }
+    t_gen = time.perf_counter() - t_gen
+    copies_h_n = COPIES_H
+    if bud.enabled:
+        # each extra copy re-pays roughly a base generation (permute) plus
+        # its uploads/compiles downstream; cap copy cost at ~20% of what's
+        # left so the mandatory gates + timed runs always fit
+        while copies_h_n > 1 and (copies_h_n - 1) * t_gen > 0.2 * bud.remaining():
+            copies_h_n -= 1
+        _mark(f"budget: COPIES_H={copies_h_n} (of {COPIES_H}), "
+              f"{bud.remaining():.0f}s left")
     copies_h = [base_h] + [
         {k: _permute(v, 100 + 7 * c + i) for i, (k, v) in
          enumerate(base_h.items())}
-        for c in range(1, COPIES_H)
+        for c in range(1, copies_h_n)
     ]
     h_names = ["q1", "q3", "q5", "q6"]
 
@@ -293,11 +330,19 @@ def main():
 
     # ---- TPC-DS sources + plans -----------------------------------------
     _mark("tpcds gen+plans")
+    t_gen_ds = time.perf_counter()
     base_ds = ds_tables(SF_DS)
+    t_gen_ds = time.perf_counter() - t_gen_ds
+    copies_ds_n = COPIES_DS
+    if bud.enabled:
+        while copies_ds_n > 1 and (copies_ds_n - 1) * t_gen_ds > 0.2 * bud.remaining():
+            copies_ds_n -= 1
+        _mark(f"budget: COPIES_DS={copies_ds_n} (of {COPIES_DS}), "
+              f"{bud.remaining():.0f}s left")
     copies_ds = [base_ds] + [
         {k: _permute(v, 500 + 11 * c + i) for i, (k, v) in
          enumerate(base_ds.items())}
-        for c in range(1, COPIES_DS)
+        for c in range(1, copies_ds_n)
     ]
     ds_plans = [build_plans(tabs, dev_conf, DSQ.QUERIES, TPCDS_QUERIES,
                             1 << 22)
@@ -319,10 +364,12 @@ def main():
 
     _mark("warmup")
     # ---- timed runs ------------------------------------------------------
+    runs, depth = RUNS, DEPTH
+
     def timed(plan_copies, names, depth, rotate):
         times = []
         it = 0
-        for _ in range(RUNS):
+        for _ in range(runs):
             t0 = time.perf_counter()
             outs = []
             for _ in range(depth):
@@ -334,27 +381,59 @@ def main():
             times.append((time.perf_counter() - t0) / depth)
         return min(times), sorted(times)[len(times) // 2]
 
-    # warm every copy (compile + first run) before timing
-    for plans in h_plans:
+    # warm every copy (compile + first run) before timing; the warm pass
+    # over copy 0 doubles as the per-iteration cost estimate budget mode
+    # sizes RUNS/DEPTH from
+    t_iter = time.perf_counter()
+    for qn in h_names:
+        fence([run_plan(h_plans[0][qn])[1]])
+    for qn in TPCDS_QUERIES:
+        fence([run_plan(ds_plans[0][qn])[1]])
+    t_iter = time.perf_counter() - t_iter
+    for plans in h_plans[1:]:
         for qn in h_names:
             fence([run_plan(plans[qn])[1]])
-    for plans in ds_plans:
+    for plans in ds_plans[1:]:
         for qn in TPCDS_QUERIES:
             fence([run_plan(plans[qn])[1]])
 
-    _mark("timed runs")
-    h_fresh = timed(h_plans, h_names, DEPTH, rotate=True)
-    h_reused = timed(h_plans, h_names, DEPTH, rotate=False)
-    ds_fresh = timed(ds_plans, TPCDS_QUERIES, DEPTH, rotate=True)
-    ds_reused = timed(ds_plans, TPCDS_QUERIES, DEPTH, rotate=False)
+    do_reused = True
+    if bud.enabled:
+        # fresh blocks cost ~runs*depth iterations per suite; reused blocks
+        # double that. Reserve ~25% of what's left for roofline + output.
+        avail = max(0.75 * bud.remaining(), t_iter)
+        while runs * depth * t_iter * 2 > avail and (runs > 1 or depth > 1):
+            if depth > 1:
+                depth -= 1
+            else:
+                runs -= 1
+        do_reused = runs * depth * t_iter * 2 * 2 <= avail
+        _mark(f"budget: RUNS={runs} DEPTH={depth} reused={do_reused} "
+              f"(iter~{t_iter:.1f}s, {bud.remaining():.0f}s left)")
 
-    _mark("roofline")
-    roofline = _measure_roofline()
+    _mark("timed runs")
+    h_fresh = timed(h_plans, h_names, depth, rotate=True)
+    ds_fresh = timed(ds_plans, TPCDS_QUERIES, depth, rotate=True)
+    if do_reused:
+        h_reused = timed(h_plans, h_names, depth, rotate=False)
+        ds_reused = timed(ds_plans, TPCDS_QUERIES, depth, rotate=False)
+    else:
+        h_reused = ds_reused = (None, None)
+
+    roofline = None
+    if not bud.enabled or bud.remaining() > 20:
+        _mark("roofline")
+        roofline = _measure_roofline()
+    else:
+        _mark("budget: skipping roofline")
 
     # ---- per-query profile artifacts (docs/observability.md) ------------
     # Untimed pass on freshly planned copies so per-node metrics reflect
     # exactly one execution (the timed plans have accumulated RUNS*DEPTH
     # iterations); traceCapture gives each dump a Perfetto-loadable trace.
+    do_profiles = not bud.enabled or bud.remaining() > 2 * t_iter + 15
+    if not do_profiles:
+        _mark("budget: skipping profile dumps")
     _mark("profile dumps")
     from spark_rapids_tpu.obs import profile_for
 
@@ -365,7 +444,7 @@ def main():
     specs = ([("tpch", qn, base_h, tpch.DF_QUERIES, 1 << 24)
               for qn in h_names]
              + [("tpcds", qn, base_ds, DSQ.QUERIES, 1 << 22)
-                for qn in TPCDS_QUERIES])
+                for qn in TPCDS_QUERIES]) if do_profiles else []
     for suite, qn, tabs, builders, batch_rows in specs:
         node = build_plans(tabs, prof_conf, builders, [qn], batch_rows)[qn]
         prof = profile_for(node)
@@ -414,20 +493,24 @@ def main():
     total_fresh = h_fresh[0] + ds_fresh[0]
     total_med = h_fresh[1] + ds_fresh[1]
     cpu_total = cpu_h_s + cpu_ds_s
-    util = (bytes_h / h_fresh[0]) / roofline
+    util = ((bytes_h / h_fresh[0]) / roofline
+            if roofline is not None else None)
+
+    def _r(v, nd):
+        return round(v, nd) if v is not None else None
 
     print(json.dumps({
         "tpch_s_per_iter": {"fresh_min": round(h_fresh[0], 4),
                             "fresh_median": round(h_fresh[1], 4),
-                            "reused_min": round(h_reused[0], 4),
-                            "reused_median": round(h_reused[1], 4)},
+                            "reused_min": _r(h_reused[0], 4),
+                            "reused_median": _r(h_reused[1], 4)},
         "tpcds_s_per_iter": {"fresh_min": round(ds_fresh[0], 4),
                              "fresh_median": round(ds_fresh[1], 4),
-                             "reused_min": round(ds_reused[0], 4),
-                             "reused_median": round(ds_reused[1], 4)},
+                             "reused_min": _r(ds_reused[0], 4),
+                             "reused_median": _r(ds_reused[1], 4)},
         "cpu_s": {"tpch_pandas": round(cpu_h_s, 3),
                   "tpcds_cpu_engine": round(cpu_ds_s, 3)},
-        "roofline_GBps": round(roofline / 1e9, 2),
+        "roofline_GBps": _r(roofline / 1e9 if roofline is not None else None, 2),
         "tpch_bytes_per_iter_GB": round(bytes_h / 1e9, 3),
         "queries": {"tpch": h_names, "tpcds": TPCDS_QUERIES,
                     "sf": {"tpch": SF_H, "tpcds": SF_DS}},
@@ -440,10 +523,20 @@ def main():
         "value": round((rows_h + rows_ds) / total_fresh, 1),
         "unit": "rows/s",
         "vs_baseline": round(cpu_total / total_fresh, 3),
-        "utilization": round(util, 4),
+        "utilization": _r(util, 4),
         "value_median": round((rows_h + rows_ds) / total_med, 1),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", type=float, default=None, metavar="SECONDS",
+                    help="soft wall-clock budget: phases deduct measured "
+                         "time; COPIES/RUNS/DEPTH shrink and optional "
+                         "phases (reused-buffer runs, roofline, profile "
+                         "dumps) are skipped to fit. Correctness gates "
+                         "always run; the final driver-metric line is "
+                         "always emitted.")
+    main(budget_s=ap.parse_args().budget)

@@ -291,6 +291,31 @@ AQE_SKEW_THRESHOLD_BYTES = conf(
 # Round-5 perf/feature knobs (VERDICT r4 item 10: the knobs perf sweeps need)
 # ---------------------------------------------------------------------------
 
+FUSION_ENABLED = conf(
+    "spark.rapids.tpu.sql.fusion.enabled", default=True,
+    doc="Collapse maximal chains of narrow per-batch operators (project/"
+        "filter/expand), inner-join probes, and a terminal partial/complete "
+        "aggregate into one jitted program per pipeline stage, paying the "
+        "per-dispatch floor once per stage instead of once per operator "
+        "(exec/fused.py; WholeStageCodegenExec analog). Data-dependent "
+        "runtime conditions (duplicate join build keys, aggregate carry "
+        "overflow) fall back to the unfused operator chain per partition.")
+
+FUSION_MIN_OPERATORS = conf(
+    "spark.rapids.tpu.sql.fusion.minOperators", default=2,
+    doc="Minimum number of absorbed per-batch dispatch sites for a fused "
+        "stage to be built; below this the extra compiled program isn't "
+        "worth it. Narrow ops and join probes count one each; a terminal "
+        "aggregate counts two (its windowed streaming absorption alone "
+        "replaces aggBatchWindow dispatches with one).")
+
+FUSION_AGG_WINDOW = conf(
+    "spark.rapids.tpu.sql.fusion.aggBatchWindow", default=7,
+    doc="Number of input batches one fused streaming-aggregate dispatch "
+        "consumes (chain+first-pass per batch, then a single carry+firsts "
+        "concat/merge). 7 keeps the merge concat 8-wide, matching the "
+        "classic operator's tuned cascade width.")
+
 SHRINK_TO_LIVE_ENABLED = conf(
     "spark.rapids.tpu.sql.batch.shrinkToLive.enabled", default=True,
     doc="Re-bucket filter/join/aggregate outputs down to the live row "

@@ -12,6 +12,7 @@ from spark_rapids_tpu.exec.project import FilterExec, ProjectExec  # noqa: F401
 from spark_rapids_tpu.exec.aggregate import HashAggregateExec  # noqa: F401
 from spark_rapids_tpu.exec.sort import SortExec, SortOrder  # noqa: F401
 from spark_rapids_tpu.exec.join import HashJoinExec  # noqa: F401
+from spark_rapids_tpu.exec.fused import TpuFusedStageExec, fuse_exec  # noqa: F401
 from spark_rapids_tpu.exec.join_bcast import (  # noqa: F401
     BroadcastHashJoinExec,
     BroadcastNestedLoopJoinExec,

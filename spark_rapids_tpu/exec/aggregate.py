@@ -244,10 +244,14 @@ class HashAggregateExec(UnaryExec):
         from spark_rapids_tpu.exec.jit_cache import shared_jit
 
         # the key must capture EVERYTHING the traced closures depend on:
-        # exprs, mode, input schema, and the fused pre-filter
-        base_key = ("agg", repr(self.group_exprs), repr(self.agg_exprs),
+        # exprs, mode, input schema, and the fused pre-filter (keyed by
+        # cache_key, not repr — repr omits non-child literals, VERDICT r5)
+        base_key = ("agg", E.exprs_cache_key(self.group_exprs),
+                    E.exprs_cache_key(self.agg_exprs),
                     self.mode, repr(self.child.output_schema),
-                    repr(self.pre_filter))
+                    self.pre_filter.cache_key()
+                    if self.pre_filter is not None else None)
+        self._base_key = base_key
         self._first_pass_fn = shared_jit(
             base_key + ("first",), lambda: self._first_pass)
         self._merge_pass_fn = shared_jit(

@@ -182,6 +182,28 @@ class TpuExec:
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         raise NotImplementedError
 
+    # -- whole-stage fusion protocol ---------------------------------------
+    # Operators whose per-batch work is a PURE batch-in/batch-out function
+    # (no host sync, no cross-batch state) implement batch_fn()/batch_fn_key
+    # so the plan-time fusion pass (plan/overrides.py) can compose maximal
+    # chains into one jitted program per stage (exec/fused.py). Returning
+    # None marks the operator as a fusion BARRIER — it executes unfused,
+    # which also preserves per-operator CPU-fallback semantics.
+
+    def batch_fn(self):
+        """Pure traceable fn(batch) -> batch, or None (fusion barrier)."""
+        return None
+
+    def batch_fn_key(self) -> tuple:
+        """shared_jit key fragment capturing batch_fn's traced program."""
+        raise NotImplementedError(type(self).__name__)
+
+    def fused_out_cap(self, in_cap: int) -> int:
+        """Static output capacity of batch_fn given an input capacity
+        (fusion tracks it through the chain to key shape-dependent
+        downstream segments, e.g. join probe byte bounds)."""
+        return in_cap
+
     # -- metrics / explain -------------------------------------------------
     def _register_metric(self, name: str, level: int = MODERATE) -> Metric:
         m = Metric(name, level, enabled=level <= _METRICS_LEVEL)
@@ -218,6 +240,16 @@ class TpuExec:
             name = type(node).__name__
             for k, v in node.metrics_snapshot().items():
                 out[f"{name}.{k}"] = out.get(f"{name}.{k}", 0) + v
+            # constituents of a fused stage are not structural children but
+            # still carry attributed metrics; an absorbed join's build
+            # subtree executes for real and hangs off the constituent
+            # (exec/fused.py)
+            for op in getattr(node, "fused_ops", ()):
+                for k, v in op.metrics_snapshot().items():
+                    oname = type(op).__name__
+                    out[f"{oname}.{k}"] = out.get(f"{oname}.{k}", 0) + v
+                if len(op.children) == 2:
+                    walk(op.children[1])
             for c in node.children:
                 walk(c)
 

@@ -49,6 +49,31 @@ class ExpandExec(UnaryExec):
     def node_description(self) -> str:
         return f"TpuExpand [{len(self.projections)} projections]"
 
+    def batch_fn(self):
+        self._bind()
+        if any(isinstance(f.dtype, (T.StructType, T.MapType))
+               for f in self._schema):
+            # nested outputs concat through the host arrow path, which
+            # can't run under an enclosing trace: fusion barrier
+            return None
+        bound = self._bound
+
+        def run(batch):
+            pieces = [EV.project_batch(batch, list(b)) for b in bound]
+            return pieces[0] if len(pieces) == 1 else concat_jit(pieces)
+        return run
+
+    def fused_out_cap(self, in_cap: int) -> int:
+        from spark_rapids_tpu.columnar.batch import bucket_capacity
+        n = len(self.projections)
+        return in_cap if n == 1 else bucket_capacity(n * in_cap)
+
+    def batch_fn_key(self) -> tuple:
+        self._bind()
+        return ("expand",
+                tuple(E.exprs_cache_key(b) for b in self._bound),
+                repr(self.child.output_schema))
+
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._bind()
         for batch in self.child.execute(partition):

@@ -1,0 +1,472 @@
+"""Whole-stage jitted pipeline fusion: one TPU dispatch per pipeline stage.
+
+Every per-operator jit call pays this platform's ~0.09s dispatch floor
+(docs/perf_notes_r05.md "axon tunnel"): a chain of K narrow operators costs
+K floors *per batch* even when each body is microseconds of device work.
+The reference escapes the analogous launch overhead with codegen'd
+whole-stage pipelines (Spark WholeStageCodegenExec) and cuDF's fused AST
+kernels; the XLA-native analog is simpler — operators already ARE traced
+programs, so a stage is just their composition under ONE ``jax.jit``.
+
+Plan-time pass (``fuse_exec``, called from plan/overrides.py behind
+``spark.rapids.tpu.sql.fusion.enabled``) collapses maximal chains of:
+
+- narrow per-batch operators — anything implementing the ``batch_fn()``
+  protocol (exec/base.py): project, filter, expand;
+- inner hash joins along their PROBE side (the build subtree executes
+  normally at stage setup; only the per-batch probe is absorbed, and only
+  for the dense / unique-table runtime paths whose probes are pure —
+  the general sorted-hash path needs a per-batch host sync and bails to
+  the unfused fallback, see HashJoinExec.fused_probe);
+- a terminal partial/complete hash aggregate, absorbed in STREAMING form:
+  per batch one dispatch runs chain -> first_pass -> concat(carry, first)
+  -> merge_pass -> truncate-to-carry-capacity, which also deletes the
+  end-of-partition concat/merge cascade the classic operator pays.
+
+into a single ``TpuFusedStageExec`` whose per-batch body is one shared_jit
+program. Operators that don't implement the protocol are fusion BARRIERS
+and keep their per-operator execution (including CPU fallback semantics).
+
+Correctness safety valves — every data-dependent assumption is checked and
+degrades to the ORIGINAL operator chain (constituents keep their children
+links, so the unfused plan is always re-executable):
+
+- join build turns out duplicate-keyed / oversized -> fallback before any
+  output is produced;
+- the streaming aggregate's carry overflows its capacity (more groups, or
+  more group-key bytes, than the first batch's bucket) -> overflow flags
+  are computed ON DEVICE inside the fused body and read back once at
+  partition end; on overflow the partition is re-run unfused;
+- empty partitions -> fallback (classic empty-input semantics).
+
+``shrink_to_live`` moves from per-operator to the fused-stage boundary:
+intermediates never materialize at operator granularity, so only the
+stage output is re-bucketed (base.execute applies it when
+``shrink_output`` is set, which the stage derives from its constituents).
+
+Metrics: constituents are not structural children but still get per-batch
+``numOutputRows``/``numOutputBatches`` attribution — the fused body
+returns every intermediate live-row count as auxiliary traced scalars (no
+extra dispatch, resolved lazily like base.execute's _pending_rows).
+obs/profile.py renders them as ``fused=#<stage>`` rows under the stage.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, bucket_capacity
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+from spark_rapids_tpu.exec.jit_cache import shared_jit
+
+
+# ---------------------------------------------------------------------------
+# traced helpers
+# ---------------------------------------------------------------------------
+
+
+def _fit(a: jax.Array, n: int) -> jax.Array:
+    """Slice or zero-pad a 1-D array to exactly ``n`` elements (static)."""
+    if a.shape[0] == n:
+        return a
+    if a.shape[0] > n:
+        return a[:n]
+    return jnp.concatenate([a, jnp.zeros(n - a.shape[0], a.dtype)])
+
+
+def _truncate_buffers(merged: ColumnarBatch, newcap: int,
+                      bc_targets: Tuple[int, ...]):
+    """Slice a merged aggregation buffer back down to the carry capacity.
+
+    Returns ``(carry, overflow)``: overflow is a traced bool that is True
+    when the merged groups no longer fit the carry's static row or string
+    byte capacity — the stage then discards the fused result and re-runs
+    the partition through the unfused fallback chain, so truncated
+    garbage never escapes.
+    """
+    over = merged.num_rows > newcap
+    nkeep = jnp.clip(merged.num_rows, 0, newcap)
+    cols: List[DeviceColumn] = []
+    for c, bc in zip(merged.columns, bc_targets):
+        if c.offsets is not None:
+            over = over | (c.offsets[nkeep] > bc)
+            cols.append(DeviceColumn(c.dtype, _fit(c.data, bc),
+                                     c.validity[:newcap],
+                                     _fit(c.offsets, newcap + 1)))
+        else:
+            d2 = c.data2[:newcap] if c.data2 is not None else None
+            cols.append(DeviceColumn(c.dtype, c.data[:newcap],
+                                     c.validity[:newcap], None,
+                                     c.dictionary, c.dict_size,
+                                     c.dict_max_len, d2))
+    return ColumnarBatch(cols, nkeep), over
+
+
+def _carry_byte_targets(first: ColumnarBatch) -> Tuple[int, ...]:
+    """Static per-column byte capacities the streaming carry truncates to.
+
+    Plain string buffer columns get 2x the first batch's byte bucket
+    (headroom for later batches with longer group keys); dict-encoded
+    columns get the exact worst case after decode (rows * longest entry)
+    — concat under trace always decodes, tracer identity can't prove a
+    shared dictionary. The overflow flag guards both estimates.
+    """
+    t = []
+    for c in first.columns:
+        if c.offsets is not None:
+            t.append(bucket_capacity(max(2 * c.byte_capacity, 8), 8))
+        elif c.is_dict:
+            t.append(bucket_capacity(
+                max(first.capacity * max(c.dict_max_len, 1), 8), 8))
+        else:
+            t.append(0)
+    return tuple(t)
+
+
+def _make_body(fns):
+    """Compose segment fns into one traced chain returning every
+    intermediate live-row count (per-constituent metric attribution)."""
+    def body(batch, consts):
+        counts = []
+        for fn, cst in zip(fns, consts):
+            batch = fn(batch, cst)
+            counts.append(batch.num_rows)
+        return batch, tuple(counts)
+    return body
+
+
+def _make_seed(fns, agg):
+    body = _make_body(fns)
+
+    def seed(batch, consts):
+        out, counts = body(batch, consts)
+        return agg._first_pass(out), counts
+    return seed
+
+
+def _make_step(fns, agg, carry_cap: int, bc_targets: Tuple[int, ...]):
+    """Streaming-aggregate step over a WINDOW of batches: one dispatch runs
+    chain -> first_pass for every batch in the window, then a single
+    (carry + firsts) concat/merge — the fused analog of the classic
+    operator's 8-way merge cascade, without the per-batch first-pass
+    dispatches or the end-of-partition cascade."""
+    from spark_rapids_tpu.exec.aggregate import concat_jit
+    bodies = [_make_body(f) for f in fns]  # one per window slot (its cap)
+
+    def step(carry, batches, consts):
+        firsts = []
+        counts_all = []
+        for body, batch in zip(bodies, batches):
+            out, counts = body(batch, consts)
+            firsts.append(agg._first_pass(out))
+            counts_all.append(counts)
+        cat = concat_jit([carry] + firsts)
+        merged = agg._merge_pass(cat)
+        carry2, over = _truncate_buffers(merged, carry_cap, bc_targets)
+        return carry2, over, tuple(counts_all)
+    return step
+
+
+# ---------------------------------------------------------------------------
+# segments
+# ---------------------------------------------------------------------------
+
+
+class _OpSeg:
+    """A narrow batch_fn operator inside a stage (shape-independent)."""
+
+    __slots__ = ("op", "_fn", "_key")
+
+    def __init__(self, op: TpuExec):
+        self.op = op
+        fn = op.batch_fn()
+        self._fn = lambda batch, _cst, f=fn: f(batch)
+        self._key = op.batch_fn_key()
+
+    def key_part(self, in_cap: int) -> tuple:
+        return self._key
+
+    def out_cap(self, in_cap: int) -> int:
+        return self.op.fused_out_cap(in_cap)
+
+    def probe_fn(self, in_cap: int):
+        return self._fn
+
+    @property
+    def consts(self):
+        return ()
+
+
+class TpuFusedStageExec(UnaryExec):
+    """One jitted program per pipeline stage (see module docstring).
+
+    ``segments`` are the absorbed operators in DATA-FLOW order (closest to
+    the source first); ``agg`` is an optional terminal partial/complete
+    HashAggregateExec absorbed in streaming form. ``fallback`` is the
+    original top of the chain — constituents keep their children links, so
+    executing it re-runs the exact unfused plan.
+    """
+
+    def __init__(self, segments: List[TpuExec], child: TpuExec,
+                 agg=None, fallback: Optional[TpuExec] = None,
+                 agg_window: int = 7):
+        super().__init__(child)
+        self.segments = list(segments)
+        self.agg = agg
+        self.agg_window = max(1, int(agg_window))
+        self._fallback = fallback if fallback is not None else (
+            agg if agg is not None else segments[-1])
+        self.fused_ops = self.segments + ([agg] if agg is not None else [])
+        self.shrink_output = (agg is not None or any(
+            op.shrink_output for op in self.segments))
+        self._register_metric("numFallbacks")
+        self._register_metric("numFusedBatches")
+
+    # -- plan surface ------------------------------------------------------
+    @property
+    def output_schema(self) -> T.Schema:
+        top = self.agg if self.agg is not None else self.segments[-1]
+        return top.output_schema
+
+    def node_description(self) -> str:
+        names = [type(op).__name__ for op in self.fused_ops]
+        return f"TpuFusedStage [{' -> '.join(names)}]"
+
+    def explain(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        lines = [f"{pad}{'+- ' if indent else ''}{self.node_description()}"]
+        for op in reversed(self.fused_ops):
+            lines.append("  " * (indent + 1) + f"*  {op.node_description()}")
+            # absorbed joins: show the build subtree (it executes for real)
+            if len(op.children) == 2:
+                lines.append(op.children[1].explain(indent + 2))
+        lines.append(self.child.explain(indent + 1))
+        return "\n".join(lines)
+
+    # -- execution ---------------------------------------------------------
+    def _runtime_segments(self, partition: int):
+        """Resolve segments for one partition; joins build their build side
+        here and may refuse (general path) -> None means fall back."""
+        segs = []
+        for op in self.segments:
+            if len(op.children) == 2:  # absorbed hash join
+                seg = op.fused_probe(partition)
+                if seg is None:
+                    return None
+                segs.append(seg)
+            else:
+                segs.append(_OpSeg(op))
+        return segs
+
+    def _fall_back(self, partition: int) -> Iterator[ColumnarBatch]:
+        self.metrics["numFallbacks"].add(1)
+        return self._fallback.execute(partition)
+
+    def _stage_key(self, segs, in_cap: int) -> tuple:
+        parts = []
+        cap = in_cap
+        for seg in segs:
+            parts.append(seg.key_part(cap))
+            cap = seg.out_cap(cap)
+        return ("fused_stage",) + tuple(parts)
+
+    def _chain_fns(self, segs, in_cap: int):
+        fns = []
+        cap = in_cap
+        for seg in segs:
+            fns.append(seg.probe_fn(cap))
+            cap = seg.out_cap(cap)
+        return fns
+
+    def _attribute(self, segs, counts) -> None:
+        for seg, n in zip(segs, counts):
+            op = seg.op
+            op.metrics["numOutputBatches"].add(1)
+            op._pending_rows.append(n)
+            if len(op._pending_rows) >= 64:
+                op.metrics["numOutputRows"].add(
+                    sum(int(x) for x in op._pending_rows))
+                op._pending_rows.clear()
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        segs = self._runtime_segments(partition)
+        if segs is None:
+            yield from self._fall_back(partition)
+            return
+        if self.agg is not None:
+            yield from self._execute_agg(partition, segs)
+        else:
+            yield from self._execute_plain(partition, segs)
+
+    def _execute_plain(self, partition: int, segs):
+        consts = tuple(seg.consts for seg in segs)
+        runs = {}
+        for batch in self.child.execute(partition):
+            cap = batch.capacity
+            run = runs.get(cap)
+            if run is None:
+                fns = self._chain_fns(segs, cap)
+                run = shared_jit(self._stage_key(segs, cap),
+                                 lambda: _make_body(fns))
+                runs[cap] = run
+            out, counts = run(batch, consts)
+            self.metrics["numFusedBatches"].add(1)
+            self._attribute(segs, counts)
+            yield out
+
+    def _execute_agg(self, partition: int, segs):
+        agg = self.agg
+        agg._prepare()
+        consts = tuple(seg.consts for seg in segs)
+        akey = ("streaming",) + agg._base_key
+        carry = None
+        carry_cap = 0
+        bc_targets = ()
+        flags = []
+        runs = {}
+        n_batches = 0
+        it = self.child.execute(partition)
+        # seed: the first batch's first-pass output defines the carry's
+        # static capacity (its bucket bounds the groups a partition may
+        # hold fused — more groups trip the overflow flag -> fallback)
+        for batch in it:
+            n_batches += 1
+            cap = batch.capacity
+            key = self._stage_key(segs, cap) + akey + ("seed",)
+            fns = self._chain_fns(segs, cap)
+            run = shared_jit(key, lambda: _make_seed(fns, agg))
+            carry, counts = run(batch, consts)
+            carry_cap = carry.capacity
+            bc_targets = _carry_byte_targets(carry)
+            self.metrics["numFusedBatches"].add(1)
+            agg.metrics["numAggBatches"].add(1)
+            self._attribute(segs, counts)
+            break
+        if n_batches == 0:
+            yield from self._fall_back(partition)
+            return
+        # steps: windows of up to agg_window batches, ONE dispatch each —
+        # chain+first_pass per batch then a single (carry+firsts)
+        # concat/merge (the classic operator pays a dispatch per batch
+        # plus an end-of-partition 8-way cascade)
+        window: List[ColumnarBatch] = []
+        for batch in it:
+            n_batches += 1
+            window.append(batch)
+            if len(window) < self.agg_window:
+                continue
+            carry, flags, counts_all = self._run_step(
+                segs, agg, consts, akey, carry, carry_cap, bc_targets,
+                window, runs, flags)
+            window = []
+        if window:
+            carry, flags, counts_all = self._run_step(
+                segs, agg, consts, akey, carry, carry_cap, bc_targets,
+                window, runs, flags)
+        # ONE host sync per partition resolves every overflow flag; on
+        # overflow the carry holds truncated garbage -> re-run unfused
+        if flags and any(bool(v) for v in jax.device_get(flags)):
+            yield from self._fall_back(partition)
+            return
+        out = carry if agg.mode == "partial" else agg._final_project_fn(carry)
+        agg.metrics["numOutputBatches"].add(1)
+        agg._pending_rows.append(out.num_rows)
+        yield out
+
+    def _run_step(self, segs, agg, consts, akey, carry, carry_cap,
+                  bc_targets, window, runs, flags):
+        caps = tuple(b.capacity for b in window)
+        run = runs.get(caps)
+        if run is None:
+            # join-probe byte bounds are capacity-dependent: each window
+            # slot gets the chain closures for ITS batch capacity
+            fns = [self._chain_fns(segs, c) for c in caps]
+            key = (akey + ("step", carry_cap, bc_targets)
+                   + tuple(self._stage_key(segs, c) for c in caps))
+            run = shared_jit(
+                key, lambda: _make_step(fns, agg, carry_cap, bc_targets))
+            runs[caps] = run
+        carry, over, counts_all = run(carry, tuple(window), consts)
+        flags = flags + [over]
+        self.metrics["numFusedBatches"].add(len(window))
+        agg.metrics["numAggBatches"].add(len(window))
+        for counts in counts_all:
+            self._attribute(segs, counts)
+        return carry, flags, counts_all
+
+
+# ---------------------------------------------------------------------------
+# plan-time fusion pass
+# ---------------------------------------------------------------------------
+
+
+def _agg_absorbable(op) -> bool:
+    from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+    if not isinstance(op, HashAggregateExec):
+        return False
+    if op.mode not in ("partial", "complete"):
+        return False  # "final" consumes pre-aggregated buffers
+    op._prepare()
+    # nested buffer columns would hit concat_jit's host-arrow path, which
+    # can't run under trace
+    return all(not isinstance(f.dtype, (T.StructType, T.MapType))
+               for f in op._buffer_schema())
+
+
+def _join_absorbable(op) -> bool:
+    from spark_rapids_tpu.exec.join import HashJoinExec
+    return isinstance(op, HashJoinExec) and op.join_type == "inner"
+
+
+def fuse_exec(root: TpuExec, min_ops: int = 2,
+              agg_window: int = 7) -> TpuExec:
+    """Rewrite an exec tree, collapsing maximal fusable chains into
+    TpuFusedStageExec nodes. ``min_ops`` is the minimum number of absorbed
+    per-batch dispatch sites for a stage to be worth one more compiled
+    program (spark.rapids.tpu.sql.fusion.minOperators). An absorbed
+    terminal aggregate counts as TWO sites: windowed streaming absorption
+    alone replaces ``agg_window`` per-batch first-pass dispatches (plus the
+    merge cascade) with one, so even a lone aggregate clears the bar."""
+
+    def try_stage(node: TpuExec):
+        agg = None
+        cur = node
+        if _agg_absorbable(cur):
+            agg = cur
+            cur = cur.children[0]
+        path = []  # top-down
+        while True:
+            if _join_absorbable(cur):
+                path.append(cur)
+                cur = cur.children[0]  # descend the probe side
+            elif cur.children and len(cur.children) == 1 \
+                    and cur.batch_fn() is not None:
+                path.append(cur)
+                cur = cur.children[0]
+            else:
+                break
+        n_sites = len(path) + (2 if agg is not None else 0)
+        if n_sites < min_ops:
+            return None
+        top = agg if agg is not None else path[0]
+        return TpuFusedStageExec(list(reversed(path)), cur,
+                                 agg=agg, fallback=top,
+                                 agg_window=agg_window)
+
+    def rewrite(node: TpuExec) -> TpuExec:
+        stage = try_stage(node)
+        if stage is not None:
+            stage.children[0] = rewrite(stage.children[0])
+            for op in stage.segments:
+                if len(op.children) == 2:
+                    op.children[1] = rewrite(op.children[1])
+            return stage
+        node.children[:] = [rewrite(c) for c in node.children]
+        return node
+
+    return rewrite(root)

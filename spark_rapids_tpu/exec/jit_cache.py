@@ -8,9 +8,14 @@ persistent cache — measured ~0.3–1s per kernel through this platform's
 disk cache, dominating small-scale queries (docs/perf_notes_r05.md).
 
 ``shared_jit(key, make)`` returns ONE jit per semantic key per process:
-the key must capture everything that changes the traced program (bound
-expression reprs include column ordinals and dtypes, so
-(op, repr(bound), ansi) is sufficient for projection-like operators).
+the key must capture everything that changes the traced program. Bound
+expressions are keyed by ``Expression.cache_key()`` — NOT ``repr``, which
+omits non-child literals (LIKE patterns, round scales, JSON paths) and
+silently shared one program across distinct plans (VERDICT r5).
+
+Hit/miss/size counters are exported as ``srtpu_jit_cache_*`` gauges
+(obs/gauges.py) so fusion's compile amplification — more distinct stage
+programs — is visible in the metrics endpoint.
 """
 
 from __future__ import annotations
@@ -22,13 +27,34 @@ import jax
 
 _CACHE: Dict[tuple, Callable] = {}
 _LOCK = threading.Lock()
+_HITS = 0
+_MISSES = 0
 
 
 def shared_jit(key: tuple, make: Callable[[], Callable]) -> Callable:
+    global _HITS, _MISSES
     fn = _CACHE.get(key)
     if fn is None:
         with _LOCK:
             fn = _CACHE.get(key)
             if fn is None:
+                _MISSES += 1
                 fn = _CACHE[key] = jax.jit(make())
+                return fn
+    _HITS += 1
     return fn
+
+
+def cache_stats() -> Dict[str, int]:
+    """Counters for obs/gauges.py: lifetime hits/misses and current size."""
+    return {"jit_cache_hit_total": _HITS,
+            "jit_cache_miss_total": _MISSES,
+            "jit_cache_size": len(_CACHE)}
+
+
+def reset_stats() -> None:
+    """Zero the hit/miss counters (tests); compiled entries are kept."""
+    global _HITS, _MISSES
+    with _LOCK:
+        _HITS = 0
+        _MISSES = 0

@@ -366,6 +366,57 @@ class HashJoinExec(BinaryExec):
         out = self._gather_pairs(probe, build, pi, bi, bi_valid, n_out, out_cap)
         return out, new_matched
 
+    # -- whole-stage fusion hook (exec/fused.py) ---------------------------
+    def fused_probe(self, partition: int):
+        """Build this join's build side now and return a stage segment whose
+        per-batch probe is PURE and traceable, or None when the runtime
+        path can't be traced (non-inner joins need build/probe matched-flag
+        bookkeeping across batches; the general sorted-hash path sizes its
+        output from a per-batch host sync of the candidate total).
+
+        The returned segment's fn takes ``(probe_batch, (build, tbl))`` —
+        the build arrays ride as jit ARGUMENTS, so the traced program (and
+        its shared_jit key) depends only on shapes and the static probe
+        parameters, never on build data.
+        """
+        if self.join_type != "inner":
+            return None
+        self._prepare()
+        build = self._fused_build_side(partition)
+        if build is None:
+            return None  # classic path has the empty-build semantics
+        with self.timer("buildTimeNs"):
+            dense = self._prepare_dense(build)
+            slots = lg_b = None
+            if dense is not None:
+                kind, tbl = "dense", dense
+            else:
+                prep = self._prepare_table(build)
+                if isinstance(prep, tuple):
+                    kind, (tbl, slots) = "unique", prep
+                    lg_b = tbl.lg_b
+                else:
+                    return None  # duplicate keys: per-batch host sync path
+        # longest build row per string column, read ONCE per build; byte
+        # bounds for any probe capacity are then pure host arithmetic
+        mls = {i: int(jax.device_get(
+                   jnp.max(c.offsets[1:] - c.offsets[:-1])))
+               for i, c in enumerate(build.columns) if c.offsets is not None}
+        return _FusedJoinProbe(self, kind, build, tbl, slots, lg_b, mls)
+
+    def _fused_build_side(self, partition: int) -> Optional[ColumnarBatch]:
+        """Materialize the build side exactly as do_execute would see it.
+        Subclasses with a different build scope (broadcast: ALL partitions)
+        must override to match — fusing a partition-local slice of a
+        broadcast build silently drops matches. None = empty build, let the
+        classic path supply its semantics."""
+        with self.timer("buildTimeNs"):
+            build_batches = list(self.right.execute(partition))
+        if not build_batches:
+            return None
+        return (build_batches[0] if len(build_batches) == 1
+                else concat_jit(build_batches))
+
     def _gather_pairs(self, probe, build, pi, bi, bi_valid, n_out, out_cap):
         row_valid = jnp.arange(out_cap, dtype=jnp.int32) < n_out
         pcols = K.gather_columns(
@@ -395,6 +446,82 @@ class HashJoinExec(BinaryExec):
             idx, out_cap)
         cols.extend(K.gather_columns(build.columns, sidx, row_valid))
         return ColumnarBatch(cols, nn.astype(jnp.int32))
+
+
+class _FusedJoinProbe:
+    """Stage segment for an absorbed inner join (HashJoinExec.fused_probe).
+
+    Holds the materialized build side + probe table for one partition and
+    hands the fusion driver (exec/fused.py) a pure ``fn(batch, (build,
+    tbl))`` per probe capacity, plus the static key fragment that makes the
+    composed stage program shareable across identical plans.
+    """
+
+    def __init__(self, join: HashJoinExec, kind: str, build: ColumnarBatch,
+                 tbl, slots, lg_b, mls):
+        self.op = join
+        self.kind = kind
+        self.build = build
+        self.tbl = tbl
+        self.slots = slots
+        self.lg_b = lg_b
+        self._mls = mls  # string col -> longest build row in bytes
+        self._bcaps = {}
+
+    @property
+    def consts(self):
+        return (self.build, self.tbl)
+
+    def out_cap(self, in_cap: int) -> int:
+        return in_cap  # dense/unique probes emit at most one row per row
+
+    def _bcaps_t(self, out_cap: int) -> tuple:
+        t = self._bcaps.get(out_cap)
+        if t is None:
+            t = tuple(sorted(
+                (i, bucket_capacity(max(out_cap * max(ml, 1), 8), 8))
+                for i, ml in self._mls.items()))
+            self._bcaps[out_cap] = t
+        return t
+
+    def key_part(self, out_cap: int) -> tuple:
+        j = self.op
+        return ("join", self.kind, tuple(j._lkeys), tuple(j._rkeys),
+                j._cond_bound.cache_key() if j._cond_bound is not None
+                else None,
+                self.slots, self.lg_b, out_cap, self._bcaps_t(out_cap))
+
+    def probe_fn(self, out_cap: int):
+        join, kind = self.op, self.kind
+        bt = self._bcaps_t(out_cap)
+        lkeys, rkeys = tuple(join._lkeys), tuple(join._rkeys)
+        cond = join._cond_bound
+        slots, lg_b = self.slots, self.lg_b
+
+        def run(probe, consts):
+            build, tbl = consts
+            cap = probe.capacity
+            join._pcaps = {i: c.byte_capacity
+                           for i, c in enumerate(probe.columns)
+                           if c.offsets is not None}
+            join._bcaps = dict(bt)
+            dummy = jnp.zeros(build.capacity, jnp.bool_)
+            if kind == "dense":
+                pi, bi, hit, n_out, _m = _dense_probe(
+                    probe, build, tbl, lkeys[0], cond, "inner", dummy, bt)
+                bi_valid = bi >= 0
+                return join._gather_pairs(probe, build, pi,
+                                          jnp.where(bi_valid, bi, 0),
+                                          bi_valid, n_out, cap)
+            bi, hit, _m = _unique_probe(
+                probe, build, tbl, dummy, lkeys, rkeys, slots, lg_b,
+                cond, "inner", bt)
+            idx, n = K.filter_indices(hit, probe.active_mask())
+            bi_c = jnp.where(idx < cap, bi[jnp.clip(idx, 0, cap - 1)], 0)
+            return join._gather_pairs(
+                probe, build, idx, jnp.clip(bi_c, 0, None),
+                jnp.arange(cap, dtype=jnp.int32) < n, n, cap)
+        return run
 
 
 def _pad_idx(idx: jax.Array, out_cap: int) -> jax.Array:

@@ -88,6 +88,23 @@ class BroadcastHashJoinExec(HashJoinExec):
             if out is not None:
                 yield out
 
+    def _fused_build_side(self, partition):
+        # the broadcast build spans ALL build-side partitions — the
+        # inherited partition-local materialization would silently drop
+        # every match whose build row lives in another partition's slice
+        build, _jh = self._build_broadcast()
+        if not bool(jax.device_get(build.num_rows > 0)):
+            return None
+        return build
+
+    def fused_probe(self, partition: int):
+        # build prep (dense table / bucketed table + the byte-bound syncs)
+        # is partition-independent for a broadcast build: do it once
+        seg = getattr(self, "_fused_seg", None)
+        if seg is None:
+            seg = self._fused_seg = (super().fused_probe(partition), )
+        return seg[0]
+
     def node_description(self) -> str:
         return (f"TpuBroadcastHashJoin {self.join_type} "
                 f"keys={list(zip(self.left_keys, self.right_keys))}")

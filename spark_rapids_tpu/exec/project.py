@@ -38,12 +38,7 @@ class ProjectExec(UnaryExec):
             self._schema = EV.output_schema(self._bound)
             from spark_rapids_tpu.exec.jit_cache import shared_jit
 
-            bound = self._bound
-            ansi = self._ansi
-            self._run = shared_jit(
-                ("project", tuple(map(repr, bound)), ansi,
-                 repr(self.child.output_schema)),
-                lambda: (lambda batch: EV.project_batch(batch, bound, ansi)))
+            self._run = shared_jit(self.batch_fn_key(), lambda: self.batch_fn())
         return self._bound
 
     @property
@@ -53,6 +48,17 @@ class ProjectExec(UnaryExec):
 
     def node_description(self) -> str:
         return f"TpuProject [{', '.join(map(repr, self.exprs))}]"
+
+    def batch_fn(self):
+        self._bind()
+        bound, ansi = self._bound, self._ansi
+        return lambda batch: EV.project_batch(batch, bound, ansi)
+
+    def batch_fn_key(self) -> tuple:
+        if self._bound is None:
+            self._bind()
+        return ("project", E.exprs_cache_key(self._bound), self._ansi,
+                repr(self.child.output_schema))
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._bind()
@@ -77,25 +83,29 @@ class FilterExec(UnaryExec):
             self._bound = E.resolve(self.condition, self.child.output_schema)
             from spark_rapids_tpu.exec.jit_cache import shared_jit
 
-            bound = self._bound
-            ansi = self._ansi
-
-            def make():
-                def run(batch):
-                    ctx = EV.EvalContext(batch, ansi)
-                    pred = EV.eval_expr(bound, ctx)
-                    keep = pred.data & pred.validity
-                    idx, n = K.filter_indices(keep, batch.active_mask())
-                    return K.gather_batch(batch, idx, n)
-                return run
-
-            self._run = shared_jit(
-                ("filter", repr(bound), ansi,
-                 repr(self.child.output_schema)), make)
+            self._run = shared_jit(self.batch_fn_key(), lambda: self.batch_fn())
         return self._bound
 
     def node_description(self) -> str:
         return f"TpuFilter [{self.condition!r}]"
+
+    def batch_fn(self):
+        self._bind()
+        bound, ansi = self._bound, self._ansi
+
+        def run(batch):
+            ctx = EV.EvalContext(batch, ansi)
+            pred = EV.eval_expr(bound, ctx)
+            keep = pred.data & pred.validity
+            idx, n = K.filter_indices(keep, batch.active_mask())
+            return K.gather_batch(batch, idx, n)
+        return run
+
+    def batch_fn_key(self) -> tuple:
+        if self._bound is None:
+            self._bind()
+        return ("filter", self._bound.cache_key(), self._ansi,
+                repr(self.child.output_schema))
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._bind()

@@ -39,6 +39,30 @@ class Expression:
             return f"{name}({', '.join(map(repr, self.children))})"
         return name
 
+    def cache_key(self) -> tuple:
+        """Canonical structural key for jit-cache sharing (exec/jit_cache).
+
+        ``repr`` omits non-child parameters (LIKE patterns, regexes, round
+        scales, JSON paths, ConcatWs.sep ...), so two programs differing
+        only in such a literal would collide and silently share one
+        compiled kernel (VERDICT r5). The key includes every non-child
+        instance attribute — anything that can change the traced program —
+        plus the recursive keys of the children.
+        """
+        scalars = []
+        d = getattr(self, "__dict__", None)
+        if d:
+            for k in sorted(d):
+                if k == "children" or (k.startswith("_")
+                                       and k not in _KEY_PRIVATE_ATTRS):
+                    continue
+                v = d[k]
+                if _holds_expression(v) or callable(v):
+                    continue  # covered by children keys below
+                scalars.append((k, _canon_key_value(v)))
+        return (type(self).__name__, tuple(scalars),
+                tuple(c.cache_key() for c in self.children))
+
     # Builder sugar so tests/plans read naturally
     def __add__(self, other):
         return Add(self, _lit(other))
@@ -93,6 +117,33 @@ def _lit(v) -> Expression:
     if isinstance(v, Expression):
         return v
     return Literal.of(v)
+
+
+# Private attrs that are semantic parameters, not caches: dataclass fields
+# (ColumnRef/Literal dtypes) and the explicit ``_params`` rebuild tuples.
+_KEY_PRIVATE_ATTRS = ("_params", "_dtype", "_nullable")
+
+
+def _holds_expression(v) -> bool:
+    if isinstance(v, Expression):
+        return True
+    if isinstance(v, (tuple, list)):
+        return any(_holds_expression(x) for x in v)
+    return False
+
+
+def _canon_key_value(v):
+    """Stable hashable form of a non-child expression parameter."""
+    if isinstance(v, (str, int, float, bool, bytes)) or v is None:
+        return v
+    if isinstance(v, (tuple, list)):
+        return tuple(_canon_key_value(x) for x in v)
+    return repr(v)  # DataType, Decimal, date, ... — reprs are canonical
+
+
+def exprs_cache_key(exprs) -> tuple:
+    """cache_key over a sequence of expressions (shared_jit call sites)."""
+    return tuple(e.cache_key() for e in exprs)
 
 
 def referenced_columns(expr: Expression) -> Tuple[int, ...]:

@@ -45,6 +45,10 @@ CATALOG: "List[Tuple[str, str, str]]" = [
     ("filecache_miss_bytes_total", "counter",
      "Bytes read through on filecache misses"),
     ("filecache_cached_bytes", "gauge", "Bytes currently held by filecaches"),
+    ("jit_cache_hit_total", "counter", "shared_jit lookups served from cache"),
+    ("jit_cache_miss_total", "counter",
+     "shared_jit entries traced+compiled (distinct programs)"),
+    ("jit_cache_size", "gauge", "Distinct jitted programs currently cached"),
 ]
 
 
@@ -87,6 +91,8 @@ def snapshot() -> Dict[str, int]:
         out["filecache_hit_bytes_total"] += fc.hit_bytes
         out["filecache_miss_bytes_total"] += fc.miss_bytes
         out["filecache_cached_bytes"] += fc.cached_bytes
+    from spark_rapids_tpu.exec import jit_cache as _jc
+    out.update(_jc.cache_stats())
     return out
 
 

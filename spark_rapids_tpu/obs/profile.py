@@ -149,6 +149,8 @@ class QueryProfile:
                     continue
                 cells.append(f"{k.removesuffix('Ns')}={_ns_ms(v)}ms"
                              if k.endswith("Ns") else f"{k}={v}")
+            if "fused" in node:
+                cells.append(f"fused=#{node['fused']}")
             lines.append(f"{pad}{prefix}{node['description']}  "
                          f"[{' '.join(cells)}]" if cells else
                          f"{pad}{prefix}{node['description']}")
@@ -168,19 +170,45 @@ class QueryProfile:
 
 def collect_node_stats(root) -> List[Dict]:
     """Pre-order walk of an exec tree -> plain per-node dicts (node id,
-    depth, parent, description, enabled metric values)."""
+    depth, parent, description, enabled metric values).
+
+    Fused-stage constituents (exec/fused.py) are not structural children
+    but still carry attributed row/batch metrics; they are emitted as
+    extra rows right under their stage, tagged ``fused=<stage id>``, with
+    the stage's opTime split evenly across them so per-operator cost
+    stays visible in explain_analyze and the Chrome trace."""
     out: List[Dict] = []
 
     def walk(node, depth: int, parent: Optional[int]):
         nid = len(out)
+        snap = node.metrics_snapshot()
         out.append({
             "id": nid,
             "parent": parent,
             "depth": depth,
             "name": type(node).__name__,
             "description": node.node_description(),
-            "metrics": node.metrics_snapshot(),
+            "metrics": snap,
         })
+        fused = list(getattr(node, "fused_ops", ()))
+        if fused:
+            share = snap.get("opTime", 0) // len(fused)
+            for op in reversed(fused):  # top-down like the plan tree
+                m = op.metrics_snapshot()
+                m["opTime"] = m.get("opTime", 0) + share
+                fid = len(out)
+                out.append({
+                    "id": fid,
+                    "parent": nid,
+                    "depth": depth + 1,
+                    "name": type(op).__name__,
+                    "description": op.node_description(),
+                    "metrics": m,
+                    "fused": nid,
+                })
+                if len(op.children) == 2:
+                    # absorbed join: its build subtree executed for real
+                    walk(op.children[1], depth + 2, fid)
         for c in node.children:
             walk(c, depth + 1, nid)
 

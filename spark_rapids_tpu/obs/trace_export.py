@@ -69,6 +69,11 @@ def node_spans_to_chrome(nodes: Iterable[Dict],
         op_ns = node.get("metrics", {}).get("opTime", 0)
         out.append(_meta("thread_name", tid,
                          f"op:{node.get('name', f'node{i}')}"))
+        args = {k: v for k, v in node.get("metrics", {}).items()}
+        if "fused" in node:
+            # fused-stage constituent: attributed share of the stage's
+            # one-dispatch-per-batch body (exec/fused.py)
+            args["fused"] = node["fused"]
         out.append({
             "ph": "X",
             "name": node.get("description", node.get("name", f"node{i}")),
@@ -77,7 +82,7 @@ def node_spans_to_chrome(nodes: Iterable[Dict],
             "tid": tid,
             "ts": 0.0,
             "dur": op_ns / 1e3,
-            "args": {k: v for k, v in node.get("metrics", {}).items()},
+            "args": args,
         })
     return out
 

@@ -602,6 +602,11 @@ class Overrides:
         if self.conf[_cbo.CBO_ENABLED]:
             _cbo.CostBasedOptimizer(self.conf).optimize(meta)
         ex = self._convert(meta)
+        if C.FUSION_ENABLED.get(self.conf):
+            from spark_rapids_tpu.exec.fused import fuse_exec
+
+            ex = fuse_exec(ex, min_ops=C.FUSION_MIN_OPERATORS.get(self.conf),
+                           agg_window=C.FUSION_AGG_WINDOW.get(self.conf))
         mode = C.EXPLAIN.get(self.conf)
         if mode != "NONE":
             print(explain(meta, mode))

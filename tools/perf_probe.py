@@ -15,7 +15,39 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+
+# ---------------------------------------------------------------------------
+# `python tools/perf_probe.py dispatch` — count jitted dispatches per warm
+# iteration with whole-stage fusion on vs off. The wrapper must be installed
+# BEFORE any spark_rapids_tpu import: operator modules capture jax.jit at
+# import time (``@partial(jax.jit, ...)`` decorators), so patching later
+# would miss every per-operator program.
+# ---------------------------------------------------------------------------
+_DISPATCH_MODE = "dispatch" in sys.argv[1:]
+_dispatches = {"n": 0}
+
+if _DISPATCH_MODE:
+    import functools
+
+    import jax as _jax_early
+
+    _orig_jit = _jax_early.jit
+
+    def _counting_jit(fun=None, **kw):
+        if fun is None:
+            return lambda f: _counting_jit(f, **kw)
+        jitted = _orig_jit(fun, **kw)
+
+        @functools.wraps(fun)
+        def wrapper(*a, **k):
+            _dispatches["n"] += 1
+            return jitted(*a, **k)
+
+        return wrapper
+
+    _jax_early.jit = _counting_jit
 
 import jax
 import jax.numpy as jnp
@@ -181,5 +213,57 @@ def main():
     print(f"chrome trace ({len(events)} spans):", out_path)
 
 
+def dispatch_count(queries=("q1", "q3"), sf=0.005):
+    """Dispatches per warm iteration, fusion on vs off (docs/fusion.md).
+
+    Counts every call into a jitted callable during one full warm
+    execution of a planner-built query. Warming and counting use two
+    SEPARATE plan instances of the same query: compiled programs are
+    process-wide (shared_jit + module-level jax.jit), so the second
+    instance runs warm, but its shuffle exchanges have not materialized
+    yet — re-executing the SAME node would skip the whole pre-shuffle
+    pipeline (ShuffleExchangeExec writes map outputs once) and count
+    nothing. The whole-stage fusion claim is that this count drops by
+    >= 2x: one program per stage per batch (windowed for aggregates)
+    instead of one per operator per batch.
+    """
+    from spark_rapids_tpu.bench import tpch
+    from spark_rapids_tpu.config.conf import RapidsConf
+
+    tables = tpch.tables_for(sf, seed=3)
+    results = {}
+    for qn in queries:
+        per = {}
+        for fused in (False, True):
+            conf = RapidsConf(
+                {"spark.rapids.tpu.sql.fusion.enabled": fused})
+
+            def fresh_plan():
+                d = tpch.df_tables(tables, conf, shuffle_partitions=2,
+                                   partitions=2, batch_rows=512)
+                return tpch.DF_QUERIES[qn](d).physical_plan()
+
+            def run_once(node):
+                for p in range(node.num_partitions()):
+                    for _ in node.execute(p):
+                        pass
+
+            run_once(fresh_plan())  # warm: trace + compile
+            node = fresh_plan()
+            _dispatches["n"] = 0
+            run_once(node)
+            per["fused" if fused else "classic"] = _dispatches["n"]
+        per["ratio"] = round(per["classic"] / max(per["fused"], 1), 2)
+        results[qn] = per
+        print(f"{qn}: classic={per['classic']} fused={per['fused']} "
+              f"ratio={per['ratio']}x", file=sys.stderr, flush=True)
+    print(json.dumps({"dispatch_counts_per_iteration": results,
+                      "sf": sf, "batch_rows": 512, "partitions": 2}))
+    return results
+
+
 if __name__ == "__main__":
-    main()
+    if _DISPATCH_MODE:
+        dispatch_count()
+    else:
+        main()
