@@ -328,6 +328,78 @@ def main(budget_s=None):
         cpu_times.append(time.perf_counter() - t0)
     cpu_h_s = min(cpu_times)
 
+    # ---- timed-run machinery (shared by both suites) --------------------
+    def timed(plan_copies, names, runs, depth, rotate):
+        times = []
+        it = 0
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            outs = []
+            for _ in range(depth):
+                plans = plan_copies[it % len(plan_copies) if rotate else 0]
+                it += 1
+                for qn in names:
+                    outs.append(run_plan(plans[qn])[1])
+            fence(outs)
+            times.append((time.perf_counter() - t0) / depth)
+        return min(times), sorted(times)[len(times) // 2]
+
+    def warm_and_time(plan_copies, names, frac):
+        """Warm every copy (compile + first run), size RUNS/DEPTH to the
+        budget share ``frac`` of what's left, then run the fresh-input and
+        reused-buffer timings. Returns (fresh, reused, t_iter); reused is
+        (None, None) when the budget cannot afford the comparison pass."""
+        t_iter = time.perf_counter()
+        for qn in names:
+            fence([run_plan(plan_copies[0][qn])[1]])
+        t_iter = time.perf_counter() - t_iter
+        for plans in plan_copies[1:]:
+            for qn in names:
+                fence([run_plan(plans[qn])[1]])
+        runs, depth = RUNS, DEPTH
+        do_reused = True
+        if bud.enabled:
+            # fresh blocks cost ~runs*depth iterations; reused doubles that
+            avail = max(frac * bud.remaining(), t_iter)
+            while runs * depth * t_iter * 2 > avail and (runs > 1 or depth > 1):
+                if depth > 1:
+                    depth -= 1
+                else:
+                    runs -= 1
+            do_reused = runs * depth * t_iter * 2 * 2 <= avail
+            _mark(f"budget: RUNS={runs} DEPTH={depth} reused={do_reused} "
+                  f"(iter~{t_iter:.1f}s, {bud.remaining():.0f}s left)")
+        fresh = timed(plan_copies, names, runs, depth, rotate=True)
+        reused = (timed(plan_copies, names, runs, depth, rotate=False)
+                  if do_reused else (None, None))
+        return fresh, reused, t_iter
+
+    def _r(v, nd):
+        return round(v, nd) if v is not None else None
+
+    def suite_line(suite, fresh, reused, cpu_s, rows):
+        """Per-suite metric line, flushed the moment the suite is measured —
+        a run killed during a later suite's setup still reports this one."""
+        print(json.dumps({
+            "suite": suite,
+            "s_per_iter": {"fresh_min": round(fresh[0], 4),
+                           "fresh_median": round(fresh[1], 4),
+                           "reused_min": _r(reused[0], 4),
+                           "reused_median": _r(reused[1], 4)},
+            "cpu_s": round(cpu_s, 3),
+            "rows_per_sec": round(rows / fresh[0], 1),
+        }), flush=True)
+
+    # ---- TPC-H timed runs (metric line lands BEFORE TPC-DS setup) ------
+    _mark("tpch warmup + timed runs")
+    # TPC-DS is still ahead: spend at most half the remaining budget here
+    h_fresh, h_reused, t_iter_h = warm_and_time(h_plans, h_names, 0.5)
+    li, orders, cust = base_h["lineitem"], base_h["orders"], base_h["customer"]
+    rows_h = (2 * li.num_rows                       # q1 + q6
+              + li.num_rows + orders.num_rows + cust.num_rows   # q3
+              + li.num_rows + orders.num_rows + cust.num_rows)  # q5
+    suite_line("tpch", h_fresh, h_reused, cpu_h_s, rows_h)
+
     # ---- TPC-DS sources + plans -----------------------------------------
     _mark("tpcds gen+plans")
     t_gen_ds = time.perf_counter()
@@ -362,63 +434,13 @@ def main(budget_s=None):
                     for r in batch_to_arrow(b, node.output_schema).to_pylist()]
         assert _rows_match(dev_rows, cpu_rows), f"tpcds {qn} mismatch"
 
-    _mark("warmup")
-    # ---- timed runs ------------------------------------------------------
-    runs, depth = RUNS, DEPTH
-
-    def timed(plan_copies, names, depth, rotate):
-        times = []
-        it = 0
-        for _ in range(runs):
-            t0 = time.perf_counter()
-            outs = []
-            for _ in range(depth):
-                plans = plan_copies[it % len(plan_copies) if rotate else 0]
-                it += 1
-                for qn in names:
-                    outs.append(run_plan(plans[qn])[1])
-            fence(outs)
-            times.append((time.perf_counter() - t0) / depth)
-        return min(times), sorted(times)[len(times) // 2]
-
-    # warm every copy (compile + first run) before timing; the warm pass
-    # over copy 0 doubles as the per-iteration cost estimate budget mode
-    # sizes RUNS/DEPTH from
-    t_iter = time.perf_counter()
-    for qn in h_names:
-        fence([run_plan(h_plans[0][qn])[1]])
-    for qn in TPCDS_QUERIES:
-        fence([run_plan(ds_plans[0][qn])[1]])
-    t_iter = time.perf_counter() - t_iter
-    for plans in h_plans[1:]:
-        for qn in h_names:
-            fence([run_plan(plans[qn])[1]])
-    for plans in ds_plans[1:]:
-        for qn in TPCDS_QUERIES:
-            fence([run_plan(plans[qn])[1]])
-
-    do_reused = True
-    if bud.enabled:
-        # fresh blocks cost ~runs*depth iterations per suite; reused blocks
-        # double that. Reserve ~25% of what's left for roofline + output.
-        avail = max(0.75 * bud.remaining(), t_iter)
-        while runs * depth * t_iter * 2 > avail and (runs > 1 or depth > 1):
-            if depth > 1:
-                depth -= 1
-            else:
-                runs -= 1
-        do_reused = runs * depth * t_iter * 2 * 2 <= avail
-        _mark(f"budget: RUNS={runs} DEPTH={depth} reused={do_reused} "
-              f"(iter~{t_iter:.1f}s, {bud.remaining():.0f}s left)")
-
-    _mark("timed runs")
-    h_fresh = timed(h_plans, h_names, depth, rotate=True)
-    ds_fresh = timed(ds_plans, TPCDS_QUERIES, depth, rotate=True)
-    if do_reused:
-        h_reused = timed(h_plans, h_names, depth, rotate=False)
-        ds_reused = timed(ds_plans, TPCDS_QUERIES, depth, rotate=False)
-    else:
-        h_reused = ds_reused = (None, None)
+    # ---- TPC-DS timed runs ----------------------------------------------
+    _mark("tpcds warmup + timed runs")
+    ds_fresh, ds_reused, t_iter_ds = warm_and_time(
+        ds_plans, TPCDS_QUERIES, 0.75)
+    rows_ds = sum(base_ds["store_sales"].num_rows for _ in TPCDS_QUERIES)
+    suite_line("tpcds", ds_fresh, ds_reused, cpu_ds_s, rows_ds)
+    t_iter = t_iter_h + t_iter_ds
 
     roofline = None
     if not bud.enabled or bud.remaining() > 20:
@@ -469,7 +491,6 @@ def main(budget_s=None):
     def q_bytes(table, cols):
         return sum(table.column(c).nbytes for c in cols)
 
-    li, orders, cust = base_h["lineitem"], base_h["orders"], base_h["customer"]
     bytes_h = (
         q_bytes(li, ["l_shipdate", "l_discount", "l_quantity",
                      "l_extendedprice"])
@@ -485,19 +506,11 @@ def main(budget_s=None):
         + q_bytes(orders, ["o_orderkey", "o_custkey", "o_orderdate"])
         + q_bytes(cust, ["c_custkey", "c_nationkey"])
     )
-    rows_h = (2 * li.num_rows                       # q1 + q6
-              + li.num_rows + orders.num_rows + cust.num_rows   # q3
-              + li.num_rows + orders.num_rows + cust.num_rows)  # q5
-    rows_ds = sum(base_ds["store_sales"].num_rows for _ in TPCDS_QUERIES)
-
     total_fresh = h_fresh[0] + ds_fresh[0]
     total_med = h_fresh[1] + ds_fresh[1]
     cpu_total = cpu_h_s + cpu_ds_s
     util = ((bytes_h / h_fresh[0]) / roofline
             if roofline is not None else None)
-
-    def _r(v, nd):
-        return round(v, nd) if v is not None else None
 
     print(json.dumps({
         "tpch_s_per_iter": {"fresh_min": round(h_fresh[0], 4),

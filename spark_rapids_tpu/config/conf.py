@@ -429,6 +429,38 @@ GATHER_FUSION_ENABLED = conf(
         "packed-matrix gather); disable only to debug kernel issues.")
 
 
+# ---------------------------------------------------------------------------
+# Round-7 async pipeline knobs (exec/pipeline.py; docs/async_pipeline.md)
+# ---------------------------------------------------------------------------
+
+PREFETCH_ENABLED = conf(
+    "spark.rapids.tpu.sql.prefetch.enabled", default=True,
+    doc="Run batch iterators ahead of their consumer at pipeline-breaking "
+        "boundaries (scan, shuffle read, CPU->TPU transitions): a background "
+        "worker drives the producer into a bounded queue so host decode, "
+        "device upload, and compute overlap instead of running in lockstep "
+        "(exec/pipeline.py; the MultiFileCloudParquetPartitionReader "
+        "read-ahead analog). Queued device batches are accounted with the "
+        "HBM pool; under memory pressure the queue sheds and execution "
+        "degrades to synchronous.")
+
+PREFETCH_DEPTH = conf(
+    "spark.rapids.tpu.sql.prefetch.depth", default=2,
+    doc="Batches a prefetch boundary may hold ready ahead of its consumer. "
+        "Each queued batch is pool-accounted, so deeper queues trade HBM "
+        "headroom for overlap.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SHUFFLE_WRITE_THREADS = conf(
+    "spark.rapids.tpu.shuffle.writeThreads", default=4,
+    doc="Map partitions a shuffle exchange materializes concurrently. "
+        "Partition 0 always runs on the calling thread first (it primes "
+        "lazy operator state the remaining map tasks share read-only); the "
+        "rest are partitioned/serialized on a threadpool of this size. "
+        "1 restores the fully serial write.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+
 _ACTIVE: "Optional[RapidsConf]" = None
 
 

@@ -30,3 +30,8 @@ from spark_rapids_tpu.exec.misc import (  # noqa: F401
     take_ordered_and_project,
 )
 from spark_rapids_tpu.exec.generate import GenerateExec  # noqa: F401
+from spark_rapids_tpu.exec.pipeline import (  # noqa: F401
+    PrefetchExec,
+    PrefetchIterator,
+    insert_prefetch,
+)

@@ -23,6 +23,7 @@ Aggregate buffer layout per function (Spark-exact result types):
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -141,6 +142,7 @@ class HashAggregateExec(UnaryExec):
         self.group_exprs = list(group_exprs)
         self.agg_exprs = list(agg_exprs)
         self._prepared = False
+        self._prepare_lock = threading.Lock()
         self._register_metric("numAggBatches")
         self._register_metric("concatTimeNs")
 
@@ -148,6 +150,12 @@ class HashAggregateExec(UnaryExec):
     def _prepare(self):
         if self._prepared:
             return
+        with self._prepare_lock:
+            if self._prepared:
+                return
+            self._prepare_locked()
+
+    def _prepare_locked(self):
         in_schema = self.child.output_schema
         self._group_bound = [E.resolve(e, in_schema) for e in self.group_exprs]
         self._group_names = [

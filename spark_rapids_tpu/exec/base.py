@@ -10,6 +10,7 @@ between partition counts.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Iterator, List, Optional, Sequence
 
@@ -62,9 +63,13 @@ def set_sync_metrics(enabled: bool) -> None:
 
 
 class Metric:
-    """Accumulating metric, summed across partitions (GpuMetric analog)."""
+    """Accumulating metric, summed across partitions (GpuMetric analog).
 
-    __slots__ = ("name", "level", "value", "enabled")
+    ``add`` is locked: scan decode pools, upload stagers, prefetch workers
+    and parallel shuffle-write tasks all enter the same operator's timers
+    concurrently, and ``value += v`` alone would drop updates."""
+
+    __slots__ = ("name", "level", "value", "enabled", "_lock")
 
     def __init__(self, name: str, level: int = MODERATE,
                  enabled: bool = True):
@@ -72,9 +77,11 @@ class Metric:
         self.level = level
         self.value = 0
         self.enabled = enabled
+        self._lock = threading.Lock()
 
     def add(self, v) -> None:
-        self.value += v
+        with self._lock:
+            self.value += v
 
     def __repr__(self):
         return f"{self.name}={self.value}"
@@ -120,8 +127,11 @@ class TpuExec:
         self._register_metric("opTime", ESSENTIAL)
         # row counts are traced device scalars; summing them eagerly would
         # force a host sync per batch per operator and kill async dispatch
-        # pipelining — they are resolved lazily in collect_metrics
+        # pipelining — they are resolved lazily in collect_metrics. The lock
+        # covers concurrent partitions of one operator (parallel shuffle
+        # writes / prefetch workers).
         self._pending_rows: List = []
+        self._rows_lock = threading.Lock()
 
     # -- schema / partitioning --------------------------------------------
     @property
@@ -164,14 +174,18 @@ class TpuExec:
             tracing.record_event(name, t0, t1 - t0,
                                  args={"partition": partition})
             self.metrics["numOutputBatches"].add(1)
-            self._pending_rows.append(batch.num_rows)
-            if len(self._pending_rows) >= 64:
+            with self._rows_lock:
+                self._pending_rows.append(batch.num_rows)
+                fold = (list(self._pending_rows)
+                        if len(self._pending_rows) >= 64 else None)
+                if fold is not None:
+                    self._pending_rows.clear()
+            if fold is not None:
                 # fold into the host counter; the early scalars are long done
                 # by now so this rarely blocks, and it bounds retained buffers
                 self.metrics["numOutputRows"].add(
-                    sum(int(n) for n in self._pending_rows)
+                    sum(int(n) for n in fold)
                 )
-                self._pending_rows.clear()
             yield batch
 
     def execute_all(self) -> Iterator[ColumnarBatch]:
@@ -226,11 +240,13 @@ class TpuExec:
     def metrics_snapshot(self) -> Dict[str, int]:
         """This node's enabled metric values (pending device row scalars
         folded in first)."""
-        if self._pending_rows:
-            self.metrics["numOutputRows"].add(
-                sum(int(n) for n in self._pending_rows)
-            )
+        with self._rows_lock:
+            pending = list(self._pending_rows)
             self._pending_rows.clear()
+        if pending:
+            self.metrics["numOutputRows"].add(
+                sum(int(n) for n in pending)
+            )
         return {m.name: m.value for m in self.metrics.values() if m.enabled}
 
     def collect_metrics(self) -> Dict[str, int]:

@@ -25,6 +25,7 @@ output from join row counts.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -62,6 +63,7 @@ class HashJoinExec(BinaryExec):
         self.right_keys = list(right_keys)
         self.condition = condition
         self._prepared = False
+        self._prepare_lock = threading.Lock()
         self._register_metric("buildTimeNs")
         self._register_metric("joinTimeNs")
         self._register_metric("numCandidatePairs")
@@ -70,6 +72,12 @@ class HashJoinExec(BinaryExec):
     def _prepare(self):
         if self._prepared:
             return
+        with self._prepare_lock:
+            if self._prepared:
+                return
+            self._prepare_locked()
+
+    def _prepare_locked(self):
         ls, rs = self.left.output_schema, self.right.output_schema
         self._lkeys = [self._key_index(k, ls) for k in self.left_keys]
         self._rkeys = [self._key_index(k, rs) for k in self.right_keys]

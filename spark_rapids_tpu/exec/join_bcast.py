@@ -19,6 +19,7 @@ output capacity, exactly like the hash join.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -58,24 +59,29 @@ class BroadcastHashJoinExec(HashJoinExec):
         super().__init__(left_keys, right_keys, join_type, left, right,
                          condition)
         self._broadcast = None
+        self._bcast_lock = threading.Lock()
         self._register_metric("broadcastTimeNs")
 
     def num_partitions(self) -> int:
         return self.left.num_partitions()
 
     def _build_broadcast(self):
-        if self._broadcast is None:
-            with self.timer("broadcastTimeNs"):
-                batches = list(self.right.execute_all())
-                if batches:
-                    build = (batches[0] if len(batches) == 1
-                             else concat_jit(batches))
-                else:
-                    build = empty_batch(self.right.output_schema.types(), 16)
-                jh = jax.jit(K.prepare_join_side, static_argnums=1)(
-                    build, tuple(self._rkeys))
-            self._broadcast = (build, jh)
-        return self._broadcast
+        # locked: probe partitions run concurrently under parallel shuffle
+        # writes / prefetch workers, and the build must execute exactly once
+        with self._bcast_lock:
+            if self._broadcast is None:
+                with self.timer("broadcastTimeNs"):
+                    batches = list(self.right.execute_all())
+                    if batches:
+                        build = (batches[0] if len(batches) == 1
+                                 else concat_jit(batches))
+                    else:
+                        build = empty_batch(
+                            self.right.output_schema.types(), 16)
+                    jh = jax.jit(K.prepare_join_side, static_argnums=1)(
+                        build, tuple(self._rkeys))
+                self._broadcast = (build, jh)
+            return self._broadcast
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._prepare()
@@ -135,6 +141,7 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
         self.condition = condition
         self.build_chunk_rows = build_chunk_rows
         self._broadcast = None
+        self._bcast_lock = threading.Lock()
         self._prepared = False
         self._register_metric("joinTimeNs")
 
@@ -170,15 +177,16 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
                    else ""))
 
     def _build_side(self) -> ColumnarBatch:
-        if self._broadcast is None:
-            batches = list(self.right.execute_all())
-            if batches:
-                self._broadcast = (batches[0] if len(batches) == 1
-                                   else concat_jit(batches))
-            else:
-                self._broadcast = empty_batch(
-                    self.right.output_schema.types(), 16)
-        return self._broadcast
+        with self._bcast_lock:
+            if self._broadcast is None:
+                batches = list(self.right.execute_all())
+                if batches:
+                    self._broadcast = (batches[0] if len(batches) == 1
+                                       else concat_jit(batches))
+                else:
+                    self._broadcast = empty_batch(
+                        self.right.output_schema.types(), 16)
+            return self._broadcast
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._prepare()

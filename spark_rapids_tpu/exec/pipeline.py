@@ -1,0 +1,285 @@
+"""Asynchronous pipeline layer: overlap host decode, device upload, compute,
+and shuffle I/O.
+
+Reference: GpuParquetScan.scala:2346 (MultiFileCloudParquetPartitionReader —
+host threads read+decode the NEXT buffers while the task computes on the
+current one and only then touches the device) and the multithreaded shuffle
+writer/reader pools. The TPU analog generalizes the idea into one primitive:
+
+  ``PrefetchIterator`` drives ANY batch iterator from a background worker
+  into a bounded queue, so the producer's host work (parquet decode,
+  dictionary encode, ``batch_from_arrow`` upload dispatch, shuffle block
+  concat) runs while the consumer computes on earlier batches. JAX's async
+  dispatch does the rest: an upload issued by the worker is merely enqueued
+  on the device stream, and downstream jitted compute chains onto it without
+  a host sync.
+
+``PrefetchExec`` is the plan-level wrapper ``Overrides.apply`` inserts at
+pipeline-breaking boundaries (scan, shuffle read, CPU->TPU transitions)
+behind ``spark.rapids.tpu.sql.prefetch.enabled``.
+
+Memory safety: every queued device batch is accounted with the HBM pool
+(mem/pool.py). When the pool cannot admit a prefetched batch the queue
+SHEDS — the worker stops, the batch in hand is delivered unaccounted, and
+the consumer degrades to pulling the source synchronously. Prefetching
+therefore never deepens an OOM; it only uses headroom that exists.
+
+Observability: the worker emits Chrome-trace spans from its own thread (the
+exporter assigns one track per thread, so prefetch lanes separate visually),
+and the module-level ``STATS`` feed the ``srtpu_prefetch_{depth,stalls,
+sheds}`` gauges (obs/gauges.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterator, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
+from spark_rapids_tpu.utils import tracing
+
+
+class PrefetchStats:
+    """Process-wide prefetch counters (srtpu_prefetch_* gauge source)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0   # batches currently sitting in prefetch queues
+        self.stalls = 0  # consumer arrivals that found the queue empty
+        self.sheds = 0   # queues degraded to synchronous on RetryOOM
+
+    def add(self, field: str, v: int) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + v)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"prefetch_depth": self.depth,
+                    "prefetch_stalls": self.stalls,
+                    "prefetch_sheds": self.sheds}
+
+
+STATS = PrefetchStats()
+
+_ITEM, _DONE, _SHED, _ERROR = "item", "done", "shed", "error"
+
+
+def _item_nbytes(item) -> int:
+    """Device/host footprint of a queued item for pool accounting."""
+    if isinstance(item, ColumnarBatch):
+        return item.nbytes()
+    nb = getattr(item, "nbytes", None)
+    if isinstance(nb, int):  # pa.Table exposes nbytes as a property
+        return nb
+    return 0
+
+
+class PrefetchIterator:
+    """Drive ``source`` from a background worker into a bounded queue.
+
+    The consumer iterates this object; ``close()`` (idempotent) stops the
+    worker, drains accounting, and closes the source. Exceptions raised by
+    the source propagate to the consumer at its next ``next()``.
+
+    ``account=False`` disables HBM-pool registration (host-side sources
+    whose footprint the pool does not track).
+    """
+
+    def __init__(self, source, depth: int = 2, label: str = "prefetch",
+                 account: bool = True):
+        self._source = iter(source)
+        self._label = label
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._direct = False    # post-shed: consumer pulls source itself
+        self._finished = False
+        self._closed = False
+        self._pool = None
+        if account:
+            try:
+                from spark_rapids_tpu.mem.pool import get_pool
+                self._pool = get_pool()
+            except Exception:
+                self._pool = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"srtpu-prefetch-{label}", daemon=True)
+        self._thread.start()
+
+    # -- worker ------------------------------------------------------------
+    def _run(self) -> None:
+        from spark_rapids_tpu.mem.pool import RetryOOM
+
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter_ns()
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    self._q.put((_DONE, None, 0))
+                    return
+                tracing.record_event(f"prefetch:{self._label}", t0,
+                                     time.perf_counter_ns() - t0)
+                nbytes = _item_nbytes(item)
+                if self._pool is not None and nbytes:
+                    try:
+                        self._pool.allocate(nbytes)
+                    except RetryOOM:
+                        # no headroom for read-ahead: hand over the batch in
+                        # hand unaccounted and degrade to synchronous pulls
+                        STATS.add("sheds", 1)
+                        self._put((_ITEM, item, 0))
+                        self._q.put((_SHED, None, 0))
+                        return
+                if not self._put((_ITEM, item, nbytes)):
+                    return  # closed while blocked on a full queue
+        except BaseException as e:  # noqa: BLE001 — must reach the consumer
+            self._q.put((_ERROR, e, 0))
+
+    def _put(self, entry) -> bool:
+        """Blocking put that stays responsive to close(); returns False (and
+        un-accounts the entry) when the iterator was closed meanwhile."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(entry, timeout=0.05)
+                if entry[0] == _ITEM:
+                    STATS.add("depth", 1)
+                return True
+            except queue.Full:
+                continue
+        if entry[0] == _ITEM and entry[2] and self._pool is not None:
+            self._pool.release(entry[2])
+        return False
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        while True:
+            if self._direct:
+                try:
+                    return next(self._source)
+                except StopIteration:
+                    self._finished = True
+                    raise
+            try:
+                tag, payload, nbytes = self._q.get_nowait()
+            except queue.Empty:
+                STATS.add("stalls", 1)
+                tag, payload, nbytes = self._q.get()
+            if tag == _ITEM:
+                STATS.add("depth", -1)
+                if nbytes and self._pool is not None:
+                    self._pool.release(nbytes)
+                return payload
+            if tag == _DONE:
+                self._finished = True
+                raise StopIteration
+            if tag == _SHED:
+                # the worker has exited; everything it produced was already
+                # dequeued (FIFO), so the source is ours now
+                self._direct = True
+                continue
+            self._finished = True
+            raise payload  # _ERROR
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._drain()
+        self._thread.join()
+        self._drain()  # entries put between the first drain and the join
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            close()
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                tag, _payload, nbytes = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if tag == _ITEM:
+                STATS.add("depth", -1)
+                if nbytes and self._pool is not None:
+                    self._pool.release(nbytes)
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class PrefetchExec(UnaryExec):
+    """Transparent boundary operator running its child's iterator ahead on a
+    background worker. Schema/partitioning delegate to the child; batch_fn
+    stays None so the fusion pass treats it as a barrier (it IS the stage
+    seam being overlapped)."""
+
+    def __init__(self, child: TpuExec, depth: int = 2):
+        super().__init__(child)
+        self.depth = depth
+
+    def node_description(self) -> str:
+        return f"TpuPrefetch(depth={self.depth})"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        label = f"{type(self.child).__name__}#p{partition}"
+        it = PrefetchIterator(self.child.execute(partition),
+                              depth=self.depth, label=label)
+        try:
+            yield from it
+        finally:
+            it.close()
+
+
+def prefetch_settings(conf=None):
+    """(enabled, depth) from ``conf`` or the active session conf."""
+    from spark_rapids_tpu.config import conf as C
+    cfg = conf if conf is not None else C.get_active()
+    return C.PREFETCH_ENABLED.get(cfg), C.PREFETCH_DEPTH.get(cfg)
+
+
+def insert_prefetch(ex: TpuExec, conf) -> TpuExec:
+    """Wrap pipeline-breaking boundaries of a converted plan in PrefetchExec.
+
+    Boundaries: file scans (decode/upload lane), shuffle exchanges and AQE
+    readers (shuffle-read lane), and CpuExec subtrees consumed by a device
+    parent (CPU->TPU transition). An exchange directly under an AQE reader
+    is left bare — the reader addresses the exchange's shuffle registration
+    itself, not its batch iterator.
+    """
+    enabled, depth = prefetch_settings(conf)
+    if not enabled:
+        return ex
+    from spark_rapids_tpu.exec.scan import FileScanBase
+    from spark_rapids_tpu.plan.cpu import CpuExec
+    from spark_rapids_tpu.shuffle.aqe import AQEShuffleReadExec
+    from spark_rapids_tpu.shuffle.exchange_exec import ShuffleExchangeExec
+
+    def walk(node: TpuExec, parent: Optional[TpuExec]) -> TpuExec:
+        for i, ch in enumerate(node.children):
+            node.children[i] = walk(ch, node)
+        if isinstance(node, PrefetchExec):
+            return node
+        if (isinstance(node, ShuffleExchangeExec)
+                and isinstance(parent, AQEShuffleReadExec)):
+            return node
+        if isinstance(node, (FileScanBase, ShuffleExchangeExec,
+                             AQEShuffleReadExec)):
+            return PrefetchExec(node, depth)
+        if (isinstance(node, CpuExec) and parent is not None
+                and not isinstance(parent, CpuExec)):
+            return PrefetchExec(node, depth)
+        return node
+
+    return walk(ex, None)

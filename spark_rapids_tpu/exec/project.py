@@ -9,6 +9,7 @@ reference's tiered-projection CSE, basicPhysicalOperators.scala:806).
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Sequence
 
 import jax
@@ -29,16 +30,21 @@ class ProjectExec(UnaryExec):
         self._bound = None
         self._ansi = ansi
         self._schema = None
+        # parallel shuffle-write tasks / prefetch workers can hit a cold
+        # node concurrently; RLock because batch_fn_key re-enters _bind
+        self._bind_lock = threading.RLock()
 
     def _bind(self):
-        if self._bound is None:
-            self._bound = tuple(
-                EV.bind_projection(self.exprs, self.child.output_schema)
-            )
-            self._schema = EV.output_schema(self._bound)
-            from spark_rapids_tpu.exec.jit_cache import shared_jit
+        with self._bind_lock:
+            if self._bound is None:
+                self._bound = tuple(
+                    EV.bind_projection(self.exprs, self.child.output_schema)
+                )
+                self._schema = EV.output_schema(self._bound)
+                from spark_rapids_tpu.exec.jit_cache import shared_jit
 
-            self._run = shared_jit(self.batch_fn_key(), lambda: self.batch_fn())
+                self._run = shared_jit(self.batch_fn_key(),
+                                       lambda: self.batch_fn())
         return self._bound
 
     @property
@@ -77,13 +83,17 @@ class FilterExec(UnaryExec):
         self.condition = condition
         self._bound = None
         self._ansi = ansi
+        self._bind_lock = threading.RLock()
 
     def _bind(self):
-        if self._bound is None:
-            self._bound = E.resolve(self.condition, self.child.output_schema)
-            from spark_rapids_tpu.exec.jit_cache import shared_jit
+        with self._bind_lock:
+            if self._bound is None:
+                self._bound = E.resolve(self.condition,
+                                        self.child.output_schema)
+                from spark_rapids_tpu.exec.jit_cache import shared_jit
 
-            self._run = shared_jit(self.batch_fn_key(), lambda: self.batch_fn())
+                self._run = shared_jit(self.batch_fn_key(),
+                                       lambda: self.batch_fn())
         return self._bound
 
     def node_description(self) -> str:

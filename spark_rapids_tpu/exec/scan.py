@@ -44,7 +44,12 @@ def _combine_window() -> int:
 
 def windowed_map(pool, fn, items, window: int):
     """pool.map with a bounded in-flight window: keeps reads overlapped with
-    consumption without materializing every decoded table."""
+    consumption without materializing every decoded table.
+
+    On generator close, queued futures are cancelled AND already-running
+    calls are awaited (never abandoned mid-decode): a read interrupted
+    between its submit and its result would otherwise keep mutating shared
+    operator state (timers, filecaches) after the pool's owner moved on."""
     items = iter(items)
     inflight = deque()
     try:
@@ -57,6 +62,12 @@ def windowed_map(pool, fn, items, window: int):
     finally:
         for f in inflight:
             f.cancel()
+        for f in inflight:
+            if not f.cancelled():
+                try:
+                    f.result()
+                except Exception:
+                    pass  # surfacing close-path read errors helps nobody
 
 
 class FileScanBase(LeafExec):
@@ -158,33 +169,43 @@ class FileScanBase(LeafExec):
         _ = self.output_schema
 
         def read(it):
+            import time as _time
+
+            from spark_rapids_tpu.utils import tracing
             with self.timer("scanTimeNs"):
+                t0 = _time.perf_counter_ns()
                 t = self._take_cached(it)
                 if t is None:
                     t = self._read_item(it)
-                return self._project(t)
+                t = self._project(t)
+                tracing.record_event("scan:decode", t0,
+                                     _time.perf_counter_ns() - t0,
+                                     args={"rows": t.num_rows})
+                return t
 
         if self.reader_type == "PERFILE":
             yield from self.upload_batched(map(read, items))
         elif self.reader_type == "MULTITHREADED":
-            with cf.ThreadPoolExecutor(self.reader_threads) as pool:
+            pool = cf.ThreadPoolExecutor(self.reader_threads)
+            try:
                 yield from self.upload_batched(
                     windowed_map(pool, read, items,
                                  window=max(self.reader_threads,
                                             _combine_window())))
+            finally:
+                # cancel_futures drops queued reads the moment the consumer
+                # walks away; wait=True lets running decodes finish instead
+                # of abandoning them mid-read
+                pool.shutdown(wait=True, cancel_futures=True)
         else:  # COALESCING
-            whole = pa.concat_tables(read(it) for it in items)
-            yield from self.upload_batched(iter([whole]))
+            # stitch in target_batch_rows windows — upload_batched already
+            # re-chunks, so streaming the per-item reads through it bounds
+            # host memory at one batch instead of the whole partition
+            yield from self.upload_batched(read(it) for it in items)
 
-    def upload_batched(self, tables) -> Iterator[ColumnarBatch]:
-        """Re-chunk host tables to target_batch_rows and upload each once.
-
-        String columns are dictionary-encoded per uploaded batch (sorted
-        dict) so device group/sort/equality run on int32 codes. Batches do
-        NOT share dictionaries across uploads (each file chunk has its own);
-        cross-batch consumers (concat/merge) decode on mismatch."""
-        from spark_rapids_tpu.columnar.batch import dictionary_encode_table
-
+    def _rechunk(self, tables) -> Iterator[pa.Table]:
+        """Host-side re-chunk of decoded tables to target_batch_rows
+        windows (no device work)."""
         pending: List[pa.Table] = []
         pending_rows = 0
         for t in tables:
@@ -192,18 +213,60 @@ class FileScanBase(LeafExec):
             pending_rows += t.num_rows
             while pending_rows >= self.target_batch_rows:
                 whole = pa.concat_tables(pending)
-                head = whole.slice(0, self.target_batch_rows)
+                yield whole.slice(0, self.target_batch_rows)
                 rest = whole.slice(self.target_batch_rows)
-                with self.timer("uploadTimeNs"):
-                    yield batch_from_arrow(dictionary_encode_table(head),
-                                           self.min_bucket)
                 pending = [rest] if rest.num_rows else []
                 pending_rows = rest.num_rows
         if pending_rows > 0:
-            with self.timer("uploadTimeNs"):
-                yield batch_from_arrow(
-                    dictionary_encode_table(pa.concat_tables(pending)),
-                    self.min_bucket)
+            yield pa.concat_tables(pending)
+
+    def _stage_upload(self, t: pa.Table) -> ColumnarBatch:
+        """Dictionary-encode + upload one chunk (the staging lane's unit of
+        work; batch_from_arrow only dispatches the device_put, so the
+        consumer's compute chains onto it asynchronously)."""
+        import time as _time
+
+        from spark_rapids_tpu.columnar.batch import dictionary_encode_table
+        from spark_rapids_tpu.utils import tracing
+
+        with self.timer("uploadTimeNs"):
+            t0 = _time.perf_counter_ns()
+            b = batch_from_arrow(dictionary_encode_table(t), self.min_bucket)
+            tracing.record_event("scan:upload", t0,
+                                 _time.perf_counter_ns() - t0,
+                                 args={"rows": t.num_rows})
+            return b
+
+    def upload_batched(self, tables) -> Iterator[ColumnarBatch]:
+        """Re-chunk host tables to target_batch_rows and upload each once.
+
+        String columns are dictionary-encoded per uploaded batch (sorted
+        dict) so device group/sort/equality run on int32 codes. Batches do
+        NOT share dictionaries across uploads (each file chunk has its own);
+        cross-batch consumers (concat/merge) decode on mismatch.
+
+        With prefetch enabled, encode+upload of chunk N+1 runs on a staging
+        worker while the consumer computes on chunk N — the decode pool's
+        windowed_map then feeds the stager thread, not the consumer thread.
+        """
+        from spark_rapids_tpu.exec.pipeline import (
+            PrefetchIterator, prefetch_settings)
+
+        enabled, depth = prefetch_settings()
+        chunks = self._rechunk(tables)
+        if not enabled:
+            for t in chunks:
+                yield self._stage_upload(t)
+            return
+        stager = PrefetchIterator(map(self._stage_upload, chunks),
+                                  depth=depth, label="scan-stage")
+        try:
+            yield from stager
+        finally:
+            stager.close()
+            # the stager worker is joined, so nothing is executing the
+            # chunk generator anymore: close it to unwind windowed_map
+            chunks.close()
 
 
 

@@ -49,6 +49,12 @@ CATALOG: "List[Tuple[str, str, str]]" = [
     ("jit_cache_miss_total", "counter",
      "shared_jit entries traced+compiled (distinct programs)"),
     ("jit_cache_size", "gauge", "Distinct jitted programs currently cached"),
+    ("prefetch_depth", "gauge",
+     "Batches currently held ready in prefetch queues"),
+    ("prefetch_stalls", "counter",
+     "Consumer arrivals that found a prefetch queue empty"),
+    ("prefetch_sheds", "counter",
+     "Prefetch queues degraded to synchronous execution on RetryOOM"),
 ]
 
 
@@ -93,6 +99,8 @@ def snapshot() -> Dict[str, int]:
         out["filecache_cached_bytes"] += fc.cached_bytes
     from spark_rapids_tpu.exec import jit_cache as _jc
     out.update(_jc.cache_stats())
+    from spark_rapids_tpu.exec import pipeline as _pl
+    out.update(_pl.STATS.snapshot())
     return out
 
 

@@ -128,8 +128,13 @@ class MeshExecutor:
 
     # -- recursive host/dist split ----------------------------------------
     def _exec(self, node: TpuExec) -> pa.Table:
+        from spark_rapids_tpu.exec.pipeline import PrefetchExec
         from spark_rapids_tpu.shuffle.aqe import AQEShuffleReadExec
 
+        # prefetch is a host-threading concern; inside the SPMD program the
+        # mesh schedules its own transfers — look through the wrapper
+        while isinstance(node, PrefetchExec):
+            node = node.children[0]
         marker = len(self.dist_nodes)
         try:
             return self._run_distributed(node)
@@ -329,10 +334,13 @@ class MeshExecutor:
         from spark_rapids_tpu.exec.aggregate import HashAggregateExec
         from spark_rapids_tpu.exec.join_bcast import BroadcastHashJoinExec
         from spark_rapids_tpu.exec.misc import CoalesceBatchesExec
+        from spark_rapids_tpu.exec.pipeline import PrefetchExec
         from spark_rapids_tpu.exec.project import FilterExec, ProjectExec
         from spark_rapids_tpu.shuffle.aqe import AQEShuffleReadExec
         from spark_rapids_tpu.shuffle.exchange_exec import ShuffleExchangeExec
 
+        while isinstance(node, PrefetchExec):
+            node = node.children[0]
         if isinstance(node, ProjectExec):
             low = self._mark(node, self._lower_project(node))
             return low

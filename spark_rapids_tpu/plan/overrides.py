@@ -607,6 +607,12 @@ class Overrides:
 
             ex = fuse_exec(ex, min_ops=C.FUSION_MIN_OPERATORS.get(self.conf),
                            agg_window=C.FUSION_AGG_WINDOW.get(self.conf))
+        # async pipeline boundaries go in AFTER fusion: a fused stage is one
+        # consumer, and its scan/shuffle inputs are exactly the seams the
+        # prefetch workers overlap (exec/pipeline.py)
+        from spark_rapids_tpu.exec.pipeline import insert_prefetch
+
+        ex = insert_prefetch(ex, self.conf)
         mode = C.EXPLAIN.get(self.conf)
         if mode != "NONE":
             print(explain(meta, mode))

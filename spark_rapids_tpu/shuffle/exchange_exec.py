@@ -12,7 +12,9 @@ blocks and uploads one device batch.
 
 from __future__ import annotations
 
+import concurrent.futures as cf
 import threading
+import time
 from typing import Iterator, List, Optional
 
 from spark_rapids_tpu import types as T
@@ -20,6 +22,7 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch, batch_from_arrow
 from spark_rapids_tpu.exec.base import TpuExec, UnaryExec
 from spark_rapids_tpu.shuffle.manager import ShuffleManager, get_manager
 from spark_rapids_tpu.shuffle.partition import Partitioner
+from spark_rapids_tpu.utils import tracing
 
 
 class ShuffleExchangeExec(UnaryExec):
@@ -37,6 +40,11 @@ class ShuffleExchangeExec(UnaryExec):
         self._reg = None
         self._written = False
         self._write_lock = threading.Lock()
+        # read-ahead of the next reduce partition (exec/pipeline.py lanes):
+        # partition -> Future[pa.Table | None], guarded by _ra_lock
+        self._ra: dict = {}
+        self._ra_lock = threading.Lock()
+        self._ra_pool: Optional[cf.ThreadPoolExecutor] = None
         self._register_metric("writeTimeNs")
         self._register_metric("readTimeNs")
 
@@ -47,33 +55,110 @@ class ShuffleExchangeExec(UnaryExec):
         return (f"TpuShuffleExchange {type(self.partitioner).__name__}"
                 f"({self.partitioner.num_partitions})")
 
+    @staticmethod
+    def _write_threads() -> int:
+        from spark_rapids_tpu.config import conf as _C
+        return _C.SHUFFLE_WRITE_THREADS.get(_C.get_active())
+
     def _ensure_written(self) -> None:
         with self._write_lock:
             if self._written:
                 return
             self._reg = self.manager.register(
                 self.child.output_schema, self.partitioner.num_partitions)
+
+            def write_map(p: int) -> None:
+                t0 = time.perf_counter_ns()
+                batches = list(self.child.execute(p))
+                if batches:
+                    self.manager.write_map_output(
+                        self._reg, self.partitioner, batches)
+                tracing.record_event("shuffle:write", t0,
+                                     time.perf_counter_ns() - t0,
+                                     args={"map": p})
+
+            from spark_rapids_tpu.exec.pipeline import prefetch_settings
+
+            n_maps = self.child.num_partitions()
+            # prefetch.enabled is the async-pipeline master switch: off means
+            # a fully synchronous engine (debuggability, differential runs);
+            # writeThreads only sets the width when the pipeline is on
+            threads = (min(self._write_threads(), max(1, n_maps - 1))
+                       if prefetch_settings()[0] else 1)
             with self.timer("writeTimeNs"):
-                for p in range(self.child.num_partitions()):
-                    batches = list(self.child.execute(p))
-                    if batches:
-                        self.manager.write_map_output(
-                            self._reg, self.partitioner, batches)
+                # map 0 always runs on the calling thread FIRST: it primes
+                # lazy operator state (expression binds, broadcast builds,
+                # nested exchange writes) that the remaining map tasks then
+                # share read-only
+                write_map(0)
+                rest = range(1, n_maps)
+                if threads > 1 and n_maps > 2:
+                    # a fresh pool per exchange: nested exchanges in the
+                    # child subtree spin their own, so a shared bounded pool
+                    # can never starve itself recursively
+                    pool = cf.ThreadPoolExecutor(
+                        threads, thread_name_prefix="srtpu-shufw")
+                    try:
+                        for f in [pool.submit(write_map, p) for p in rest]:
+                            f.result()
+                    finally:
+                        pool.shutdown(wait=True, cancel_futures=True)
+                else:
+                    for p in rest:
+                        write_map(p)
             self._written = True
 
     def cleanup(self) -> None:
         """Release shuffle files/blocks (called by the session once the
         query's output is consumed; Spark's ContextCleaner analog)."""
+        with self._ra_lock:
+            pool, self._ra_pool = self._ra_pool, None
+            self._ra.clear()
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
         with self._write_lock:
             if self._reg is not None:
                 self.manager.cleanup(self._reg)
                 self._reg = None
                 self._written = False
 
+    # -- read side ---------------------------------------------------------
+    def _read_table(self, partition: int):
+        t0 = time.perf_counter_ns()
+        table = self.manager.read_partition(self._reg, partition)
+        tracing.record_event("shuffle:read", t0,
+                             time.perf_counter_ns() - t0,
+                             args={"partition": partition})
+        return table
+
+    def _take_or_read(self, partition: int):
+        with self._ra_lock:
+            fut = self._ra.pop(partition, None)
+        with self.timer("readTimeNs"):
+            if fut is not None:
+                return fut.result()
+            return self._read_table(partition)
+
+    def _schedule_read_ahead(self, partition: int) -> None:
+        """Fetch+host-concat the next reduce partition's blocks in the
+        background while the current one computes downstream."""
+        from spark_rapids_tpu.exec.pipeline import prefetch_settings
+
+        nxt = partition + 1
+        if nxt >= self.num_partitions() or not prefetch_settings()[0]:
+            return
+        with self._ra_lock:
+            if nxt in self._ra:
+                return
+            if self._ra_pool is None:
+                self._ra_pool = cf.ThreadPoolExecutor(
+                    1, thread_name_prefix="srtpu-shufr")
+            self._ra[nxt] = self._ra_pool.submit(self._read_table, nxt)
+
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._ensure_written()
-        with self.timer("readTimeNs"):
-            table = self.manager.read_partition(self._reg, partition)
+        table = self._take_or_read(partition)
+        self._schedule_read_ahead(partition)
         if table is None or table.num_rows == 0:
             return
         # re-chunk to target batch size, one upload per chunk

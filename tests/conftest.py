@@ -12,6 +12,7 @@ tests must skip there (the ``cpu_mesh`` fixture below). Run via
 """
 
 import os
+import sys
 
 TPU_LANE = os.environ.get("SRTPU_TPU_LANE") == "1"
 
@@ -21,13 +22,16 @@ if not TPU_LANE:
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     )
-    # CPU lanes use a machine-local compile cache: the shared persistent
-    # cache can hold CPU AOT kernels compiled under OTHER host feature
-    # flags, which segfault (SIGILL) when loaded here
-    # (docs/perf_notes_r03.md; observed again in r5's slow-lane run)
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join("/tmp", f"srtpu_xla_cpu_{os.uname().nodename}"))
+    # CPU lanes use a compile cache keyed by the host's CPU FEATURE SET,
+    # not its nodename: a nodename-keyed cache survives container moves
+    # across different microarchitectures, and AOT kernels compiled under
+    # other feature flags SIGILL/SIGSEGV when loaded here
+    # (docs/perf_notes_r03.md; the r5/r6 slow-lane segfaults were this)
+    _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    from _xla_cpu_cache import cpu_cache_dir
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cpu_cache_dir())
 
 import jax  # noqa: E402
 

@@ -8,4 +8,5 @@ set -e
 cd "$(dirname "$0")/.."
 SRTPU_SLOW_LANE=1 exec python -m pytest \
     tests/test_distributed.py tests/test_cluster.py \
-    tests/test_tpcds.py tests/test_scaletest.py -q "$@"
+    tests/test_tpcds.py tests/test_scaletest.py \
+    tests/test_fusion_diff.py tests/test_pipeline.py -q "$@"

@@ -1,0 +1,271 @@
+"""Async pipeline layer (exec/pipeline.py): prefetch correctness.
+
+Fast lane: PrefetchIterator semantics (ordering, background-exception
+propagation, early close), queue shedding under a capped HBM pool, plan
+insertion structure, and a small planner differential. The tracker-wide
+prefetch on/off differential over every TPC-H and TPC-DS planner query
+mirrors test_fusion_diff.py and runs in the slow lane
+(tests/run_slow_lane.sh).
+"""
+
+import os
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.bench import tpcds, tpch
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exec.pipeline import (
+    STATS,
+    PrefetchExec,
+    PrefetchIterator,
+    insert_prefetch,
+)
+from spark_rapids_tpu.mem.pool import HbmPool, get_pool, set_pool
+
+SLOW_LANE = os.environ.get("SRTPU_SLOW_LANE") == "1"
+slow_lane = pytest.mark.skipif(
+    not SLOW_LANE,
+    reason="tracker-wide differential; run tests/run_slow_lane.sh")
+
+
+# ---------------------------------------------------------------------------
+# PrefetchIterator unit semantics
+# ---------------------------------------------------------------------------
+
+def test_prefetch_preserves_order_and_exhausts():
+    it = PrefetchIterator(iter(range(100)), depth=3, label="unit",
+                          account=False)
+    assert list(it) == list(range(100))
+    with pytest.raises(StopIteration):
+        next(it)
+    it.close()
+
+
+def test_prefetch_background_exception_propagates():
+    def src():
+        yield 1
+        yield 2
+        raise ValueError("decode failed")
+
+    it = PrefetchIterator(src(), depth=2, label="unit", account=False)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(ValueError, match="decode failed"):
+        next(it)
+    # a failed iterator stays terminated
+    with pytest.raises(StopIteration):
+        next(it)
+    it.close()
+
+
+def test_prefetch_close_unblocks_producer():
+    """close() mid-stream must stop a worker blocked on a full queue and
+    close the source generator."""
+    closed = threading.Event()
+
+    def src():
+        try:
+            i = 0
+            while True:
+                yield i
+                i += 1
+        finally:
+            closed.set()
+
+    it = PrefetchIterator(src(), depth=1, label="unit", account=False)
+    assert next(it) == 0
+    it.close()
+    assert closed.wait(timeout=5.0), "source generator was not closed"
+    it.close()  # idempotent
+
+
+def test_prefetch_runs_ahead_of_consumer():
+    """The worker must produce while the consumer sits idle (the point of
+    the layer): after a pause, the queue holds `depth` items."""
+    produced = []
+
+    def src():
+        for i in range(10):
+            produced.append(i)
+            yield i
+
+    it = PrefetchIterator(src(), depth=4, label="unit", account=False)
+    deadline = time.monotonic() + 5.0
+    while len(produced) < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(produced) >= 4, "worker did not run ahead"
+    assert list(it) == list(range(10))
+    it.close()
+
+
+# ---------------------------------------------------------------------------
+# queue shedding under a capped pool
+# ---------------------------------------------------------------------------
+
+def _device_batches(n=6, rows=64):
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+
+    for i in range(n):
+        yield batch_from_arrow(pa.table(
+            {"a": pa.array(range(i * rows, (i + 1) * rows), pa.int64())}))
+
+
+def test_prefetch_sheds_and_degrades_under_capped_pool():
+    """A pool with no headroom rejects prefetch accounting; the queue sheds
+    once and the consumer still sees every batch, in order."""
+    old = get_pool()
+    set_pool(HbmPool(limit_bytes=1))  # nothing fits
+    try:
+        sheds0 = STATS.snapshot()["prefetch_sheds"]
+        it = PrefetchIterator(_device_batches(), depth=2, label="shed")
+        out = list(it)
+        it.close()
+        assert len(out) == 6
+        import numpy as np
+        for i, b in enumerate(out):
+            assert int(np.asarray(b.columns[0].data)[0]) == i * 64
+        assert STATS.snapshot()["prefetch_sheds"] == sheds0 + 1
+    finally:
+        set_pool(old)
+
+
+def test_prefetch_accounts_with_pool():
+    """Queued batches register with the pool and are released on dequeue
+    and on close."""
+    old = get_pool()
+    pool = HbmPool(limit_bytes=1 << 30)
+    set_pool(pool)
+    try:
+        it = PrefetchIterator(_device_batches(), depth=2, label="acct")
+        deadline = time.monotonic() + 5.0
+        while pool.free == pool.limit and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool.free < pool.limit, "no prefetch accounting"
+        it.close()
+        assert pool.free == pool.limit, "accounting leaked on close"
+    finally:
+        set_pool(old)
+
+
+# ---------------------------------------------------------------------------
+# plan insertion structure
+# ---------------------------------------------------------------------------
+
+def _tpch_plan(conf_overrides=None):
+    tables = tpch.tables_for(0.002, seed=5)
+    conf = RapidsConf(conf_overrides or {})
+    d = tpch.df_tables(tables, conf, shuffle_partitions=2, partitions=2,
+                       batch_rows=512)
+    return tpch.DF_QUERIES["q3"](d).physical_plan()
+
+
+def _walk(node):
+    yield node
+    for ch in node.children:
+        yield from _walk(ch)
+
+
+def test_insert_prefetch_wraps_boundaries():
+    plan = _tpch_plan()
+    wrapped = [n for n in _walk(plan) if isinstance(n, PrefetchExec)]
+    assert wrapped, "planner inserted no PrefetchExec"
+    for n in _walk(plan):
+        if isinstance(n, PrefetchExec):
+            # never stacked
+            assert not isinstance(n.children[0], PrefetchExec)
+    from spark_rapids_tpu.shuffle.aqe import AQEShuffleReadExec
+    from spark_rapids_tpu.shuffle.exchange_exec import ShuffleExchangeExec
+    for n in _walk(plan):
+        if isinstance(n, AQEShuffleReadExec):
+            # the reader addresses the exchange's registration directly
+            assert isinstance(n.children[0], ShuffleExchangeExec)
+
+
+def test_insert_prefetch_disabled_leaves_plan_bare():
+    plan = _tpch_plan({"spark.rapids.tpu.sql.prefetch.enabled": False})
+    assert not [n for n in _walk(plan) if isinstance(n, PrefetchExec)]
+
+
+def test_prefetch_exec_propagates_child_exception():
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exec.base import BatchSourceExec
+
+    class Boom(BatchSourceExec):
+        def do_execute(self, partition):
+            yield from super().do_execute(partition)
+            raise RuntimeError("child blew up")
+
+    t = pa.table({"a": pa.array([1, 2, 3], pa.int64())})
+    from spark_rapids_tpu.columnar.batch import batch_from_arrow
+    src = Boom([[batch_from_arrow(t)]], T.Schema.from_arrow(t.schema))
+    node = PrefetchExec(src, depth=2)
+    it = node.execute(0)
+    next(it)
+    with pytest.raises(RuntimeError, match="child blew up"):
+        next(it)
+
+
+# ---------------------------------------------------------------------------
+# planner differentials: prefetch on vs off must be invisible in results
+# ---------------------------------------------------------------------------
+
+def _run_tpch(tables, q, enabled):
+    conf = RapidsConf({"spark.rapids.tpu.sql.prefetch.enabled": enabled})
+    d = tpch.df_tables(tables, conf, shuffle_partitions=2, partitions=2,
+                       batch_rows=512)
+    return tpch.DF_QUERIES[q](d).to_arrow()
+
+
+def _run_tpcds(tables, q, enabled):
+    conf = RapidsConf({"spark.rapids.tpu.sql.prefetch.enabled": enabled})
+    return tpcds.build_query(q, tables, conf, shuffle_partitions=2).to_arrow()
+
+
+def test_prefetch_differential_fast():
+    """Default-lane sentinel: one scan-heavy and one join-heavy query."""
+    tables = tpch.tables_for(0.005, seed=3)
+    for q in ("q6", "q3"):
+        on, off = _run_tpch(tables, q, True), _run_tpch(tables, q, False)
+        assert on.equals(off), f"tpch {q}: prefetch changed results"
+
+
+def test_prefetch_shed_query_still_completes():
+    """A planner query under a pool with zero headroom degrades to
+    synchronous pulls but still produces identical results."""
+    tables = tpch.tables_for(0.005, seed=3)
+    expected = _run_tpch(tables, "q6", False)
+    old = get_pool()
+    set_pool(HbmPool(limit_bytes=1))
+    try:
+        got = _run_tpch(tables, "q6", True)
+    finally:
+        set_pool(old)
+    assert got.equals(expected)
+
+
+@pytest.fixture(scope="module")
+def tpch_tables():
+    return tpch.tables_for(0.005, seed=3)
+
+
+@pytest.fixture(scope="module")
+def tpcds_tables():
+    return tpcds.tables_for(0.002, seed=42)
+
+
+@slow_lane
+@pytest.mark.parametrize("q", sorted(tpch.DF_QUERIES))
+def test_tpch_prefetch_differential(tpch_tables, q):
+    on, off = _run_tpch(tpch_tables, q, True), _run_tpch(tpch_tables, q, False)
+    assert on.equals(off), f"tpch {q}: prefetch changed results"
+
+
+@slow_lane
+@pytest.mark.parametrize("q", sorted(tpcds.QUERIES))
+def test_tpcds_prefetch_differential(tpcds_tables, q):
+    on, off = (_run_tpcds(tpcds_tables, q, True),
+               _run_tpcds(tpcds_tables, q, False))
+    assert on.equals(off), f"tpcds {q}: prefetch changed results"

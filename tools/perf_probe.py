@@ -262,8 +262,145 @@ def dispatch_count(queries=("q1", "q3"), sf=0.005):
     return results
 
 
+def _lane_of(name: str) -> str:
+    """Trace-span -> pipeline-lane mapping for the overlap report."""
+    if name == "scan:decode":
+        return "decode"
+    if name == "scan:upload":
+        return "upload"
+    if name.startswith("prefetch:"):
+        return "prefetch-worker"
+    if name == "PrefetchExec":
+        return "prefetch-wait"
+    if name.startswith("shuffle:"):
+        return "shuffle"
+    if name.endswith("ScanExec"):
+        return "scan-iter"
+    return "compute"
+
+
+def _merge_intervals(spans):
+    """[(start, end)] -> disjoint sorted union."""
+    out = []
+    for s, e in sorted(spans):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _intersect_s(a, b):
+    """Total seconds the two disjoint interval lists overlap."""
+    total, i, j = 0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if lo < hi:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total / 1e9
+
+
+def overlap(sf=None, n_files=None, reps=2):
+    """``python tools/perf_probe.py overlap`` — the async-pipeline proof
+    (docs/async_pipeline.md): a scan-bound Q6 over a multi-file parquet
+    lineitem, prefetch on vs off. Reports wall time both ways, the scan
+    throughput ratio, per-lane busy time from the captured trace, and how
+    long each host lane ran CONCURRENTLY with device compute. The
+    prefetch-on trace is exported for Perfetto (lanes land on distinct
+    tracks because the exporter assigns one tid per producing thread)."""
+    import shutil
+    import tempfile
+
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.bench import tpch
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.obs import to_chrome_trace
+    from spark_rapids_tpu.plan import read_parquet
+
+    sf = float(os.environ.get("OVERLAP_SF", sf or 0.3))
+    n_files = int(os.environ.get("OVERLAP_FILES", n_files or 8))
+    li = tpch.gen_lineitem(sf, seed=7)
+    tmp = tempfile.mkdtemp(prefix="srtpu_overlap_")
+    paths = []
+    step = (li.num_rows + n_files - 1) // n_files
+    for i in range(n_files):
+        p = os.path.join(tmp, f"lineitem_{i:02d}.parquet")
+        pq.write_table(li.slice(i * step, step), p)
+        paths.append(p)
+
+    def run(enabled, capture):
+        conf = RapidsConf(
+            {"spark.rapids.tpu.sql.prefetch.enabled": enabled})
+        d = {"lineitem": read_parquet(paths, conf=conf)}
+        q = tpch.DF_QUERIES["q6"](d)
+        best, events = None, []
+        for _ in range(reps):
+            if capture:
+                tracing.set_capture(True, clear=True)
+            t0 = time.perf_counter()
+            out = q.to_arrow()
+            dt = time.perf_counter() - t0
+            if capture:
+                tracing.set_capture(False)
+            if best is None or dt < best[0]:
+                best = (dt, out)
+                if capture:
+                    events = tracing.trace_events(clear=True)
+        return best[0], best[1], events
+
+    try:
+        on_s, on_out, events = run(True, capture=True)
+        off_s, off_out, _ = run(False, capture=False)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    assert on_out.equals(off_out), "prefetch changed q6 results"
+
+    lanes = {}
+    for ev in events:
+        lanes.setdefault(_lane_of(ev["name"]), []).append(ev)
+    merged = {ln: _merge_intervals(
+                  [(e["start_ns"], e["start_ns"] + e["dur_ns"]) for e in evs])
+              for ln, evs in lanes.items()}
+    busy = {ln: round(sum(e - s for s, e in iv) / 1e9, 4)
+            for ln, iv in merged.items()}
+    threads = {ln: len({e["thread"] for e in evs})
+               for ln, evs in lanes.items()}
+    compute = merged.get("compute", [])
+    conc = {ln: round(_intersect_s(iv, compute), 4)
+            for ln, iv in merged.items() if ln != "compute"}
+
+    trace_path = os.environ.get("PROBE_TRACE", "trace_overlap.json")
+    with open(trace_path, "w") as f:
+        json.dump(to_chrome_trace(events, process_name="overlap"), f)
+
+    print(json.dumps({
+        "mode": "overlap",
+        # overlap can only beat serial execution when the host has cores to
+        # run lanes on (or the device is a real accelerator): on a 1-core
+        # host the ratio is ~1.0 by construction and the lane-concurrency
+        # numbers below are the meaningful output
+        "host_cores": os.cpu_count(),
+        "sf": sf, "files": n_files, "rows": li.num_rows,
+        "prefetch_on_s": round(on_s, 4),
+        "prefetch_off_s": round(off_s, 4),
+        "scan_throughput_ratio": round(off_s / on_s, 3),
+        "lane_busy_s": busy,
+        "lane_threads": threads,
+        "lane_concurrent_with_compute_s": conc,
+        "trace": trace_path,
+    }))
+
+
 if __name__ == "__main__":
     if _DISPATCH_MODE:
         dispatch_count()
+    elif "overlap" in sys.argv[1:]:
+        overlap()
     else:
         main()
