@@ -226,7 +226,24 @@ class _Budget:
         return self.total - (time.perf_counter() - self.t0)
 
 
-def main(budget_s=None):
+def _faults_guard(faults_spec, environ):
+    """Chaos runs must never shrink correctness coverage: with a fault
+    schedule active, refuse the BENCH_* env overrides that scale down the
+    inputs/runs the differential gates compare. (The --budget shrinkage of
+    statistical knobs is already gate-safe by construction; the envs are
+    not — they change WHAT is checked, not how often.)"""
+    if not faults_spec:
+        return
+    banned = [k for k in ("BENCH_SF_H", "BENCH_SF_DS", "BENCH_RUNS",
+                          "BENCH_DEPTH") if k in environ]
+    if banned:
+        raise SystemExit(
+            f"--faults is set: refusing to run with correctness-gate "
+            f"overrides {banned} (chaos runs must execute the full "
+            f"differential check)")
+
+
+def main(budget_s=None, faults=None):
     import jax
     from spark_rapids_tpu.bench import tpch
     from spark_rapids_tpu.bench import tpcds_queries as DSQ
@@ -235,7 +252,9 @@ def main(budget_s=None):
     from spark_rapids_tpu.plan import from_arrow
     from spark_rapids_tpu.utils.sync import fence
 
-    dev_conf = RapidsConf({})
+    _faults_guard(faults, os.environ)
+    dev_conf = RapidsConf(
+        {"spark.rapids.tpu.test.faults": faults} if faults else {})
     cpu_conf = RapidsConf({"spark.rapids.tpu.sql.enabled": False})
     bud = _Budget(budget_s)
 
@@ -552,4 +571,11 @@ if __name__ == "__main__":
                          "dumps) are skipped to fit. Correctness gates "
                          "always run; the final driver-metric line is "
                          "always emitted.")
-    main(budget_s=ap.parse_args().budget)
+    ap.add_argument("--faults", type=str, default=None, metavar="SPEC",
+                    help="fault-injection schedule (spark.rapids.tpu.test."
+                         "faults grammar) applied to the device runs; "
+                         "refuses BENCH_* correctness-gate overrides so "
+                         "chaos runs always execute the full differential "
+                         "check (docs/fault_injection.md)")
+    _args = ap.parse_args()
+    main(budget_s=_args.budget, faults=_args.faults)

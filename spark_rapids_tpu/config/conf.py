@@ -124,6 +124,63 @@ OOM_INJECT_SKIP = conf(
     doc="Number of pool allocations to allow before injecting an OOM.",
     internal=True)
 
+# -- fault injection & resilience (docs/fault_injection.md) -----------------
+
+TEST_FAULTS = conf(
+    "spark.rapids.tpu.test.faults", default="",
+    doc="Fault-injection schedule: 'site:action@k=v,...;site:action@...' "
+        "(e.g. 'mem.alloc:retry@skip=3;shuffle.fetch:drop@p=0.1,seed=42'). "
+        "Sites: mem.alloc, io.decode, shuffle.serialize, shuffle.fetch, "
+        "shuffle.block, parallel.exchange, executor. Actions: retry, split, "
+        "drop, error, corrupt, slow, stall, kill. Empty = injection off, "
+        "zero overhead. Generalizes the reference's OomInjectionConf "
+        "(RapidsConf.scala:2753) to every layer; see docs/fault_injection.md.",
+    internal=True)
+
+SHUFFLE_INTEGRITY = conf(
+    "spark.rapids.tpu.shuffle.integrity.enabled", default=True,
+    doc="Append a per-block CRC trailer (CRC32C when available, else CRC-32) "
+        "to serialized shuffle blocks and verify it on read. A mismatch "
+        "triggers refetch from the source, then recompute of the map output "
+        "if the source itself is corrupt.")
+
+SHUFFLE_FETCH_MAX_ATTEMPTS = conf(
+    "spark.rapids.tpu.shuffle.fetch.maxAttempts", default=4,
+    doc="Attempts per remote shuffle fetch before the failure propagates "
+        "(first try + retries). Retried on timeout/connection errors with "
+        "exponential backoff and jitter.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SHUFFLE_FETCH_BACKOFF_MS = conf(
+    "spark.rapids.tpu.shuffle.fetch.retryBackoffMs", default=50.0,
+    doc="Base backoff between shuffle fetch retries; doubles per attempt "
+        "with +/-50% jitter to avoid thundering-herd refetch.")
+
+SHUFFLE_FETCH_DEADLINE_S = conf(
+    "spark.rapids.tpu.shuffle.fetch.deadlineSeconds", default=120.0,
+    doc="Overall wall-clock deadline across all attempts of one shuffle "
+        "fetch, bounding worst-case stall regardless of maxAttempts.")
+
+RETRY_BACKOFF_MS = conf(
+    "spark.rapids.tpu.memory.retry.backoffMs", default=0.0,
+    doc="Optional base backoff between OOM retry attempts in with_retry "
+        "(exponential, jittered, capped at 32x base). 0 = retry immediately "
+        "(reference behavior: RmmRapidsRetryIterator blocks on the state "
+        "machine instead).")
+
+FAULT_BLACKLIST_ENABLED = conf(
+    "spark.rapids.tpu.fault.deviceBlacklist.enabled", default=True,
+    doc="After repeated device failures of the same plan, blacklist it and "
+        "degrade execution to the CPU engine (graceful degradation; the "
+        "reference instead hard-exits the executor, Plugin.scala:560).")
+
+FAULT_BLACKLIST_THRESHOLD = conf(
+    "spark.rapids.tpu.fault.deviceBlacklist.threshold", default=3,
+    doc="Device failures of one plan tolerated before it is blacklisted to "
+        "the CPU engine. Escaped retryable OOMs get the same number of "
+        "whole-query retries but never degrade.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
 SHUFFLE_MODE = conf(
     "spark.rapids.tpu.shuffle.mode", default="MULTITHREADED",
     doc="Shuffle manager mode: MULTITHREADED (host files, works everywhere), "

@@ -171,7 +171,9 @@ class FileScanBase(LeafExec):
         def read(it):
             import time as _time
 
+            from spark_rapids_tpu import faults
             from spark_rapids_tpu.utils import tracing
+            faults.check("io.decode", file=str(getattr(it, "path", it)))
             with self.timer("scanTimeNs"):
                 t0 = _time.perf_counter_ns()
                 t = self._take_cached(it)

@@ -1,0 +1,90 @@
+"""Repeated-device-failure blacklist -> CPU degradation.
+
+Reference discipline: the plugin treats repeated fatal device errors as
+evidence the device (or this plan's use of it) is unhealthy and hard-exits
+the executor so work lands elsewhere (Plugin.scala:560-568). Standalone we
+have no scheduler above us, so the equivalent graceful degradation is
+per-plan: after ``spark.rapids.tpu.fault.deviceBlacklist.threshold`` device
+failures of the same plan, the plan is blacklisted and re-planned onto the
+CPU engine (plan/cpu.py) — results over raw availability, availability over
+the device.
+
+Classification is deliberately narrow so unset-faults behavior is
+unchanged: only injected device faults (FaultInjectedError) and real XLA
+runtime failures count toward the blacklist; escaped retryable OOMs get a
+bounded whole-query retry (memory pressure is transient, not a device
+fault) and everything else re-raises untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from spark_rapids_tpu.faults.registry import FaultInjectedError
+
+_LOCK = threading.Lock()
+_DEVICE_FAILS: Dict[str, int] = {}
+_OOM_FAILS: Dict[str, int] = {}
+_LISTED: set = set()
+
+RAISE, RETRY, DEGRADE = "raise", "retry", "degrade"
+
+
+def _threshold(conf) -> int:
+    from spark_rapids_tpu.config import conf as _C
+    return _C.FAULT_BLACKLIST_THRESHOLD.get(conf)
+
+
+def _enabled(conf) -> bool:
+    from spark_rapids_tpu.config import conf as _C
+    return _C.FAULT_BLACKLIST_ENABLED.get(conf)
+
+
+def _is_device_failure(exc: BaseException) -> bool:
+    if isinstance(exc, FaultInjectedError):
+        return True
+    # real accelerator-runtime failures, matched without importing jaxlib
+    name = type(exc).__name__
+    mod = type(exc).__module__ or ""
+    return name == "XlaRuntimeError" and ("jax" in mod or "xla" in mod)
+
+
+def is_listed(key: str, conf) -> bool:
+    if not _enabled(conf):
+        return False
+    with _LOCK:
+        return key in _LISTED
+
+
+def classify(key: str, exc: BaseException, conf) -> str:
+    """Record one failed execution of plan ``key``; returns what the caller
+    should do: RAISE (not ours), RETRY (device again), DEGRADE (CPU)."""
+    if not _enabled(conf):
+        return RAISE
+    from spark_rapids_tpu.mem.pool import RetryOOM, SplitAndRetryOOM
+    from spark_rapids_tpu.shuffle.integrity import BlockCorruption
+
+    if isinstance(exc, (RetryOOM, SplitAndRetryOOM, BlockCorruption)):
+        # transient pressure (memory) or transient data damage (storage /
+        # wire corruption): bounded whole-query retry, never CPU — a re-run
+        # regenerates the shuffle data, degradation would not
+        with _LOCK:
+            _OOM_FAILS[key] = _OOM_FAILS.get(key, 0) + 1
+            return RETRY if _OOM_FAILS[key] < _threshold(conf) else RAISE
+    if not _is_device_failure(exc):
+        return RAISE
+    with _LOCK:
+        _DEVICE_FAILS[key] = _DEVICE_FAILS.get(key, 0) + 1
+        if _DEVICE_FAILS[key] >= _threshold(conf):
+            _LISTED.add(key)
+            return DEGRADE
+        return RETRY
+
+
+def clear() -> None:
+    """Forget all failure history (tests)."""
+    with _LOCK:
+        _DEVICE_FAILS.clear()
+        _OOM_FAILS.clear()
+        _LISTED.clear()

@@ -1,0 +1,197 @@
+"""Deterministic fault-injection registry.
+
+Reference: RmmSpark.forceRetryOOM / forceSplitAndRetryOOM (spark-rapids-jni)
+and RapidsConf.scala:2753 ``OomInjectionConf`` — the reference builds
+deterministic fault injection directly into its runtime so retry paths are
+testable without real hardware failures. This module generalizes that from
+one site (the allocator) to every layer the framework hardened: memory,
+io decode, shuffle serialize/fetch/blocks, the ICI exchange, and whole
+executors.
+
+Schedule grammar (``spark.rapids.tpu.test.faults``)::
+
+    site:action[@k=v[,k=v...]][;site:action@...]
+
+    mem.alloc:retry@skip=3;shuffle.fetch:drop@p=0.1,seed=42;
+    io.decode:error@file=*.parquet;executor:kill@id=1
+
+Sites (see docs/fault_injection.md for the catalog): ``mem.alloc``,
+``io.decode``, ``shuffle.serialize``, ``shuffle.fetch``, ``shuffle.block``,
+``parallel.exchange``, ``executor``.
+
+Actions: ``retry`` (RetryOOM), ``split`` (SplitAndRetryOOM), ``drop``
+(TimeoutError), ``error`` (FaultInjectedError), ``corrupt`` (bit-flip,
+applied by ``faults.corrupt``), ``slow``/``stall`` (sleep ``ms``), ``kill``
+(hard process exit, the Plugin.scala:560 hard-exit analog).
+
+Params: ``skip=N`` events pass before the rule arms; ``count=N`` bounds how
+many times it fires (default 1, unlimited when ``p`` is given); ``p=0.x``
+fires each armed event with that probability from a ``seed``-ed stream
+(deterministic across runs); ``file=GLOB`` / ``id=N`` restrict matching to
+a context file path / numeric worker id; ``ms=N`` sets sleep duration.
+
+All schedule state (skip/count/rng) is mutated under a per-rule lock —
+PR 3's parallel shuffle map writers hit the same rule from many threads.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+_SITES = ("mem.alloc", "io.decode", "shuffle.serialize", "shuffle.fetch",
+          "shuffle.block", "parallel.exchange", "executor")
+_ACTIONS = ("retry", "split", "drop", "error", "corrupt", "slow", "stall",
+            "kill")
+
+
+class FaultInjectedError(RuntimeError):
+    """A fault injected by an ``error`` rule (classified as a device
+    failure by the blacklist, so repeated injections degrade to CPU)."""
+
+    def __init__(self, site: str, message: str):
+        super().__init__(message)
+        self.site = site
+
+
+class _Rule:
+    """One parsed rule with lock-guarded schedule state."""
+
+    def __init__(self, site: str, action: str, params: Dict[str, object]):
+        self.site = site
+        self.action = action
+        self.params = params
+        self.file_glob: Optional[str] = params.get("file")  # type: ignore
+        self.worker_id: Optional[int] = params.get("id")  # type: ignore
+        self.ms = float(params.get("ms", 2000 if action == "stall" else 50))
+        self.p: Optional[float] = params.get("p")  # type: ignore
+        # count bounds total fires: deterministic rules default to one shot
+        # (the OomInjector contract); probabilistic rules default unbounded
+        default_count = None if self.p is not None else 1
+        self._count: Optional[int] = params.get("count", default_count)
+        self._skip = int(params.get("skip", 0))
+        self._rng = random.Random(int(params.get("seed", 0)))
+        self._lock = threading.Lock()
+
+    def matches(self, ctx: Dict[str, object]) -> bool:
+        if self.file_glob is not None:
+            f = ctx.get("file")
+            if f is None or not fnmatch.fnmatch(str(f), self.file_glob):
+                return False
+        if self.worker_id is not None:
+            wid = ctx.get("id")
+            if wid is None or int(wid) != self.worker_id:
+                return False
+        return True
+
+    def draw(self) -> bool:
+        """Advance the schedule one event; True = the rule fires now."""
+        with self._lock:
+            if self._skip > 0:
+                self._skip -= 1
+                return False
+            if self._count is not None and self._count <= 0:
+                return False
+            if self.p is not None and self._rng.random() >= self.p:
+                return False
+            if self._count is not None:
+                self._count -= 1
+            return True
+
+    def corrupt_pos(self, n: int) -> int:
+        """Seeded byte position to flip (corrupt action)."""
+        with self._lock:
+            return self._rng.randrange(n)
+
+
+def parse_spec(spec: str) -> List[_Rule]:
+    rules: List[_Rule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, tail = part.partition("@")
+        site, _, action = head.partition(":")
+        site, action = site.strip(), action.strip()
+        if site not in _SITES:
+            raise ValueError(f"unknown fault site {site!r} in {part!r} "
+                             f"(known: {', '.join(_SITES)})")
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r} in {part!r} "
+                             f"(known: {', '.join(_ACTIONS)})")
+        params: Dict[str, object] = {}
+        for kv in filter(None, (s.strip() for s in tail.split(","))):
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(f"bad fault param {kv!r} in {part!r}")
+            k = k.strip()
+            if k in ("skip", "count", "seed", "id"):
+                params[k] = int(v)
+            elif k in ("p", "ms"):
+                params[k] = float(v)
+            elif k == "file":
+                params[k] = v.strip()
+            else:
+                raise ValueError(f"unknown fault param {k!r} in {part!r}")
+        rules.append(_Rule(site, action, params))
+    return rules
+
+
+class FaultRegistry:
+    """Parsed fault schedule; ``check``/``corrupt`` are the site hooks."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self._by_site: Dict[str, List[_Rule]] = {}
+        for r in parse_spec(spec):
+            self._by_site.setdefault(r.site, []).append(r)
+
+    def __bool__(self) -> bool:
+        return bool(self._by_site)
+
+    def check(self, site: str, ctx: Dict[str, object]) -> None:
+        for rule in self._by_site.get(site, ()):
+            if rule.action == "corrupt" or not rule.matches(ctx):
+                continue
+            if not rule.draw():
+                continue
+            self._fire(rule, site, ctx)
+
+    def _fire(self, rule: _Rule, site: str, ctx: Dict[str, object]) -> None:
+        from spark_rapids_tpu import faults as _f
+        _f.note_injected(site)
+        if rule.action in ("slow", "stall"):
+            time.sleep(rule.ms / 1000.0)
+            return
+        if rule.action == "kill":
+            # hard exit, no cleanup — the reference plugin hard-exits
+            # executors on fatal device errors (Plugin.scala:560-568)
+            os._exit(137)
+        if rule.action == "retry":
+            from spark_rapids_tpu.mem.pool import RetryOOM
+            raise RetryOOM(f"injected retry OOM at {site}")
+        if rule.action == "split":
+            from spark_rapids_tpu.mem.pool import SplitAndRetryOOM
+            raise SplitAndRetryOOM(f"injected split-and-retry OOM at {site}")
+        if rule.action == "drop":
+            raise TimeoutError(f"injected fault: dropped {site} ({ctx})")
+        raise FaultInjectedError(site, f"injected fault at {site} ({ctx})")
+
+    def corrupt(self, site: str, data: bytes,
+                ctx: Dict[str, object]) -> bytes:
+        for rule in self._by_site.get(site, ()):
+            if rule.action != "corrupt" or not rule.matches(ctx):
+                continue
+            if not data or not rule.draw():
+                continue
+            from spark_rapids_tpu import faults as _f
+            _f.note_injected(site)
+            pos = rule.corrupt_pos(len(data))
+            out = bytearray(data)
+            out[pos] ^= 0xFF
+            data = bytes(out)
+        return data

@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional
 
+from spark_rapids_tpu import faults
+
 
 class RetryOOM(RuntimeError):
     """Allocation failed but may succeed after spilling/rolling back and
@@ -35,23 +37,35 @@ class CpuRetryOOM(RetryOOM):
 
 class OomInjector:
     """Deterministic OOM injection for tests (RmmSpark.forceRetryOOM analog):
-    after `skip` allocations, throw `count` OOMs of the given kind."""
+    after `skip` allocations, throw `count` OOMs of the given kind.
+
+    Kept for API back-compat; new code should install the general schedule
+    via ``spark.rapids.tpu.test.faults`` (mem.alloc site, faults/registry.py).
+    Schedule state is lock-guarded: the parallel shuffle map writers drive
+    concurrent allocations, and unlocked skip/count decrements could fire
+    the injection zero or multiple times.
+    """
 
     def __init__(self, kind: str = "RETRY", skip: int = 0, count: int = 1):
         assert kind in ("RETRY", "SPLIT")
         self.kind = kind
         self.skip = skip
         self.count = count
+        self._lock = threading.Lock()
 
     def on_alloc(self) -> None:
-        if self.skip > 0:
-            self.skip -= 1
-            return
-        if self.count > 0:
+        with self._lock:
+            if self.skip > 0:
+                self.skip -= 1
+                return
+            if self.count <= 0:
+                return
             self.count -= 1
-            if self.kind == "RETRY":
-                raise RetryOOM("injected retry OOM")
-            raise SplitAndRetryOOM("injected split-and-retry OOM")
+            kind = self.kind
+        faults.note_injected("mem.alloc")
+        if kind == "RETRY":
+            raise RetryOOM("injected retry OOM")
+        raise SplitAndRetryOOM("injected split-and-retry OOM")
 
 
 class HbmPool:
@@ -94,6 +108,9 @@ class HbmPool:
 
     def allocate(self, nbytes: int) -> None:
         """Account nbytes; spill then raise RetryOOM if over budget."""
+        # injection site, outside the pool lock so slow/stall rules cannot
+        # serialize unrelated allocators
+        faults.check("mem.alloc", nbytes=nbytes)
         with self._lock:
             self.alloc_count += 1
             if self._injector is not None:

@@ -11,11 +11,14 @@ the split is a device kernel (halve rows by gather).
 
 from __future__ import annotations
 
+import random
+import time
 from typing import Callable, Iterable, Iterator, List, Optional, TypeVar
 
 import jax
 import jax.numpy as jnp
 
+from spark_rapids_tpu import faults
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.exec import kernels as K
 from spark_rapids_tpu.mem.pool import RetryOOM, SplitAndRetryOOM
@@ -25,6 +28,19 @@ A = TypeVar("A")
 B = TypeVar("B")
 
 DEFAULT_MAX_ATTEMPTS = 32
+
+
+def _oom_backoff(attempts: int) -> None:
+    """Optional jittered exponential pause between OOM retries
+    (spark.rapids.tpu.memory.retry.backoffMs; default 0 = immediate retry).
+    Gives concurrent tasks' spills/frees a window to land before we
+    re-contend for the budget."""
+    from spark_rapids_tpu.config import conf as C
+    base_ms = C.RETRY_BACKOFF_MS.get(C.get_active())
+    if base_ms <= 0:
+        return
+    scale = 1 << min(attempts - 1, 5)
+    time.sleep((base_ms / 1000.0) * scale * (0.5 + random.random()))
 
 
 def split_batch_half(batch: ColumnarBatch) -> List[ColumnarBatch]:
@@ -64,6 +80,7 @@ def with_retry(
         while work:
             item = work.pop(0)
             attempts = 0
+            oom_seen = False
             while True:
                 attempts += 1
                 try:
@@ -73,9 +90,12 @@ def with_retry(
                         item.close()
                     else:
                         result = fn(item)
+                    if oom_seen:
+                        faults.note_recovered("mem.retry")
                     yield result
                     break
                 except SplitAndRetryOOM:
+                    oom_seen = True
                     from spark_rapids_tpu.utils import task_metrics as TM
                     TM.add("split_and_retry_count", 1)
                     if isinstance(item, SpillableBatch):
@@ -91,12 +111,14 @@ def with_retry(
                     item = work.pop(0)
                     attempts = 0
                 except RetryOOM:
+                    oom_seen = True
                     from spark_rapids_tpu.utils import task_metrics as TM
                     TM.add("retry_count", 1)
                     if attempts >= max_attempts:
                         raise
                     # the pool already spilled what it could; loop retries
                     # the same input (it re-materializes on get())
+                    _oom_backoff(attempts)
                     continue
 
 

@@ -55,6 +55,13 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "Consumer arrivals that found a prefetch queue empty"),
     ("prefetch_sheds", "counter",
      "Prefetch queues degraded to synchronous execution on RetryOOM"),
+    ("fault_injected_total", "counter",
+     "Faults fired by the injection registry (docs/fault_injection.md)"),
+    ("fault_recovered_total", "counter",
+     "Failures absorbed by a hardened path: OOM retry succeeded, corrupt "
+     "block refetched clean, fetch retry connected, lost output recomputed"),
+    ("fault_degraded_total", "counter",
+     "Queries that gave up on the device and completed on the CPU engine"),
 ]
 
 
@@ -101,6 +108,8 @@ def snapshot() -> Dict[str, int]:
     out.update(_jc.cache_stats())
     from spark_rapids_tpu.exec import pipeline as _pl
     out.update(_pl.STATS.snapshot())
+    from spark_rapids_tpu import faults as _faults
+    out.update(_faults.counters())
     return out
 
 

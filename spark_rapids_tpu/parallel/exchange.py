@@ -174,6 +174,8 @@ def distributed_agg_step(mesh: Mesh, batch: ColumnarBatch, n_keys: int,
     the global result without further merging.
     """
     n_dev = mesh.devices.size
+    from spark_rapids_tpu import faults
+    faults.check("parallel.exchange", n_dev=n_dev)
     ops = list(ops)
     n_bufs = len(ops)
     merge_ops = [(n_keys + i, _MERGE[op]) for i, (_, op) in enumerate(ops)]

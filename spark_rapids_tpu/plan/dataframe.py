@@ -158,12 +158,60 @@ class DataFrame:
             "cpu_nodes": sorted(set(cpu_nodes)),
         }
 
+    def _plan_key(self) -> str:
+        """Stable identity of this logical plan for failure accounting
+        (faults/blacklist.py)."""
+        parts: List[str] = []
+
+        def walk(n, d):
+            parts.append("  " * d + n.describe())
+            for c in n.children:
+                walk(c, d + 1)
+
+        walk(self.plan, 0)
+        return "\n".join(parts)
+
+    def _cpu_plan(self):
+        """Re-plan with the device engine off (graceful degradation path)."""
+        from spark_rapids_tpu.plan.overrides import Overrides
+
+        base = self.conf or C.RapidsConf()
+        return Overrides(base.with_overrides(**{C.SQL_ENABLED.key: False}),
+                         self.shuffle_partitions).apply(self.plan)
+
     def to_arrow(self) -> pa.Table:
+        """Execute, with per-plan failure handling: device failures retry
+        and then blacklist the plan onto the CPU engine; escaped retryable
+        OOMs get a bounded whole-query retry; everything else propagates
+        (faults/blacklist.py classification)."""
+        from spark_rapids_tpu import faults
+        from spark_rapids_tpu.faults import blacklist as _bl
+
+        base_conf = self.conf or C.RapidsConf()
+        key = self._plan_key()
+        if _bl.is_listed(key, base_conf):
+            return self._execute_plan(self._cpu_plan())
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                out = self._execute_plan(self.physical_plan())
+                if attempt > 1:
+                    faults.note_recovered("query")
+                return out
+            except Exception as e:
+                verdict = _bl.classify(key, e, base_conf)
+                if verdict == _bl.DEGRADE:
+                    faults.note_degraded("query")
+                    return self._execute_plan(self._cpu_plan())
+                if verdict != _bl.RETRY:
+                    raise
+
+    def _execute_plan(self, node) -> pa.Table:
         from spark_rapids_tpu.columnar.batch import batch_to_arrow
         from spark_rapids_tpu.plan.cpu import CpuExec
         from spark_rapids_tpu.shuffle import ShuffleExchangeExec
 
-        node = self.physical_plan()
         schema = node.output_schema
         tables = []
         try:

@@ -591,6 +591,8 @@ class Overrides:
         # conf (shrink pass, kernel caps) — the reference similarly
         # re-reads RapidsConf per plan (GpuOverrides.scala:4748)
         C.set_active(self.conf)
+        from spark_rapids_tpu import faults as _faults
+        _faults.configure(self.conf)
         _base.set_sync_metrics(self.conf[C.METRICS_SYNC])
         _base.set_metrics_level(self.conf[C.METRICS_LEVEL])
         if C.SQL_ENABLED.get(self.conf):

@@ -31,11 +31,44 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import pickle
+import re
 import threading
 import traceback
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import pyarrow as pa
+
+# marker embedded in a reduce task's error when a source's blocks stay
+# corrupt across refetches: the driver parses it and recomputes those map
+# outputs on a different executor (refetch-then-recompute)
+_CORRUPT_MARKER = re.compile(
+    r"SRTPU_CORRUPT_BLOCKS peer=([\d.]+):(\d+) maps=([\d,]+)")
+
+
+def _fetch_checked(cli, bids, expect_sealed: bool, host: str, port: int,
+                   mids) -> List[bytes]:
+    """Fetch blocks from one source and verify their integrity trailers.
+    Corruption retries the whole per-source fetch (block->map attribution
+    is unreliable: absent blocks are legitimately dropped); persistent
+    corruption raises with a driver-parseable marker naming the source."""
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.shuffle import integrity as _integrity
+
+    last: Optional[Exception] = None
+    for attempt in range(3):
+        blocks = cli.fetch(bids)
+        if not expect_sealed:
+            return blocks
+        try:
+            out = [_integrity.unseal(b) for b in blocks]
+            if attempt:
+                faults.note_recovered("shuffle.block")
+            return out
+        except _integrity.BlockCorruption as e:
+            last = e
+    raise RuntimeError(
+        f"SRTPU_CORRUPT_BLOCKS peer={host}:{port} "
+        f"maps={','.join(str(m) for m in mids)} :: {last}")
 
 
 # ---------------------------------------------------------------------------
@@ -48,6 +81,7 @@ def _find_agg_exchange(plan):
     exchange feeding a final-mode aggregate. Deterministic DFS, so the
     driver and every worker resolve the same node from the same plan."""
     from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+    from spark_rapids_tpu.exec.pipeline import PrefetchExec
     from spark_rapids_tpu.shuffle.aqe import AQEShuffleReadExec
     from spark_rapids_tpu.shuffle.exchange_exec import ShuffleExchangeExec
     from spark_rapids_tpu.shuffle.partition import HashPartitioner
@@ -57,6 +91,10 @@ def _find_agg_exchange(plan):
     def walk(node):
         if isinstance(node, HashAggregateExec) and node.mode == "final":
             ex = node.children[0]
+            # the async pipeline pass wraps shuffle reads in a prefetch
+            # boundary (exec/pipeline.py insert_prefetch) — look through it
+            if isinstance(ex, PrefetchExec):
+                ex = ex.children[0]
             if isinstance(ex, AQEShuffleReadExec):
                 ex = ex.exchange
             if isinstance(ex, ShuffleExchangeExec) and isinstance(
@@ -100,6 +138,7 @@ def _worker_main(worker_id: str, ctrl) -> None:
     except Exception:
         pass
 
+    from spark_rapids_tpu import faults
     from spark_rapids_tpu import types as T  # noqa: F401 (x64 init)
     from spark_rapids_tpu.shuffle.manager import ShuffleManager
     from spark_rapids_tpu.shuffle.protocol import BlockId
@@ -107,6 +146,7 @@ def _worker_main(worker_id: str, ctrl) -> None:
     from spark_rapids_tpu.shuffle.transport import (ShuffleServer, TcpServer,
                                                     connect_tcp)
 
+    wid_num = int(worker_id.rsplit("-", 1)[1])
     manager = ShuffleManager(
         local_dir=f"/tmp/srtpu_cluster_{os.getpid()}", writer_threads=2,
         reader_threads=2)
@@ -119,9 +159,15 @@ def _worker_main(worker_id: str, ctrl) -> None:
         if ent is None:
             return None
         reg, local_idx = ent
+        # raw: blocks leave this store still sealed so integrity is
+        # verified END-TO-END by the fetching reduce task
         blocks = manager._fetch_blocks(reg, bid.partition, local_idx,
-                                       local_idx + 1)
-        return blocks[0] if blocks else None
+                                       local_idx + 1, raw=True)
+        if not blocks:
+            return None
+        return faults.corrupt("shuffle.block", blocks[0], id=wid_num,
+                              shuffle=bid.shuffle_id,
+                              partition=bid.partition)
 
     server = TcpServer(ShuffleServer(block_fetcher), host="127.0.0.1")
     clients: Dict[Tuple[str, int], object] = {}
@@ -147,6 +193,7 @@ def _worker_main(worker_id: str, ctrl) -> None:
             confs[payload] = RapidsConf(conf_items)
             plans[payload] = _build_plan(payload)
         _C.set_active(confs[payload])
+        faults.configure(confs[payload])
         return plans[payload]
 
     try:
@@ -159,6 +206,7 @@ def _worker_main(worker_id: str, ctrl) -> None:
                 if kind == "map":
                     _, task_id, payload, shuffle_id, parts = msg
                     _, exchange = _find_agg_exchange(plan_for(payload))
+                    faults.check("executor", id=wid_num, task="map")
                     child = exchange.children[0]
                     if shuffle_id not in regs:
                         regs[shuffle_id] = manager.register(
@@ -177,15 +225,18 @@ def _worker_main(worker_id: str, ctrl) -> None:
                      sources) = msg
                     final_agg, exchange = _find_agg_exchange(
                         plan_for(payload))
+                    faults.check("executor", id=wid_num, task="reduce")
                     schema = exchange.children[0].output_schema
                     blocks: List[bytes] = []
                     for host, port, mids in sources:
                         if not mids:
                             continue
                         cli = client_for(host, port)
-                        blocks.extend(cli.fetch(
+                        blocks.extend(_fetch_checked(
+                            cli,
                             [BlockId(shuffle_id, m, reduce_id)
-                             for m in mids]))
+                             for m in mids],
+                            manager.integrity, host, port, mids))
                     batch = merge_to_batch(blocks, schema, min_bucket=16)
                     if batch is None:
                         ctrl.send(("reduce_done", task_id, reduce_id, None))
@@ -327,10 +378,13 @@ class TcpShuffleCluster:
                 return None
             _t.sleep(0)
 
-    def _run_maps(self, payload, sid: int, parts_todo, owner) -> None:
+    def _run_maps(self, payload, sid: int, parts_todo, owner,
+                  avoid: Optional[Set[str]] = None) -> None:
         """Run (or re-run) map partitions until each has a live owner —
         Spark lineage recompute: blocks on a dead executor are lost, their
-        partitions re-execute on survivors."""
+        partitions re-execute on survivors. ``avoid`` steers recompute away
+        from an executor serving corrupt blocks (soft: ignored when it
+        would leave no candidates)."""
         from spark_rapids_tpu.config import conf as _C
 
         retries = _C.CLUSTER_TASK_RETRIES.get(_C.get_active())
@@ -339,6 +393,8 @@ class TcpShuffleCluster:
         last_error = None
         while todo:
             alive = self._alive_workers()
+            if avoid:
+                alive = [w for w in alive if w not in avoid] or alive
             if not alive:
                 raise RuntimeError("all executors lost")
             assignment: Dict[str, List[int]] = {}
@@ -430,6 +486,7 @@ class TcpShuffleCluster:
                     self._on_dead(wid)
                     continue
                 pending.append((tid, wid, r))
+            corrupt_sources: Dict[Optional[str], set] = {}
             for tid, wid, r in pending:
                 msg = self._recv(wid)
                 if msg is None:
@@ -437,6 +494,13 @@ class TcpShuffleCluster:
                 if msg[0] == "error":
                     last_error = f"reduce task failed on {wid}: {msg[-1]}"
                     self._mark_alive(wid)
+                    m = _CORRUPT_MARKER.search(str(msg[-1]))
+                    if m:
+                        bad_addr = (m.group(1), int(m.group(2)))
+                        bad = next((w for w, a in self._addrs.items()
+                                    if a == bad_addr), None)
+                        corrupt_sources.setdefault(bad, set()).update(
+                            int(x) for x in m.group(3).split(","))
                     continue  # r stays todo: retry up to the budget
                 assert msg[0] == "reduce_done"
                 self._mark_alive(wid)
@@ -444,6 +508,17 @@ class TcpShuffleCluster:
                 blob = msg[3]
                 if blob:
                     tables.append(pa.ipc.open_stream(blob).read_all())
+            # a source kept serving corrupt blocks across refetches:
+            # recompute its map outputs, preferring OTHER executors
+            # (deferred past the drain — _run_maps must not interleave
+            # with pending reduce replies on the same pipes)
+            for bad, mids in corrupt_sources.items():
+                for p in mids:
+                    owner.pop(p, None)
+                self._run_maps(payload, sid, sorted(mids), owner,
+                               avoid={bad} if bad else None)
+                from spark_rapids_tpu import faults
+                faults.note_recovered("shuffle.recompute")
             attempts += 1
             if reduces_todo and attempts > retries:
                 raise RuntimeError(

@@ -1,0 +1,82 @@
+"""Per-block integrity trailer for the kudo shuffle wire format.
+
+Reference: the reference transports shuffle buffers over UCX with
+link-level integrity; our DCN/TCP path (and the host file path under it)
+gets an explicit per-block checksum instead, so corruption anywhere between
+serialize and merge is DETECTED at read time and recoverable by refetch
+(shuffle/manager.py, shuffle/cluster.py) rather than silently aggregated.
+
+The trailer is appended by the ShuffleManager AFTER serialization and
+stripped BEFORE merge, deliberately outside the kudo frame itself:
+``merge_tables`` walks concatenated frames positionally and the native
+merge fast-path sniffs the header codec byte — both must keep seeing
+pristine frames.
+
+Layout (little-endian, 9 bytes): magic u32 "SRFC" | algo u8 | checksum u32.
+Algo 1 is CRC32C when a native ``crc32c`` library is importable; algo 0 is
+zlib's CRC-32 (C speed, always available — no new dependencies). The algo
+byte travels in the trailer so reader and writer need not agree up front.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+_TRAILER = struct.Struct("<IBI")
+MAGIC = 0x43465253  # "SRFC"
+ALGO_CRC32 = 0
+ALGO_CRC32C = 1
+TRAILER_BYTES = _TRAILER.size
+
+try:  # pragma: no cover - environment dependent
+    from crc32c import crc32c as _crc32c  # type: ignore
+    _HAVE_CRC32C = True
+except Exception:
+    _crc32c = None
+    _HAVE_CRC32C = False
+
+
+class BlockCorruption(RuntimeError):
+    """A shuffle block failed its integrity check on read."""
+
+
+def _checksum(data: bytes, algo: int) -> int:
+    if algo == ALGO_CRC32C:
+        if _crc32c is None:
+            raise BlockCorruption("block sealed with CRC32C but no crc32c "
+                                  "implementation is available")
+        return _crc32c(data) & 0xFFFFFFFF
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def seal(blob: bytes) -> bytes:
+    """Append the integrity trailer to a serialized block."""
+    algo = ALGO_CRC32C if _HAVE_CRC32C else ALGO_CRC32
+    return blob + _TRAILER.pack(MAGIC, algo, _checksum(blob, algo))
+
+
+def is_sealed(blob: bytes) -> bool:
+    if len(blob) < TRAILER_BYTES:
+        return False
+    magic, _, _ = _TRAILER.unpack_from(blob, len(blob) - TRAILER_BYTES)
+    return magic == MAGIC
+
+
+def unseal(blob: bytes, verify: bool = True) -> bytes:
+    """Strip (and by default verify) the trailer; raises BlockCorruption on
+    a missing trailer or checksum mismatch."""
+    if len(blob) < TRAILER_BYTES:
+        raise BlockCorruption(
+            f"block too short for integrity trailer ({len(blob)} bytes)")
+    magic, algo, crc = _TRAILER.unpack_from(blob, len(blob) - TRAILER_BYTES)
+    if magic != MAGIC:
+        raise BlockCorruption("integrity trailer missing or overwritten")
+    if algo not in (ALGO_CRC32, ALGO_CRC32C):
+        raise BlockCorruption(f"unknown integrity algo {algo} (corrupt "
+                              f"trailer)")
+    body = blob[:-TRAILER_BYTES]
+    if verify and _checksum(body, algo) != crc:
+        raise BlockCorruption(
+            f"block checksum mismatch ({len(body)} bytes, algo {algo})")
+    return body

@@ -22,8 +22,10 @@ from typing import Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
+from spark_rapids_tpu import faults
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.shuffle import integrity as _integrity
 from spark_rapids_tpu.shuffle.partition import Partitioner
 from spark_rapids_tpu.shuffle.serializer import (
     merge_tables, merge_to_batch, serialize_table,
@@ -55,12 +57,17 @@ class ShuffleManager:
 
     def __init__(self, local_dir: str = "/tmp/srtpu_shuffle",
                  writer_threads: int = 4, reader_threads: int = 4,
-                 codec: str = "none", cache_only: bool = False):
+                 codec: str = "none", cache_only: bool = False,
+                 integrity: Optional[bool] = None):
         from spark_rapids_tpu.mem import cleaner
         cleaner.register_manager(self)
         self.local_dir = local_dir
         self.codec = codec
         self.cache_only = cache_only
+        if integrity is None:
+            from spark_rapids_tpu.config import conf as C
+            integrity = C.SHUFFLE_INTEGRITY.get(C.get_active())
+        self.integrity = bool(integrity)
         self._regs: Dict[int, ShuffleRegistration] = {}
         self._next_id = 0
         self._lock = threading.Lock()
@@ -91,7 +98,12 @@ class ShuffleManager:
         def ser(item):
             pid, tables = item
             t = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
-            return pid, serialize_table(t, self.codec)
+            blob = serialize_table(t, self.codec)
+            # integrity trailer goes on OUTSIDE the kudo frame: merge walks
+            # concatenated frames positionally, so frames must stay pristine
+            if self.integrity:
+                blob = _integrity.seal(blob)
+            return pid, blob
 
         blocks = list(self._write_pool.map(ser, sorted(per_part.items())))
         index: Dict[int, Tuple[int, int]] = {}
@@ -150,23 +162,57 @@ class ShuffleManager:
     # -- read side ---------------------------------------------------------
     def _fetch_blocks(self, reg: ShuffleRegistration, partition: int,
                       map_start: int = 0,
-                      map_end: Optional[int] = None) -> List[bytes]:
+                      map_end: Optional[int] = None,
+                      raw: bool = False) -> List[bytes]:
         """Fetch a reduce partition's blocks from map outputs [map_start,
-        map_end) (pool). The map range supports AQE skew-split reads."""
+        map_end) (pool). The map range supports AQE skew-split reads.
+
+        ``raw=True`` returns blocks still sealed (the DCN block service
+        path: blocks stay sealed across the wire and the reduce side
+        verifies end-to-end); otherwise each block's integrity trailer is
+        verified and stripped here, with re-read-from-source on mismatch.
+        """
 
         def fetch(mo: _MapOutput) -> Optional[bytes]:
             if mo.cached is not None:
-                return mo.cached.get(partition)
-            loc = mo.index.get(partition)
-            if loc is None:
+                blob = mo.cached.get(partition)
+            else:
+                loc = mo.index.get(partition)
+                if loc is None:
+                    return None
+                with open(mo.path, "rb") as f:
+                    f.seek(loc[0])
+                    blob = f.read(loc[1])
+            if blob is None:
                 return None
-            with open(mo.path, "rb") as f:
-                f.seek(loc[0])
-                return f.read(loc[1])
+            faults.check("shuffle.block", shuffle=reg.shuffle_id,
+                         partition=partition)
+            return faults.corrupt("shuffle.block", blob,
+                                  shuffle=reg.shuffle_id, partition=partition)
+
+        def fetch_verified(mo: _MapOutput) -> Optional[bytes]:
+            blob = fetch(mo)
+            if blob is None or raw or not self.integrity:
+                return blob
+            last: Optional[Exception] = None
+            for attempt in range(3):
+                try:
+                    body = _integrity.unseal(blob)
+                    if attempt:
+                        faults.note_recovered("shuffle.block")
+                    return body
+                except _integrity.BlockCorruption as e:
+                    last = e
+                    blob = fetch(mo)  # refetch from the source of truth
+                    if blob is None:
+                        break
+            raise _integrity.BlockCorruption(
+                f"persistent corruption in shuffle {reg.shuffle_id} "
+                f"partition {partition}: {last}")
 
         with reg.lock:
             outputs = reg.map_outputs[map_start:map_end]
-        return [b for b in self._read_pool.map(fetch, outputs)
+        return [b for b in self._read_pool.map(fetch_verified, outputs)
                 if b is not None]
 
     def read_partition(self, reg: ShuffleRegistration,

@@ -26,6 +26,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 import pyarrow as pa
 
+from spark_rapids_tpu import faults
 from spark_rapids_tpu import types as T
 
 _MAGIC = 0x54505553
@@ -65,6 +66,7 @@ def serialize_table(table: pa.Table, codec: str = "none") -> bytes:
     """Arrow table (host, already partition-sliced) -> wire bytes."""
     n_rows = table.num_rows
     n_cols = table.num_columns
+    faults.check("shuffle.serialize", rows=n_rows, cols=n_cols)
     header = [struct.pack("<IIIBxxx", _MAGIC, n_rows, n_cols, _CODECS[codec])]
     bufs: List[bytes] = []
     for col in table.columns:

@@ -15,11 +15,14 @@ protocol layer is transport-agnostic exactly like the reference's).
 from __future__ import annotations
 
 import queue
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
+from spark_rapids_tpu import faults
 from spark_rapids_tpu.shuffle.protocol import (
     BlockId,
     BufferChunk,
@@ -276,11 +279,52 @@ class ShuffleClient:
         return txn
 
     def fetch(self, blocks: List[BlockId],
-              timeout: Optional[float] = 30.0) -> List[bytes]:
+              timeout: Optional[float] = 30.0,
+              max_attempts: Optional[int] = None,
+              backoff_ms: Optional[float] = None,
+              deadline: Optional[float] = None) -> List[bytes]:
+        """Fetch with retry: exponential backoff + jitter per attempt and an
+        overall wall-clock deadline (spark.rapids.tpu.shuffle.fetch.*).
+
+        Only transient failures retry — timeouts and connection-level
+        errors; protocol errors (peer answered with ErrorMessage) propagate
+        immediately as RuntimeError from Transaction.wait."""
+        from spark_rapids_tpu.config import conf as C
+        active = C.get_active()
+        if max_attempts is None:
+            max_attempts = C.SHUFFLE_FETCH_MAX_ATTEMPTS.get(active)
+        if backoff_ms is None:
+            backoff_ms = C.SHUFFLE_FETCH_BACKOFF_MS.get(active)
+        if deadline is None:
+            deadline = C.SHUFFLE_FETCH_DEADLINE_S.get(active)
+        give_up_at = time.monotonic() + deadline
+        attempt = 0
+        while True:
+            attempt += 1
+            budget = give_up_at - time.monotonic()
+            if timeout is not None:
+                budget = min(budget, timeout)
+            try:
+                result = self._fetch_once(blocks, max(budget, 0.001))
+                if attempt > 1:
+                    faults.note_recovered("shuffle.fetch")
+                return result
+            except (TimeoutError, ConnectionError, OSError):
+                if attempt >= max_attempts:
+                    raise
+                pause = (backoff_ms / 1000.0) * (1 << (attempt - 1)) \
+                    * (0.5 + random.random())
+                if time.monotonic() + pause >= give_up_at:
+                    raise
+                time.sleep(pause)
+
+    def _fetch_once(self, blocks: List[BlockId],
+                    timeout: Optional[float]) -> List[bytes]:
         """Full doFetch: metadata -> plan receive -> transfer -> blocks.
 
         Timed-out transactions are discarded so retries against a stalled
         peer can't accumulate pre-allocated receive buffers."""
+        faults.check("shuffle.fetch", n=len(blocks))
         meta_txn = self.request_metadata(blocks)
         try:
             sizes = meta_txn.wait(timeout)

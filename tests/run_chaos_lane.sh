@@ -1,0 +1,13 @@
+#!/bin/sh
+# Chaos lane: every tracker TPC-H/TPC-DS query runs under a seeded fault
+# schedule (injected OOMs, corrupted shuffle blocks, slow serializes,
+# dropped fetches) and must be bit-identical to the fault-free run with
+# srtpu_fault_recovered_total > 0 — the acceptance net for the hardened
+# retry/refetch/degradation paths (docs/fault_injection.md). The executor
+# kill + recompute paths run in the cluster suite (tests/run_slow_lane.sh).
+#
+# SRTPU_FAULTS_SEED pins the schedule so failures reproduce exactly.
+set -e
+cd "$(dirname "$0")/.."
+SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}" \
+    exec python -m pytest tests/test_faults.py -q "$@"

@@ -1,12 +1,15 @@
 #!/bin/sh
 # Slow differential lane: multi-process cluster, distributed-vs-local TPC-H/
-# TPC-DS comparisons, and the ScaleTest harness — minutes each, opt-in so
-# the default lane stays fast (VERDICT r4 weak #6). CI should run BOTH:
+# TPC-DS comparisons, the ScaleTest harness, and the seeded chaos lane —
+# minutes each, opt-in so the default lane stays fast (VERDICT r4 weak #6).
+# CI should run BOTH:
 #   python -m pytest tests/ -q            # default lane
 #   tests/run_slow_lane.sh                # this lane
 set -e
 cd "$(dirname "$0")/.."
-SRTPU_SLOW_LANE=1 exec python -m pytest \
+SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}" \
+    exec python -m pytest \
     tests/test_distributed.py tests/test_cluster.py \
     tests/test_tpcds.py tests/test_scaletest.py \
-    tests/test_fusion_diff.py tests/test_pipeline.py -q "$@"
+    tests/test_fusion_diff.py tests/test_pipeline.py \
+    tests/test_faults.py -q "$@"

@@ -148,3 +148,68 @@ def test_cluster_executor_sigkill_recovery(rng):
         out2 = c.run_query(q)
         assert _canon(_rows(out2)) == local
         assert victim in c._dead
+
+
+def test_cluster_executor_kill_fault_recovery(rng):
+    """Satellite: the conf-driven ``executor:kill`` fault hard-exits one
+    executor mid-query (os._exit(137), the Plugin.scala:560 analog) and the
+    query still returns results bit-identical to a fault-free run."""
+    n = 4000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })
+    # worker 1 dies on its SECOND task (skip=1): it completes one map task
+    # first, so its written blocks are LOST and must recompute via lineage
+    fault_conf = RapidsConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.test.faults": "executor:kill@id=1,skip=1",
+    })
+    df_clean = from_arrow(t, _conf(), batch_rows=512, partitions=6)
+    df_clean.shuffle_partitions = 4
+    q_clean = df_clean.group_by("k").agg(E.Sum(col("v")).alias("s"),
+                                         E.Count(col("v")).alias("n"))
+    local = _canon([tuple(r.values()) for r in q_clean.collect()])
+
+    df = from_arrow(t, fault_conf, batch_rows=512, partitions=6)
+    df.shuffle_partitions = 4
+    q = df.group_by("k").agg(E.Sum(col("v")).alias("s"),
+                             E.Count(col("v")).alias("n"))
+    with TcpShuffleCluster(n_workers=3) as c:
+        victim = c.workers[1]
+        out = c.run_query(q)
+        assert _canon(_rows(out)) == local
+        assert victim in c._dead
+        # survivors keep serving queries after the loss
+        out2 = c.run_query(q_clean)
+        assert _canon(_rows(out2)) == local
+
+
+def test_cluster_corrupt_block_refetch_then_recompute(rng):
+    """Blocks served corrupt by one executor are detected by the integrity
+    trailer on the reduce side; persistent corruption triggers recompute of
+    that executor's map outputs on OTHER executors (refetch-then-recompute)
+    and the query completes bit-identically."""
+    n = 4000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })
+    # worker 0 serves every block corrupted (p=1, unbounded): refetch can
+    # never clean it, so the driver must recompute its maps elsewhere
+    fault_conf = RapidsConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.test.faults":
+            "shuffle.block:corrupt@id=0,p=1.0,seed=5",
+    })
+    df_clean = from_arrow(t, _conf(), batch_rows=512, partitions=4)
+    df_clean.shuffle_partitions = 3
+    q_clean = df_clean.group_by("k").agg(E.Sum(col("v")).alias("s"))
+    local = _canon([tuple(r.values()) for r in q_clean.collect()])
+
+    df = from_arrow(t, fault_conf, batch_rows=512, partitions=4)
+    df.shuffle_partitions = 3
+    q = df.group_by("k").agg(E.Sum(col("v")).alias("s"))
+    with TcpShuffleCluster(n_workers=2) as c:
+        out = c.run_query(q)
+        assert _canon(_rows(out)) == local

@@ -246,3 +246,96 @@ def test_shuffle_manager_served_over_transport(tmp_path, rng):
         assert sorted(rows, key=repr) == sorted(t.to_pylist(), key=repr)
     finally:
         server.close()
+
+
+# ---------------------------------------------------------------------------
+# fetch retry / timeout hardening (docs/fault_injection.md)
+# ---------------------------------------------------------------------------
+
+
+class _BlackHole(Connection):
+    """A peer that accepts requests and never answers."""
+
+    def send(self, payload):
+        pass
+
+
+def test_fetch_timeout_releases_transaction_state():
+    """A timed-out fetch must leave no transaction or pre-allocated receive
+    window behind: retries against a stalled peer can't accumulate state."""
+    from spark_rapids_tpu.shuffle.transport import ShuffleClient
+
+    client = ShuffleClient(_BlackHole())
+    with pytest.raises(TimeoutError):
+        client.fetch([BlockId(0, 0, 0)], timeout=0.05,
+                     max_attempts=2, backoff_ms=1.0, deadline=2.0)
+    assert client._pending == {}
+    assert client._recv == {}
+
+
+def test_fetch_deadline_bounds_total_time():
+    """The overall deadline caps wall clock regardless of maxAttempts."""
+    import time
+
+    from spark_rapids_tpu.shuffle.transport import ShuffleClient
+
+    client = ShuffleClient(_BlackHole())
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        client.fetch([BlockId(0, 0, 0)], timeout=0.05,
+                     max_attempts=1000, backoff_ms=1.0, deadline=0.3)
+    assert time.monotonic() - t0 < 5.0
+
+
+class _FlakyServer(ShuffleServer):
+    """Swallows the first ``drop_n`` metadata requests (the peer looks
+    stalled), then behaves normally — the peer "recovers mid-deadline"."""
+
+    def __init__(self, *a, drop_n=1, **k):
+        super().__init__(*a, **k)
+        self.remaining_drops = drop_n
+
+    def handle(self, payload, conn):
+        msg = decode_message(payload)
+        if isinstance(msg, MetadataRequest) and self.remaining_drops > 0:
+            self.remaining_drops -= 1
+            return
+        super().handle(payload, conn)
+
+
+def test_fetch_retry_succeeds_when_peer_recovers(rng):
+    from spark_rapids_tpu import faults
+
+    blob = rng.bytes(4000)
+    server = _FlakyServer(_store({(0, 0, 0): blob}), drop_n=1)
+    client = connect_loopback(server)
+    before = faults.counters()["fault_recovered_total"]
+    got = client.fetch([BlockId(0, 0, 0)], timeout=0.05,
+                       max_attempts=3, backoff_ms=1.0, deadline=10.0)
+    assert got == [blob]
+    assert server.remaining_drops == 0
+    # window fully released; the client keeps working after the episode
+    assert client._pending == {} and client._recv == {}
+    assert client.fetch([BlockId(0, 0, 0)], timeout=1.0) == [blob]
+    assert faults.counters()["fault_recovered_total"] > before
+
+
+def test_injected_fetch_drop_recovered_by_retry(rng):
+    """shuffle.fetch:drop injection is absorbed by the retry path."""
+    from spark_rapids_tpu import faults
+
+    blob = rng.bytes(1000)
+    server = ShuffleServer(_store({(0, 0, 0): blob}))
+    client = connect_loopback(server)
+    faults.install("shuffle.fetch:drop@count=1")
+    try:
+        before = faults.counters()
+        got = client.fetch([BlockId(0, 0, 0)], timeout=1.0,
+                           max_attempts=3, backoff_ms=1.0, deadline=10.0)
+        assert got == [blob]
+        after = faults.counters()
+        assert after["fault_injected_total"] > before["fault_injected_total"]
+        assert (after["fault_recovered_total"]
+                > before["fault_recovered_total"])
+    finally:
+        faults.reset()

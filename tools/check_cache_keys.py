@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Static guard for the jit-cache key contract (VERDICT r5 bug class).
+
+Two programs whose expressions differ only in a non-child parameter (a
+LIKE pattern, a round scale, a trunc format...) MUST produce different
+``cache_key()`` tuples, or they silently share one compiled kernel and
+return wrong results. The convention: such parameters are recorded in
+``self._params``, and the base ``Expression.cache_key`` folds ``_params``
+in through ``_KEY_PRIVATE_ATTRS`` (exprs/expr.py).
+
+This checker fails (exit 1) when either side of that contract breaks:
+
+1. an ``Expression`` subclass assigns ``self._params`` but defines its own
+   ``cache_key()`` that neither mentions ``_params`` nor defers to
+   ``super().cache_key()`` — the parameter would vanish from the key;
+2. ``_KEY_PRIVATE_ATTRS`` in exprs/expr.py no longer contains
+   ``"_params"`` — every ``_params`` in the tree would vanish at once.
+
+Pure AST analysis, no imports of the checked code; wired into the default
+test lane via tests/test_faults.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_rapids_tpu")
+
+
+def _assigns_self_attr(node: ast.AST, attr: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            for t in targets:
+                if (isinstance(t, ast.Attribute) and t.attr == attr
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    return True
+    return False
+
+
+def _mentions_params(fn: ast.AST) -> bool:
+    """cache_key is compliant if it touches _params itself or defers to the
+    base implementation (which folds _params in)."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "_params", "cache_key"):
+            if sub.attr == "cache_key" and isinstance(sub.value, ast.Call) \
+                    and isinstance(sub.value.func, ast.Name) \
+                    and sub.value.func.id == "super":
+                return True
+            if sub.attr == "_params":
+                return True
+        if isinstance(sub, ast.Constant) and sub.value == "_params":
+            return True
+    return False
+
+
+def _check_file(path: str, violations: list) -> None:
+    with open(path, "r") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        violations.append(f"{path}: not parseable: {e}")
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {m.name: m for m in node.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        if "cache_key" not in methods:
+            continue  # inherits the base key, which includes _params
+        if not _assigns_self_attr(node, "_params"):
+            continue
+        if not _mentions_params(methods["cache_key"]):
+            rel = os.path.relpath(path, REPO)
+            violations.append(
+                f"{rel}:{node.lineno}: class {node.name} assigns "
+                f"self._params but its cache_key() neither includes "
+                f"_params nor calls super().cache_key() — parameterized "
+                f"programs would share one compiled kernel (VERDICT r5)")
+
+
+def _check_key_private_attrs(violations: list) -> None:
+    path = os.path.join(PKG, "exprs", "expr.py")
+    with open(path, "r") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "_KEY_PRIVATE_ATTRS":
+                    try:
+                        vals = ast.literal_eval(node.value)
+                    except ValueError:
+                        vals = ()
+                    if "_params" in vals:
+                        return
+                    violations.append(
+                        "spark_rapids_tpu/exprs/expr.py: _KEY_PRIVATE_ATTRS "
+                        "no longer contains '_params' — every _params "
+                        "parameter would vanish from cache keys")
+                    return
+    violations.append(
+        "spark_rapids_tpu/exprs/expr.py: _KEY_PRIVATE_ATTRS not found "
+        "(cache_key contract changed? update tools/check_cache_keys.py)")
+
+
+def main() -> int:
+    violations: list = []
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                _check_file(os.path.join(dirpath, fn), violations)
+    _check_key_private_attrs(violations)
+    if violations:
+        print("cache-key guard FAILED:", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("cache-key guard OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
