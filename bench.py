@@ -578,4 +578,9 @@ if __name__ == "__main__":
                          "chaos runs always execute the full differential "
                          "check (docs/fault_injection.md)")
     _args = ap.parse_args()
+    if _args.budget is None and not sys.stdout.isatty():
+        # non-interactive bare run (CI/harness): a full unbudgeted sweep can
+        # outlive the caller's timeout and lose the final metric line —
+        # default to a conservative budget instead
+        _args.budget = float(os.environ.get("SRTPU_BENCH_BUDGET_S", "600"))
     main(budget_s=_args.budget, faults=_args.faults)

@@ -517,6 +517,29 @@ SHUFFLE_WRITE_THREADS = conf(
         "1 restores the fully serial write.",
     check=lambda v: None if v >= 1 else "must be >= 1")
 
+REUSE_ENABLED = conf(
+    "spark.rapids.tpu.sql.exchange.reuse.enabled", default=True,
+    doc="Collapse semantically-equal exchange/broadcast/DPP-subquery "
+        "subtrees of a physical plan into ReusedExchange/ReusedBroadcast "
+        "aliases of one surviving materialization (Spark's "
+        "ReuseExchangeAndSubquery analog, plan/reuse.py). Runs before "
+        "fusion so fused stages see the rewritten plan.")
+
+REUSE_CACHE_MAX_BYTES = conf(
+    "spark.rapids.tpu.sql.exchange.reuse.cache.maxBytes", default=2 << 30,
+    doc="Byte cap on reduce-side batches the reuse materialization cache "
+        "may pin as SpillableBatches across all shared exchanges. An entry "
+        "denied admission falls back to re-reading the shuffle manager "
+        "(still one map-side materialization) — the cap bounds memory, "
+        "never correctness.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+REUSE_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.sql.exchange.reuse.cache.maxEntries", default=64,
+    doc="Cap on distinct shared-exchange entries admitted to the reuse "
+        "materialization cache at once.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
 
 _ACTIVE: "Optional[RapidsConf]" = None
 

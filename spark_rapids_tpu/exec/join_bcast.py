@@ -60,6 +60,9 @@ class BroadcastHashJoinExec(HashJoinExec):
                          condition)
         self._broadcast = None
         self._bcast_lock = threading.Lock()
+        # set by plan/reuse.py when another join shares this build side: a
+        # SharedBroadcast holder publishing one prepared (build, jh) pair
+        self._shared_broadcast = None
         self._register_metric("broadcastTimeNs")
 
     def num_partitions(self) -> int:
@@ -70,6 +73,18 @@ class BroadcastHashJoinExec(HashJoinExec):
         # writes / prefetch workers, and the build must execute exactly once
         with self._bcast_lock:
             if self._broadcast is None:
+                holder = self._shared_broadcast
+                if holder is not None:
+                    shared = holder.get()
+                    if shared is not None:
+                        # another join with the identical build side (same
+                        # fingerprint + key ordinals) already concatenated
+                        # and hashed it — adopt instead of rebuilding
+                        from spark_rapids_tpu.exec import reuse as _reuse
+                        _reuse.note("reuse_bytes_saved_total",
+                                    int(shared[0].nbytes()))
+                        self._broadcast = shared
+                        return self._broadcast
                 with self.timer("broadcastTimeNs"):
                     batches = list(self.right.execute_all())
                     if batches:
@@ -81,6 +96,8 @@ class BroadcastHashJoinExec(HashJoinExec):
                     jh = jax.jit(K.prepare_join_side, static_argnums=1)(
                         build, tuple(self._rkeys))
                 self._broadcast = (build, jh)
+                if holder is not None:
+                    holder.put(self._broadcast)
             return self._broadcast
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:

@@ -261,6 +261,7 @@ def insert_prefetch(ex: TpuExec, conf) -> TpuExec:
     enabled, depth = prefetch_settings(conf)
     if not enabled:
         return ex
+    from spark_rapids_tpu.exec.reuse import ReusedExchangeExec
     from spark_rapids_tpu.exec.scan import FileScanBase
     from spark_rapids_tpu.plan.cpu import CpuExec
     from spark_rapids_tpu.shuffle.aqe import AQEShuffleReadExec
@@ -271,11 +272,11 @@ def insert_prefetch(ex: TpuExec, conf) -> TpuExec:
             node.children[i] = walk(ch, node)
         if isinstance(node, PrefetchExec):
             return node
-        if (isinstance(node, ShuffleExchangeExec)
+        if (isinstance(node, (ShuffleExchangeExec, ReusedExchangeExec))
                 and isinstance(parent, AQEShuffleReadExec)):
             return node
         if isinstance(node, (FileScanBase, ShuffleExchangeExec,
-                             AQEShuffleReadExec)):
+                             ReusedExchangeExec, AQEShuffleReadExec)):
             return PrefetchExec(node, depth)
         if (isinstance(node, CpuExec) and parent is not None
                 and not isinstance(parent, CpuExec)):

@@ -1,0 +1,410 @@
+"""Runtime side of plan-wide computation reuse.
+
+Reference: Spark's ReuseExchangeAndSubquery rule plus the plugin replaying
+materialized exchanges per consumer (GpuBroadcastExchangeExec.scala:354
+uploads the broadcast once per task from one host materialization;
+ReusedExchangeExec aliases a shuffle stage). The plan-time rewrite lives in
+plan/reuse.py; this module owns what runs during the query:
+
+- ``ReusedExchangeExec`` / ``ReusedBroadcastExec`` — leaf aliases of a
+  surviving materialization. Deliberately LEAVES: the survivor is referenced
+  by attribute, not as a structural child, so plan walks stay tree-shaped
+  and the shared subtree executes exactly once.
+- ``SharedExchangeEntry`` — refcounted per-plan cache of one exchange's
+  reduce-side output, batches held as ``SpillableBatch``es (mem/spill.py)
+  so a cached partition is evictable under HBM pressure.
+- ``MaterializationCache`` — process-wide byte/entry accounting capping how
+  much the entries may pin (spark.rapids.tpu.sql.exchange.reuse.cache.*).
+- ``SharedBroadcast`` — holder sharing one prepared (build batch, join
+  hashes) pair between broadcast joins with an identical build side
+  (exec/join_bcast.py consults it under its build lock).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable, Dict, Iterator, List, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import LeafExec, TpuExec
+
+
+# ---------------------------------------------------------------------------
+# counters (obs/gauges.py merges these into snapshot())
+# ---------------------------------------------------------------------------
+
+_counters_lock = threading.Lock()
+_counters: Dict[str, int] = {
+    "reuse_exchanges_total": 0,
+    "reuse_broadcasts_total": 0,
+    "reuse_subqueries_total": 0,
+    "reuse_bytes_saved_total": 0,
+}
+
+
+def note(name: str, n: int = 1) -> None:
+    with _counters_lock:
+        _counters[name] += int(n)
+
+
+def counters() -> Dict[str, int]:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# spill framework acquisition
+# ---------------------------------------------------------------------------
+
+_fw_lock = threading.Lock()
+_owned_fw = None  # strong ref: cleaner._frameworks is a WeakSet
+
+
+def _framework():
+    """A SpillFramework over the active pool. An already-registered
+    framework for that pool is reused — SpillFramework.__init__ installs
+    itself as the pool's spill callback, so stacking a second one over the
+    same pool would silently disconnect the first."""
+    from spark_rapids_tpu.mem import cleaner
+    from spark_rapids_tpu.mem.pool import get_pool
+    from spark_rapids_tpu.mem.spill import SpillFramework
+
+    global _owned_fw
+    pool = get_pool()
+    with _fw_lock:
+        with cleaner._lock:
+            existing = [fw for fw in cleaner._frameworks
+                        if isinstance(fw, SpillFramework)
+                        and getattr(fw, "pool", None) is pool]
+        if existing:
+            return existing[0]
+        _owned_fw = SpillFramework(pool)
+        return _owned_fw
+
+
+# ---------------------------------------------------------------------------
+# materialization cache accounting
+# ---------------------------------------------------------------------------
+
+
+class MaterializationCache:
+    """Process-wide budget for cached exchange materializations. An entry
+    denied admission becomes a passthrough: its consumers re-read from the
+    shuffle manager, which is still one map-side materialization — the cap
+    only bounds reduce-side batch pinning, never correctness."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes_used = 0
+        self.entry_count = 0
+        self._admitted: set = set()  # id(entry)
+
+    @staticmethod
+    def _caps():
+        from spark_rapids_tpu.config import conf as C
+        cfg = C.get_active()
+        return (C.REUSE_CACHE_MAX_BYTES.get(cfg),
+                C.REUSE_CACHE_MAX_ENTRIES.get(cfg))
+
+    def admit(self, entry, nbytes: int) -> bool:
+        max_bytes, max_entries = self._caps()
+        with self._lock:
+            new_entry = id(entry) not in self._admitted
+            if new_entry and self.entry_count >= max_entries:
+                return False
+            if self.bytes_used + nbytes > max_bytes:
+                return False
+            if new_entry:
+                self._admitted.add(id(entry))
+                self.entry_count += 1
+            self.bytes_used += nbytes
+            return True
+
+    def evict(self, entry, nbytes: int) -> None:
+        with self._lock:
+            self.bytes_used -= nbytes
+            if id(entry) in self._admitted:
+                self._admitted.discard(id(entry))
+                self.entry_count -= 1
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"bytes_used": self.bytes_used,
+                    "entries": self.entry_count}
+
+
+MATERIALIZATION_CACHE = MaterializationCache()
+
+# safety net for direct plan executors that never run the DataFrame cleanup
+# walk (tests/conftest.py releases stragglers before the leak sweep)
+_live_entries: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def release_stragglers() -> None:
+    for e in list(_live_entries):
+        e.force_release()
+
+
+_UNCACHED = object()
+
+
+class SharedExchangeEntry:
+    """One shared exchange materialization: the survivor exchange and every
+    ``ReusedExchangeExec`` consumer read partitions through here. The first
+    reader of a partition runs the producer and caches the batches as
+    SpillableBatches; later readers replay the handles, pinned one batch at
+    a time so the whole partition never has to stay device-resident.
+
+    Refcounted: ``retain()`` per consumer at plan time, ``release()`` per
+    consumer at query cleanup. Hitting zero closes the handles and RESETS
+    the refcount, so a re-executed plan materializes afresh — mirroring
+    ShuffleExchangeExec.cleanup() flipping ``_written`` back."""
+
+    def __init__(self, cache: Optional[MaterializationCache] = None):
+        self._cache = cache or MATERIALIZATION_CACHE
+        self._lock = threading.Lock()
+        self._plocks: Dict[int, threading.Lock] = {}
+        self._parts: Dict[int, object] = {}
+        self._initial_refs = 0
+        self._refs = 0
+        _live_entries.add(self)
+
+    def retain(self, n: int = 1) -> None:
+        with self._lock:
+            self._initial_refs += n
+            self._refs += n
+
+    def cached_partitions(self) -> int:
+        with self._lock:
+            return sum(1 for v in self._parts.values() if v is not _UNCACHED)
+
+    def refs(self) -> int:
+        with self._lock:
+            return self._refs
+
+    def _plock(self, partition: int) -> threading.Lock:
+        with self._lock:
+            return self._plocks.setdefault(partition, threading.Lock())
+
+    def read(self, partition: int,
+             producer: Callable[[], Iterator[ColumnarBatch]]
+             ) -> Iterator[ColumnarBatch]:
+        with self._plock(partition):
+            with self._lock:
+                cached = self._parts.get(partition)
+            if cached is None:
+                # eager materialization ON the first consumer's thread and
+                # UNDER the partition lock: a generator holding the lock
+                # across yields could deadlock two consumers interleaved on
+                # one thread, and the exchange read path materializes the
+                # whole partition table anyway (shuffle/exchange_exec.py)
+                batches = list(producer())
+                handles = self._try_cache(batches)
+                with self._lock:
+                    self._parts[partition] = (_UNCACHED if handles is None
+                                              else handles)
+                return iter(batches)
+        if cached is _UNCACHED:
+            return producer()
+        note("reuse_bytes_saved_total", sum(h.nbytes for h in cached))
+        return self._replay(cached)
+
+    def _try_cache(self, batches: List[ColumnarBatch]):
+        from spark_rapids_tpu.mem.spill import SpillableBatch
+
+        nbytes = sum(b.nbytes() + 4 for b in batches)
+        if not self._cache.admit(self, nbytes):
+            return None
+        handles: List = []
+        try:
+            fw = _framework()
+            for b in batches:
+                handles.append(SpillableBatch(b, fw))
+        except Exception:
+            # a capped pool may refuse the handle registration even after
+            # spilling — fall back to passthrough, never fail the query
+            for h in handles:
+                h.close()
+            self._cache.evict(self, nbytes)
+            return None
+        return handles
+
+    @staticmethod
+    def _replay(handles):
+        for h in handles:
+            with h as batch:
+                yield batch
+
+    def release(self) -> None:
+        with self._lock:
+            self._refs -= 1
+            if self._refs > 0:
+                return
+            parts, self._parts = self._parts, {}
+            self._plocks = {}
+            self._refs = self._initial_refs
+        self._close_parts(parts)
+
+    def force_release(self) -> None:
+        """Drop everything regardless of refcount (end-of-process sweep)."""
+        with self._lock:
+            parts, self._parts = self._parts, {}
+            self._plocks = {}
+            self._refs = self._initial_refs
+        self._close_parts(parts)
+
+    def _close_parts(self, parts: Dict[int, object]) -> None:
+        freed = 0
+        for v in parts.values():
+            if v is _UNCACHED:
+                continue
+            for h in v:
+                freed += h.nbytes
+                h.close()
+        if parts:
+            self._cache.evict(self, freed)
+
+
+# ---------------------------------------------------------------------------
+# shared broadcast holder
+# ---------------------------------------------------------------------------
+
+
+class SharedBroadcast:
+    """Plan-time holder shared by broadcast joins whose (build fingerprint,
+    build-key indices) match: the first join to build publishes its prepared
+    (build batch, join hashes) pair; later joins adopt it instead of
+    re-concatenating and re-hashing the same build side. The fused path
+    composes for free — ``_fused_build_side`` goes through the same
+    ``_build_broadcast`` (exec/join_bcast.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = None
+
+    def get(self):
+        with self._lock:
+            return self._value
+
+    def put(self, value) -> None:
+        with self._lock:
+            if self._value is None:
+                self._value = value
+
+
+# ---------------------------------------------------------------------------
+# reused nodes
+# ---------------------------------------------------------------------------
+
+
+class ReusedExchangeExec(LeafExec):
+    """Aliases an already-planned shuffle exchange (Spark ReusedExchangeExec).
+
+    Captures the replaced duplicate's output schema: shuffle payloads are
+    positional, so aliasing a renamed-but-equal subtree is a schema swap,
+    never a physical projection. Exposes the exchange surface AQE readers,
+    the skew-join planner and the cluster lane touch (``_ensure_written``,
+    ``manager``, ``_reg``, ``partitioner``) by delegation to the survivor,
+    so every consumer shares one shuffle registration."""
+
+    def __init__(self, target, schema: T.Schema, reuse_id: int, entry=None):
+        super().__init__()
+        self.target = target
+        self._schema = schema
+        self.reuse_id = reuse_id
+        self.entry = entry
+        self._counted_write_skip = False
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return self.target.num_partitions()
+
+    # -- delegated exchange surface (shuffle/aqe.py, shuffle/cluster.py) ----
+    @property
+    def partitioner(self):
+        return self.target.partitioner
+
+    @property
+    def manager(self):
+        return self.target.manager
+
+    @property
+    def _reg(self):
+        return self.target._reg
+
+    @property
+    def target_batch_rows(self):
+        return self.target.target_batch_rows
+
+    def _ensure_written(self) -> None:
+        self.target._ensure_written()
+        if not self._counted_write_skip:
+            # one map-side materialization serves the whole reuse group, so
+            # each Reused consumer is one avoided re-run — credit it once
+            # per consumer regardless of which consumer's call did the
+            # physical write (execution order is build-side dependent)
+            self._counted_write_skip = True
+            try:
+                sizes = self.target.manager.partition_sizes(self.target._reg)
+                note("reuse_bytes_saved_total", int(sum(sizes)))
+            except Exception:
+                pass
+
+    def node_description(self) -> str:
+        return f"ReusedExchange (reuses #{self.reuse_id})"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        self._ensure_written()
+        if self.entry is None:
+            yield from self.target._produce(partition)
+            return
+        yield from self.entry.read(
+            partition, lambda: self.target._produce(partition))
+
+    def cleanup(self) -> None:
+        self._counted_write_skip = False
+        if self.entry is not None:
+            self.entry.release()
+
+
+class ReusedBroadcastExec(LeafExec):
+    """Aliases a materialized broadcast build side (a ReplayExec) — the
+    analog of the reference replaying one GpuBroadcastExchangeExec across
+    every consumer join."""
+
+    def __init__(self, target, schema: T.Schema, reuse_id: int):
+        super().__init__()
+        self.target = target
+        self._schema = schema
+        self.reuse_id = reuse_id
+
+    @property
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return self.target.num_partitions()
+
+    def node_description(self) -> str:
+        return f"ReusedBroadcast (reuses #{self.reuse_id})"
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        already = getattr(self.target, "_cache", None)
+        if already is not None:
+            try:
+                note("reuse_bytes_saved_total",
+                     sum(int(b.nbytes()) for b in already[partition]))
+            except Exception:
+                pass
+        yield from self.target.execute(partition)

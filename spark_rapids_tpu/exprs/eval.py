@@ -32,6 +32,11 @@ from spark_rapids_tpu.columnar.column import ColVal, DeviceColumn
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.exprs import expr as E
 
+# imported at module scope deliberately: cast_strings builds module-level
+# jnp constants, and a first import from inside a jitted body (the fused
+# path traces _cast_to_string) would capture them as tracers that leak
+# into every later use
+from spark_rapids_tpu.exprs import cast_strings as CS
 
 from spark_rapids_tpu.exprs.strings import StringVal, row_ids as _string_row_ids
 
@@ -388,8 +393,6 @@ def cast_val(cv: Val, src: T.DataType, dst: T.DataType, ansi: bool,
 def _cast_to_string(cv: Val, src: T.DataType) -> StringVal:
     """value -> string on device (reference GpuCast.scala:1713 + jni
     CastStrings; float->string stays on CPU — gated in check_expr)."""
-    from spark_rapids_tpu.exprs import cast_strings as CS
-
     if isinstance(cv, WideVal):
         assert isinstance(src, T.DecimalType)
         return CS.decimal_to_string(cv.lo, cv.hi, src.scale, cv.validity)
@@ -410,8 +413,6 @@ def _cast_to_string(cv: Val, src: T.DataType) -> StringVal:
 def _cast_from_string(cv: "StringVal", dst: T.DataType, capacity: int) -> Val:
     """string -> value on device (reference GpuCast.scala:288 + jni
     CastStrings; string->decimal and ANSI-mode stay on CPU)."""
-    from spark_rapids_tpu.exprs import cast_strings as CS
-
     if dst in (T.STRING, T.BINARY):
         return cv
     if dst in T.INTEGRAL_TYPES:

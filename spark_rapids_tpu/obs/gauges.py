@@ -62,6 +62,15 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "block refetched clean, fetch retry connected, lost output recomputed"),
     ("fault_degraded_total", "counter",
      "Queries that gave up on the device and completed on the CPU engine"),
+    ("reuse_exchanges_total", "counter",
+     "Repeated shuffle-exchange subtrees collapsed to ReusedExchange"),
+    ("reuse_broadcasts_total", "counter",
+     "Repeated broadcast builds collapsed to ReusedBroadcast"),
+    ("reuse_subqueries_total", "counter",
+     "DPP/subquery filters deduped or repointed at a shared build"),
+    ("reuse_bytes_saved_total", "counter",
+     "Bytes a consumer replayed from a shared materialization instead of "
+     "recomputing (docs/exchange_reuse.md)"),
 ]
 
 
@@ -110,6 +119,8 @@ def snapshot() -> Dict[str, int]:
     out.update(_pl.STATS.snapshot())
     from spark_rapids_tpu import faults as _faults
     out.update(_faults.counters())
+    from spark_rapids_tpu.exec import reuse as _reuse
+    out.update(_reuse.counters())
     return out
 
 

@@ -36,7 +36,16 @@ import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # older jax: experimental path, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, **kw)
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import (ColumnarBatch, batch_from_arrow,
@@ -355,6 +364,17 @@ class MeshExecutor:
             return self._mark(node, self._lower(node.exchange))
         if isinstance(node, ShuffleExchangeExec):
             return self._mark(node, self._lower_exchange(node))
+        from spark_rapids_tpu.exec.reuse import ReusedExchangeExec
+        if isinstance(node, ReusedExchangeExec):
+            # alias of an already-planned exchange: lower the survivor (the
+            # SPMD program re-shuffles; host fallback delegates lazily too)
+            return self._mark(node, self._lower(node.target))
+        from spark_rapids_tpu.exec.fused import TpuFusedStageExec
+        if isinstance(node, TpuFusedStageExec):
+            # the fused stage is a host dispatch-count optimization; inside
+            # the SPMD program lower its constituents (the fallback keeps
+            # the exact unfused chain with children links intact)
+            return self._lower(node._fallback)
         if isinstance(node, HashAggregateExec):
             return self._mark(node, self._lower_agg(node))
         if isinstance(node, BroadcastHashJoinExec):

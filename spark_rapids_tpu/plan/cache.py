@@ -11,7 +11,9 @@ footprint than raw device/host batches.
 from __future__ import annotations
 
 import io
-from typing import Iterator, List
+import threading
+from collections import OrderedDict
+from typing import Iterator, List, Tuple
 
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -19,6 +21,16 @@ import pyarrow.parquet as pq
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
 from spark_rapids_tpu.exec.base import LeafExec, TpuExec
+
+# fingerprint -> (source root, relation): caching a subtree semantically
+# equal to one already cached returns the existing relation instead of
+# re-executing (e.g. df.cache() over an already-materialized reused
+# exchange). The source root is held STRONGLY on purpose — fingerprints
+# embed object ids (source parts, shuffle managers) that stay valid only
+# while those objects are alive; a bounded LRU keeps the pinning small.
+_MEMO_CAP = 16
+_memo: "OrderedDict[tuple, Tuple[TpuExec, CachedRelation]]" = OrderedDict()
+_memo_lock = threading.Lock()
 
 
 class CachedRelation(LeafExec):
@@ -34,7 +46,24 @@ class CachedRelation(LeafExec):
 
     @staticmethod
     def cache(node: TpuExec, compression: str = "zstd") -> "CachedRelation":
-        """Execute ``node`` once and capture every batch as parquet bytes."""
+        """Execute ``node`` once and capture every batch as parquet bytes.
+
+        Keyed by the canonical plan fingerprint (plan/reuse.py): a second
+        cache of a semantically-equal subtree — same plan renamed, or a
+        reused exchange whose survivor was already cached — returns the
+        existing relation without re-executing."""
+        from spark_rapids_tpu.plan.reuse import plan_fingerprint
+
+        try:
+            key = (plan_fingerprint(node), compression)
+        except Exception:
+            key = None
+        if key is not None:
+            with _memo_lock:
+                hit = _memo.get(key)
+                if hit is not None:
+                    _memo.move_to_end(key)
+                    return hit[1]
         schema = node.output_schema
         parts: List[List[bytes]] = []
         for p in range(node.num_partitions()):
@@ -45,7 +74,13 @@ class CachedRelation(LeafExec):
                 pq.write_table(t, buf, compression=compression)
                 blobs.append(buf.getvalue())
             parts.append(blobs)
-        return CachedRelation(parts, schema)
+        rel = CachedRelation(parts, schema)
+        if key is not None:
+            with _memo_lock:
+                _memo[key] = (node, rel)
+                while len(_memo) > _MEMO_CAP:
+                    _memo.popitem(last=False)
+        return rel
 
     @property
     def output_schema(self) -> T.Schema:

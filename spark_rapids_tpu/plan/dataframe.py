@@ -233,8 +233,10 @@ class DataFrame:
             self._last_profile = prof
 
             # release shuffle files/blocks now that output is materialized
+            from spark_rapids_tpu.exec.reuse import ReusedExchangeExec
+
             def walk(n):
-                if isinstance(n, ShuffleExchangeExec):
+                if isinstance(n, (ShuffleExchangeExec, ReusedExchangeExec)):
                     n.cleanup()
                 for c in n.children:
                     walk(c)

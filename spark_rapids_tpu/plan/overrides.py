@@ -604,6 +604,12 @@ class Overrides:
         if self.conf[_cbo.CBO_ENABLED]:
             _cbo.CostBasedOptimizer(self.conf).optimize(meta)
         ex = self._convert(meta)
+        # computation reuse BEFORE fusion: fused stages must see the
+        # ReusedExchange/ReusedBroadcast leaves so a deduped subtree is
+        # never re-fused (and rebuilt) per consumer (plan/reuse.py)
+        from spark_rapids_tpu.plan.reuse import apply_reuse
+
+        ex = apply_reuse(ex, self.conf)
         if C.FUSION_ENABLED.get(self.conf):
             from spark_rapids_tpu.exec.fused import fuse_exec
 

@@ -97,6 +97,11 @@ def _find_agg_exchange(plan):
                 ex = ex.children[0]
             if isinstance(ex, AQEShuffleReadExec):
                 ex = ex.exchange
+            # a reused exchange aliases its survivor's registration — all
+            # consumers resolve to ONE shuffle id (plan/reuse.py)
+            from spark_rapids_tpu.exec.reuse import ReusedExchangeExec
+            if isinstance(ex, ReusedExchangeExec):
+                ex = ex.target
             if isinstance(ex, ShuffleExchangeExec) and isinstance(
                     ex.partitioner, HashPartitioner):
                 found.append((node, ex))

@@ -45,6 +45,11 @@ class ShuffleExchangeExec(UnaryExec):
         self._ra: dict = {}
         self._ra_lock = threading.Lock()
         self._ra_pool: Optional[cf.ThreadPoolExecutor] = None
+        # plan-wide reuse (plan/reuse.py): when this exchange survives a
+        # dedupe, _shared caches reduce partitions for its ReusedExchangeExec
+        # consumers and reuse_id tags the explain output
+        self._shared = None
+        self.reuse_id: Optional[int] = None
         self._register_metric("writeTimeNs")
         self._register_metric("readTimeNs")
 
@@ -52,8 +57,11 @@ class ShuffleExchangeExec(UnaryExec):
         return self.partitioner.num_partitions
 
     def node_description(self) -> str:
-        return (f"TpuShuffleExchange {type(self.partitioner).__name__}"
+        desc = (f"TpuShuffleExchange {type(self.partitioner).__name__}"
                 f"({self.partitioner.num_partitions})")
+        if self.reuse_id is not None:
+            desc += f" [reuse #{self.reuse_id}]"
+        return desc
 
     @staticmethod
     def _write_threads() -> int:
@@ -121,6 +129,8 @@ class ShuffleExchangeExec(UnaryExec):
                 self.manager.cleanup(self._reg)
                 self._reg = None
                 self._written = False
+        if self._shared is not None:
+            self._shared.release()
 
     # -- read side ---------------------------------------------------------
     def _read_table(self, partition: int):
@@ -155,7 +165,7 @@ class ShuffleExchangeExec(UnaryExec):
                     1, thread_name_prefix="srtpu-shufr")
             self._ra[nxt] = self._ra_pool.submit(self._read_table, nxt)
 
-    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+    def _produce(self, partition: int) -> Iterator[ColumnarBatch]:
         self._ensure_written()
         table = self._take_or_read(partition)
         self._schedule_read_ahead(partition)
@@ -165,3 +175,13 @@ class ShuffleExchangeExec(UnaryExec):
         for start in range(0, table.num_rows, self.target_batch_rows):
             chunk = table.slice(start, self.target_batch_rows)
             yield batch_from_arrow(chunk)
+
+    def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        if self._shared is None:
+            yield from self._produce(partition)
+            return
+        # survivor of a reuse rewrite: route through the shared entry so
+        # the first consumer (this exchange or any ReusedExchangeExec)
+        # caches the partition and later ones replay it
+        yield from self._shared.read(
+            partition, lambda: self._produce(partition))

@@ -52,7 +52,7 @@ def rng():
 # minutes each and run via tests/run_slow_lane.sh (SRTPU_SLOW_LANE=1) —
 # the default lane stays fast. CI/driver should run both.
 SLOW_LANE_MODULES = ("test_distributed", "test_cluster", "test_tpcds",
-                     "test_scaletest", "test_fusion_diff")
+                     "test_scaletest", "test_fusion_diff", "test_reuse_diff")
 SLOW_LANE = os.environ.get("SRTPU_SLOW_LANE") == "1"
 
 
@@ -84,6 +84,14 @@ def pytest_sessionfinish(session, exitstatus):
         from spark_rapids_tpu.mem import cleaner
     except Exception:
         return
+    try:
+        # tests that drive physical_plan() directly never run the DataFrame
+        # cleanup walk — drop any reuse-cache entries they left pinned
+        # before the pool-balance sweep below
+        from spark_rapids_tpu.exec import reuse
+        reuse.release_stragglers()
+    except Exception:
+        pass
     leaks = [l for l in cleaner.sweep()
              if "HbmPool" in l or "orphan spill file" in l]
     if leaks:

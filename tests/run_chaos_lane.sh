@@ -10,4 +10,4 @@
 set -e
 cd "$(dirname "$0")/.."
 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}" \
-    exec python -m pytest tests/test_faults.py -q "$@"
+    exec python -m pytest tests/test_faults.py tests/test_reuse.py -q "$@"

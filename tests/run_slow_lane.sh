@@ -11,5 +11,6 @@ SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}
     exec python -m pytest \
     tests/test_distributed.py tests/test_cluster.py \
     tests/test_tpcds.py tests/test_scaletest.py \
-    tests/test_fusion_diff.py tests/test_pipeline.py \
-    tests/test_faults.py -q "$@"
+    tests/test_fusion_diff.py tests/test_reuse_diff.py \
+    tests/test_pipeline.py tests/test_faults.py \
+    tests/test_reuse.py -q "$@"

@@ -397,10 +397,67 @@ def overlap(sf=None, n_files=None, reps=2):
     }))
 
 
+def reuse_report(queries=("q1", "q2", "q59"), sf=0.002):
+    """``python tools/perf_probe.py reuse`` — per-query duplicate-subtree
+    counts and reuse hits (docs/exchange_reuse.md).
+
+    For each CTE-shaped tracker TPC-DS query: how many repeated reusable
+    subtrees the fingerprint pass finds (with the rewrite disabled, so the
+    raw duplicates are visible), then the reuse counters + bytes saved from
+    actually executing with the rewrite on, plus a bit-identical check
+    against the rewrite off."""
+    from spark_rapids_tpu.bench import tpcds_queries as Q
+    from spark_rapids_tpu.bench.tpcds_schema import tables_for
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.exec import reuse as R
+    from spark_rapids_tpu.plan import from_arrow
+    from spark_rapids_tpu.plan.reuse import duplicate_groups
+
+    tables = tables_for(sf, seed=42)
+
+    def build(name, reuse_on, fusion=True):
+        conf = RapidsConf({"spark.rapids.tpu.sql.exchange.reuse.enabled":
+                           reuse_on,
+                           "spark.rapids.tpu.sql.fusion.enabled": fusion})
+        d = {}
+        for k, v in tables.items():
+            df = from_arrow(v, conf)
+            df.shuffle_partitions = 2
+            d[k] = df
+        return Q.QUERIES[name](d)
+
+    results = {}
+    for qn in queries:
+        # duplicate probe on the pre-fusion shape: fused stages fingerprint
+        # opaque, which is exactly why the rewrite runs before fusion
+        raw_plan = build(qn, False, fusion=False).physical_plan()
+        dups = duplicate_groups(raw_plan)
+        off = build(qn, False).to_arrow()
+        R.reset_counters()
+        on = build(qn, True).to_arrow()
+        c = R.counters()
+        results[qn] = {
+            "duplicate_groups": dups,
+            "reused_exchanges": c["reuse_exchanges_total"],
+            "reused_broadcasts": c["reuse_broadcasts_total"],
+            "reused_subqueries": c["reuse_subqueries_total"],
+            "bytes_saved": c["reuse_bytes_saved_total"],
+            "bit_identical": on.equals(off),
+        }
+        print(f"{qn}: dups={len(dups)} "
+              f"exchanges={c['reuse_exchanges_total']} "
+              f"bytes_saved={c['reuse_bytes_saved_total']} "
+              f"identical={on.equals(off)}", file=sys.stderr, flush=True)
+    print(json.dumps({"reuse": results, "sf": sf}))
+    return results
+
+
 if __name__ == "__main__":
     if _DISPATCH_MODE:
         dispatch_count()
     elif "overlap" in sys.argv[1:]:
         overlap()
+    elif "reuse" in sys.argv[1:]:
+        reuse_report()
     else:
         main()
