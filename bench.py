@@ -479,20 +479,39 @@ def main(budget_s=None, faults=None):
     from spark_rapids_tpu.obs import profile_for
 
     prof_conf = RapidsConf({"spark.rapids.tpu.profile.traceCapture": True})
-    prof_dir = os.environ.get("BENCH_PROFILE_DIR", ".")
+    prof_dir = os.environ.get("BENCH_PROFILE_DIR", "artifacts")
     os.makedirs(prof_dir, exist_ok=True)
     profile_files, trace_files = [], []
     specs = ([("tpch", qn, base_h, tpch.DF_QUERIES, 1 << 24)
               for qn in h_names]
              + [("tpcds", qn, base_ds, DSQ.QUERIES, 1 << 22)
                 for qn in TPCDS_QUERIES]) if do_profiles else []
+    from spark_rapids_tpu.obs import histo as _histo
+    batch_histo = _histo.get("batch_op_ns")
     for suite, qn, tabs, builders, batch_rows in specs:
         node = build_plans(tabs, prof_conf, builders, [qn], batch_rows)[qn]
         prof = profile_for(node)
+        b0 = batch_histo.snapshot()
         fence([run_plan(node)[1]])
         if prof is None:
             continue
         prof.finish(node)
+        # per-query metric line: wall, plan/compile/execute attribution, and
+        # batch-op tail percentiles over exactly this query's window
+        win = _histo.diff(b0, batch_histo.snapshot())
+        ph = prof.phases
+        print(json.dumps({
+            "query": f"{suite}_{qn}",
+            "wall_ms": round(prof.wall_ns / 1e6, 3),
+            "phases_ms": {
+                "plan": round(sum(ph.get(p, 0.0) for p in
+                                  ("plan-rewrite", "reuse", "fusion",
+                                   "prefetch")), 3),
+                "compile": ph.get("compile", 0.0),
+                "execute": ph.get("execute", 0.0),
+            },
+            "batch_op_ms": batch_histo.percentiles_ms(win),
+        }), flush=True)
         ppath = os.path.join(prof_dir, f"profile_{suite}_{qn}.json")
         with open(ppath, "w") as f:
             json.dump({**prof.to_dict(),

@@ -237,6 +237,32 @@ PROFILE_TRACE = conf(
         "QueryProfile.chrome_trace() carries real per-operator batch spans "
         "(small per-batch overhead; docs/observability.md).")
 
+METRICS_JOURNAL_ENABLED = conf(
+    "spark.rapids.tpu.metrics.journal.enabled", default=True,
+    doc="Record query lifecycle phases (submit/plan-rewrite/reuse/fusion/"
+        "compile/execute/finish) plus spill/retry/fault/worker events in "
+        "the bounded in-process journal (obs/events.py; "
+        "docs/observability.md). Per-event cost is one dict append under "
+        "a lock — measured <3% on TPC-H q1 (docs/perf_notes_r09.md).")
+
+METRICS_JOURNAL_CAPACITY = conf(
+    "spark.rapids.tpu.metrics.journal.capacity", default=4096,
+    doc="Bounded journal ring size; oldest events are evicted "
+        "(srtpu_journal_evicted_total counts drops).")
+
+METRICS_HISTOGRAM_ENABLED = conf(
+    "spark.rapids.tpu.metrics.histogram.enabled", default=True,
+    doc="Record log2-bucketed latency histograms (query wall, per-batch "
+        "opTime, shuffle fetch, retry backoff) exposed as Prometheus "
+        "_bucket/_sum/_count families with p50/p95/p99 in profiles "
+        "(obs/histo.py).")
+
+HEALTH_PROGRESS_TIMEOUT_S = conf(
+    "spark.rapids.tpu.metrics.health.progressTimeoutSeconds", default=60.0,
+    doc="A worker that keeps heartbeating but reports no task progress "
+        "for this long is flagged stalled in the health registry and "
+        "raises a worker-stale journal event (obs/health.py).")
+
 ANSI_ENABLED = conf(
     "spark.rapids.tpu.sql.ansi.enabled", default=False,
     doc="ANSI SQL mode: overflow and invalid casts raise instead of "

@@ -145,10 +145,16 @@ class TpuExec:
 
     # -- execution ---------------------------------------------------------
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.obs import histo as _histo
         from spark_rapids_tpu.utils import tracing
         it = self.do_execute(partition)
         op_time = self.metrics["opTime"]
         name = type(self).__name__
+        # per-batch latency distribution (p50/p95/p99 in profiles and
+        # Prometheus); the flag is read once per execute(), the record is
+        # one bit_length + two adds under a lock per batch
+        batch_histo = (_histo.get("batch_op_ns")
+                       if _histo.enabled() else None)
         while True:
             t0 = time.perf_counter_ns()
             try:
@@ -168,6 +174,8 @@ class TpuExec:
                         batch, _C.SHRINK_TO_LIVE_MIN_CAPACITY.get(cfg))
             t1 = time.perf_counter_ns()
             op_time.add(t1 - t0)
+            if batch_histo is not None:
+                batch_histo.record(t1 - t0)
             # per-batch operator span for the Chrome trace exporter; only
             # recorded while a capture window (Profiler / QueryProfile with
             # trace capture) is open, so the steady state pays one flag read

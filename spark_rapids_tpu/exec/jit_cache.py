@@ -21,6 +21,7 @@ programs — is visible in the metrics endpoint.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict
 
 import jax
@@ -29,6 +30,31 @@ _CACHE: Dict[tuple, Callable] = {}
 _LOCK = threading.Lock()
 _HITS = 0
 _MISSES = 0
+_COMPILE_NS = 0
+
+
+def _timed_first_call(jfn: Callable) -> Callable:
+    """jax.jit is lazy: trace+compile happens on the first invocation, not
+    at jit() time. Time that first call and bank it as compile cost so
+    QueryProfile can attribute compile-vs-execute (the first call also
+    runs the first batch, so this is an upper bound — dominated by
+    compilation for anything the disk cache misses). Later calls pay one
+    flag check."""
+    state = {"first": True}
+
+    def wrapper(*args, **kwargs):
+        global _COMPILE_NS
+        if state["first"]:
+            t0 = time.perf_counter_ns()
+            out = jfn(*args, **kwargs)
+            dt = time.perf_counter_ns() - t0
+            state["first"] = False
+            with _LOCK:
+                _COMPILE_NS += dt
+            return out
+        return jfn(*args, **kwargs)
+
+    return wrapper
 
 
 def shared_jit(key: tuple, make: Callable[[], Callable]) -> Callable:
@@ -39,16 +65,22 @@ def shared_jit(key: tuple, make: Callable[[], Callable]) -> Callable:
             fn = _CACHE.get(key)
             if fn is None:
                 _MISSES += 1
-                fn = _CACHE[key] = jax.jit(make())
+                fn = _CACHE[key] = _timed_first_call(jax.jit(make()))
                 return fn
     _HITS += 1
     return fn
+
+
+def compile_ns_total() -> int:
+    """Lifetime ns spent in first calls of newly-traced programs."""
+    return _COMPILE_NS
 
 
 def cache_stats() -> Dict[str, int]:
     """Counters for obs/gauges.py: lifetime hits/misses and current size."""
     return {"jit_cache_hit_total": _HITS,
             "jit_cache_miss_total": _MISSES,
+            "jit_compile_ns_total": _COMPILE_NS,
             "jit_cache_size": len(_CACHE)}
 
 

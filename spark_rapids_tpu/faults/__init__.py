@@ -104,10 +104,19 @@ def get_registry() -> Optional[FaultRegistry]:
 
 
 # -- counters ---------------------------------------------------------------
+# Each note_* also journals the event (obs/events.py) so the fault registry
+# and the lifecycle journal tell one story end-to-end: chaos-lane tests
+# assert every counted recovery/degrade has a matching journal event.
+
+def _journal(kind: str, site: str) -> None:
+    from spark_rapids_tpu.obs import events as _ev
+    _ev.emit(kind, site=site)
+
 
 def note_injected(site: str) -> None:
     with _CTR_LOCK:
         _COUNTERS["fault_injected_total"] += 1
+    _journal("fault-injected", site)
 
 
 def note_recovered(site: str) -> None:
@@ -116,6 +125,7 @@ def note_recovered(site: str) -> None:
     a lost map output recomputed, a failed query re-ran clean."""
     with _CTR_LOCK:
         _COUNTERS["fault_recovered_total"] += 1
+    _journal("fault-recovered", site)
 
 
 def note_degraded(site: str) -> None:
@@ -123,6 +133,7 @@ def note_degraded(site: str) -> None:
     (graceful degradation, plan/cpu.py)."""
     with _CTR_LOCK:
         _COUNTERS["fault_degraded_total"] += 1
+    _journal("degraded", site)
 
 
 def counters() -> Dict[str, int]:

@@ -40,7 +40,10 @@ def _oom_backoff(attempts: int) -> None:
     if base_ms <= 0:
         return
     scale = 1 << min(attempts - 1, 5)
-    time.sleep((base_ms / 1000.0) * scale * (0.5 + random.random()))
+    pause = (base_ms / 1000.0) * scale * (0.5 + random.random())
+    time.sleep(pause)
+    from spark_rapids_tpu.obs import histo as _histo
+    _histo.record("retry_backoff_ns", int(pause * 1e9))
 
 
 def split_batch_half(batch: ColumnarBatch) -> List[ColumnarBatch]:

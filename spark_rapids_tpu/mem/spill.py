@@ -189,6 +189,8 @@ class SpillFramework:
         self.spilled_to_host_count += 1
         from spark_rapids_tpu.utils import task_metrics as TM
         TM.add("spill_to_host_bytes", h.nbytes)
+        from spark_rapids_tpu.obs import events as _journal
+        _journal.emit("spill", tier="host", bytes=h.nbytes)
         with self._lock:
             self.host_used += h.nbytes
             over = self.host_used - self.host_limit
@@ -239,6 +241,8 @@ class SpillFramework:
         self.spilled_to_disk_count += 1
         from spark_rapids_tpu.utils import task_metrics as TM
         TM.add("spill_to_disk_bytes", h.nbytes)
+        from spark_rapids_tpu.obs import events as _journal
+        _journal.emit("spill", tier="disk", bytes=h.nbytes)
         with self._lock:
             self.host_used -= h.nbytes
         return h.nbytes

@@ -7,10 +7,15 @@ package is the standalone unification of the repo's fragments:
 
 - ``profile``       QueryProfile registry: per-query snapshot/aggregate of
                     operator metrics, task metrics, memory/shuffle/filecache
-                    gauges, and trace events; ``explain_analyze`` rendering
-- ``trace_export``  Chrome trace_event JSON for chrome://tracing / Perfetto
-- ``expose``        Prometheus text exposition of process gauges
-- ``gauges``        the gauge catalog both of the above read
+                    gauges, trace events, and phase attribution;
+                    ``explain_analyze`` rendering
+- ``events``        bounded thread-safe lifecycle event journal (JSONL)
+- ``histo``         log-bucketed latency histograms (p50/p95/p99)
+- ``health``        worker heartbeat + health registry (merged driver view)
+- ``trace_export``  Chrome trace_event JSON for chrome://tracing / Perfetto,
+                    incl. multi-worker merge with per-process tracks
+- ``expose``        Prometheus text exposition of process gauges + histograms
+- ``gauges``        the gauge catalog the above read
 
 See docs/observability.md for the metric catalog and workflows.
 """
@@ -24,8 +29,16 @@ from spark_rapids_tpu.obs.profile import (  # noqa: F401
     profile_for,
     recent_profiles,
 )
-from spark_rapids_tpu.obs.trace_export import to_chrome_trace  # noqa: F401
+from spark_rapids_tpu.obs.trace_export import (  # noqa: F401
+    merge_process_traces,
+    to_chrome_trace,
+)
 from spark_rapids_tpu.obs.expose import (  # noqa: F401
+    render_histograms,
     render_prometheus,
     write_textfile,
 )
+from spark_rapids_tpu.obs import events as journal  # noqa: F401
+from spark_rapids_tpu.obs import health  # noqa: F401
+from spark_rapids_tpu.obs import histo  # noqa: F401
+from spark_rapids_tpu.obs.health import REGISTRY as health_registry  # noqa: F401

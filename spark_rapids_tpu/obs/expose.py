@@ -12,12 +12,43 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from spark_rapids_tpu.obs import gauges as G
+from spark_rapids_tpu.obs import histo as H
 
 NAMESPACE = "srtpu"
 
 
+def render_histograms(snapshots: Optional[Dict[str, Dict]] = None) -> str:
+    """Latency histograms (obs/histo.py) as ``_bucket``/``_sum``/``_count``
+    families. Internal unit is ns; exposed as Prometheus-conventional
+    seconds under ``<name minus _ns>_seconds``. Empty buckets past the
+    largest populated one are elided (``+Inf`` always closes the family).
+    """
+    snaps = snapshots if snapshots is not None else H.snapshot_all()
+    lines = []
+    for name, help_text in H.CATALOG:
+        s = snaps.get(name)
+        if s is None:
+            continue
+        base = name[:-3] if name.endswith("_ns") else name
+        full = f"{NAMESPACE}_{base}_seconds"
+        lines.append(f"# HELP {full} {help_text}")
+        lines.append(f"# TYPE {full} histogram")
+        counts = s["counts"]
+        top = max((i for i, c in enumerate(counts) if c), default=-1)
+        cum = 0
+        for i in range(top + 1):
+            cum += counts[i]
+            le = (1 << i) / 1e9  # bucket i upper bound: 2**i ns
+            lines.append(f'{full}_bucket{{le="{le:g}"}} {cum}')
+        lines.append(f'{full}_bucket{{le="+Inf"}} {s["count"]}')
+        lines.append(f"{full}_sum {s['sum'] / 1e9:g}")
+        lines.append(f"{full}_count {s['count']}")
+    return "\n".join(lines) + "\n"
+
+
 def render_prometheus(snapshot: Optional[Dict[str, int]] = None) -> str:
-    """The current (or given) gauge snapshot as exposition text."""
+    """The current (or given) gauge snapshot as exposition text, followed
+    by the latency histogram families."""
     snap = snapshot if snapshot is not None else G.snapshot()
     lines = []
     for name, kind, help_text in G.CATALOG:
@@ -25,7 +56,7 @@ def render_prometheus(snapshot: Optional[Dict[str, int]] = None) -> str:
         lines.append(f"# HELP {full} {help_text}")
         lines.append(f"# TYPE {full} {kind}")
         lines.append(f"{full} {snap.get(name, 0)}")
-    return "\n".join(lines) + "\n"
+    return "\n".join(lines) + "\n" + render_histograms()
 
 
 def write_textfile(path: str) -> str:

@@ -48,6 +48,9 @@ CATALOG: "List[Tuple[str, str, str]]" = [
     ("jit_cache_hit_total", "counter", "shared_jit lookups served from cache"),
     ("jit_cache_miss_total", "counter",
      "shared_jit entries traced+compiled (distinct programs)"),
+    ("jit_compile_ns_total", "counter",
+     "Nanoseconds spent in first calls of newly-traced programs "
+     "(compile-cost attribution for QueryProfile phases)"),
     ("jit_cache_size", "gauge", "Distinct jitted programs currently cached"),
     ("prefetch_depth", "gauge",
      "Batches currently held ready in prefetch queues"),
@@ -71,6 +74,14 @@ CATALOG: "List[Tuple[str, str, str]]" = [
     ("reuse_bytes_saved_total", "counter",
      "Bytes a consumer replayed from a shared materialization instead of "
      "recomputing (docs/exchange_reuse.md)"),
+    ("journal_events_total", "counter",
+     "Lifecycle events emitted to the bounded journal (obs/events.py)"),
+    ("journal_evicted_total", "counter",
+     "Journal events evicted by the bounded ring"),
+    ("worker_stale_total", "counter",
+     "Workers flagged stalled by the health registry (no task progress)"),
+    ("worker_lost_total", "counter",
+     "Workers removed from the health registry as dead/lost"),
 ]
 
 
@@ -121,6 +132,10 @@ def snapshot() -> Dict[str, int]:
     out.update(_faults.counters())
     from spark_rapids_tpu.exec import reuse as _reuse
     out.update(_reuse.counters())
+    from spark_rapids_tpu.obs import events as _ev
+    out.update(_ev.counters())
+    from spark_rapids_tpu.obs import health as _health
+    out.update(_health.counters())
     return out
 
 

@@ -1,0 +1,156 @@
+"""Worker heartbeat + health registry (RapidsShuffleHeartbeatManager analog).
+
+The shuffle heartbeat manager (shuffle/heartbeat.py) answers "which peers
+exist" for executor discovery; this registry answers "how healthy is each
+worker" for the *driver's merged view*: every heartbeat carries the
+worker's gauge snapshot and a last-progress timestamp (last time it
+finished a task), and the driver can sweep for workers that are still
+heartbeating but have stopped making progress (stalled) or have stopped
+reporting entirely (lost).
+
+Both distributed paths feed it: ``shuffle/cluster.py`` reports per
+executor process, ``parallel/executor.py`` reports the in-process mesh
+worker. Sweeps emit journal events (obs/events.py) and can feed the PR-4
+device blacklist via the caller.
+
+Timestamps use ``time.monotonic()`` — wall-clock jumps must not declare
+workers dead.
+"""
+
+from __future__ import annotations
+
+import threading
+from time import monotonic as _mono
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.obs import events as _events
+
+
+class WorkerHealth:
+    """Mutable per-worker record; registry lock guards all mutation."""
+
+    __slots__ = ("worker_id", "kind", "registered_at", "last_seen",
+                 "last_progress", "heartbeats", "gauges", "meta", "stale")
+
+    def __init__(self, worker_id: str, kind: str):
+        now = _mono()
+        self.worker_id = worker_id
+        self.kind = kind  # "cluster" | "mesh" | "local"
+        self.registered_at = now
+        self.last_seen = now
+        self.last_progress = now
+        self.heartbeats = 0
+        self.gauges: Dict[str, int] = {}
+        self.meta: Dict = {}
+        self.stale = False
+
+    def to_dict(self, now: Optional[float] = None) -> Dict:
+        now = _mono() if now is None else now
+        return {
+            "worker_id": self.worker_id,
+            "kind": self.kind,
+            "stale": self.stale,
+            "heartbeats": self.heartbeats,
+            "seen_ago_s": round(now - self.last_seen, 3),
+            "progress_ago_s": round(now - self.last_progress, 3),
+            "gauges": dict(self.gauges),
+            "meta": dict(self.meta),
+        }
+
+
+class HealthRegistry:
+    """Driver-side merged health view over every reporting worker."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._workers: Dict[str, WorkerHealth] = {}
+        self._stale_total = 0
+        self._lost_total = 0
+
+    def report(self, worker_id: str, gauges: Optional[Dict[str, int]] = None,
+               kind: str = "cluster", progress: bool = False,
+               **meta) -> WorkerHealth:
+        """One heartbeat: refresh last_seen, optionally gauges/progress."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None:
+                w = self._workers[worker_id] = WorkerHealth(worker_id, kind)
+            w.last_seen = _mono()
+            w.heartbeats += 1
+            if gauges is not None:
+                w.gauges = dict(gauges)
+            if progress:
+                w.last_progress = w.last_seen
+                w.stale = False  # recovered; sweeps may re-flag it
+            if meta:
+                w.meta.update(meta)
+            return w
+
+    def note_progress(self, worker_id: str) -> None:
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is not None:
+                w.last_progress = _mono()
+
+    def remove(self, worker_id: str, lost: bool = False) -> None:
+        with self._lock:
+            gone = self._workers.pop(worker_id, None) is not None
+            if gone and lost:
+                self._lost_total += 1
+        if gone and lost:
+            _events.emit("worker-lost", worker=worker_id)
+
+    def sweep_stalled(self, progress_timeout_s: float) -> List[str]:
+        """Flag workers with no progress for ``progress_timeout_s``.
+
+        Returns newly-stalled worker ids; each raises a ``worker-stale``
+        journal event exactly once per stall episode (a heartbeat with
+        progress clears the flag)."""
+        now = _mono()
+        newly: List[str] = []
+        with self._lock:
+            for w in self._workers.values():
+                if not w.stale and now - w.last_progress > progress_timeout_s:
+                    w.stale = True
+                    self._stale_total += 1
+                    newly.append(w.worker_id)
+        for wid in newly:
+            _events.emit("worker-stale", worker=wid,
+                         timeout_s=progress_timeout_s)
+        return newly
+
+    def view(self) -> Dict:
+        """Merged health view: per-worker records + summed counter gauges."""
+        now = _mono()
+        with self._lock:
+            workers = [w.to_dict(now) for w in self._workers.values()]
+        merged: Dict[str, int] = {}
+        for w in workers:
+            for k, v in w["gauges"].items():
+                if isinstance(v, (int, float)):
+                    merged[k] = merged.get(k, 0) + v
+        return {
+            "workers": sorted(workers, key=lambda w: w["worker_id"]),
+            "alive": sum(1 for w in workers if not w["stale"]),
+            "stale": sum(1 for w in workers if w["stale"]),
+            "merged_gauges": merged,
+        }
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {"worker_stale_total": self._stale_total,
+                    "worker_lost_total": self._lost_total}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._workers.clear()
+            self._stale_total = 0
+            self._lost_total = 0
+
+
+# Process-wide registry: the driver side of every distributed path.
+REGISTRY = HealthRegistry()
+
+
+def counters() -> Dict[str, int]:
+    return REGISTRY.counters()

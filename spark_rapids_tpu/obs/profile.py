@@ -23,7 +23,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from spark_rapids_tpu.obs import events as _events
 from spark_rapids_tpu.obs import gauges as G
+from spark_rapids_tpu.obs import histo as _histo
 from spark_rapids_tpu.utils import task_metrics as TM
 from spark_rapids_tpu.utils import tracing
 
@@ -60,6 +62,7 @@ class QueryProfile:
         self.started = False
         self.finished = False
         self.wall_ns = 0
+        self.phases: Dict[str, float] = {}  # phase name -> ms
         self.nodes: List[Dict] = []
         self.metrics: Dict[str, int] = {}
         self.gauges: Dict[str, Dict] = {}
@@ -68,13 +71,25 @@ class QueryProfile:
         self._t0 = 0
         self._gauges0: Dict[str, int] = {}
         self._tasks0: Dict[str, int] = {}
+        self._compile0 = 0
         self._owned_capture = False
+        _events.emit("submit", query_id=self.query_id,
+                     description=description[:160])
 
     # -- lifecycle ---------------------------------------------------------
+    def note_phase(self, name: str, dur_ns: int) -> None:
+        """Attribute a planning-side phase (plan-rewrite/reuse/fusion);
+        journaled as it happens so the lifecycle timeline reads in order."""
+        self.phases[name] = self.phases.get(name, 0.0) + _ns_ms(dur_ns)
+        _events.emit("phase", query_id=self.query_id, phase=name,
+                     dur_ms=_ns_ms(dur_ns))
+
     def start(self) -> "QueryProfile":
         self._t0 = time.perf_counter_ns()
         self._gauges0 = G.snapshot()
         self._tasks0 = TM.aggregate_snapshot()
+        from spark_rapids_tpu.exec import jit_cache as _jc
+        self._compile0 = _jc.compile_ns_total()
         if self.capture_trace and not tracing.capturing():
             # open our own event window; a user-managed Profiler window
             # stays untouched (we'd otherwise clear their events)
@@ -91,7 +106,14 @@ class QueryProfile:
 
     def finish(self, root=None) -> "QueryProfile":
         """Snapshot everything; idempotent (re-finish refreshes)."""
+        first = not self.finished
         self.wall_ns = time.perf_counter_ns() - self._t0
+        # Attribute the execute window: ns spent tracing+compiling new
+        # jitted programs (exec/jit_cache.py first-call timer) vs the rest.
+        from spark_rapids_tpu.exec import jit_cache as _jc
+        compile_ns = max(0, _jc.compile_ns_total() - self._compile0)
+        self.phases["compile"] = _ns_ms(compile_ns)
+        self.phases["execute"] = _ns_ms(max(0, self.wall_ns - compile_ns))
         end = G.snapshot()
         self.gauges = G.diff(self._gauges0, end)
         tasks1 = TM.aggregate_snapshot()
@@ -107,6 +129,11 @@ class QueryProfile:
         if root is not None:
             self.nodes = collect_node_stats(root)
             self.metrics = root.collect_metrics()
+        if first:
+            _histo.record("query_wall_ns", self.wall_ns)
+            _events.emit("finish", query_id=self.query_id,
+                         wall_ms=_ns_ms(self.wall_ns),
+                         compile_ms=self.phases["compile"])
         self.finished = True
         return self
 
@@ -116,6 +143,11 @@ class QueryProfile:
             "query_id": self.query_id,
             "description": self.description,
             "wall_ms": _ns_ms(self.wall_ns),
+            "phases": dict(self.phases),
+            "latency": {  # process-wide log-bucket estimates (obs/histo.py)
+                "query_wall": _histo.percentiles("query_wall_ns"),
+                "batch_op": _histo.percentiles("batch_op_ns"),
+            },
             "nodes": self.nodes,
             "metrics": self.metrics,
             "gauges": self.gauges,
@@ -133,6 +165,14 @@ class QueryProfile:
         """Plan tree with per-node metric rows inline."""
         lines = [f"== Query Profile #{self.query_id} "
                  f"(wall {_ns_ms(self.wall_ns)} ms) =="]
+        if self.phases:
+            order = ("plan-rewrite", "reuse", "fusion", "prefetch",
+                     "compile", "execute")
+            cells = [f"{p}={self.phases[p]}ms" for p in order
+                     if p in self.phases]
+            cells += [f"{p}={v}ms" for p, v in sorted(self.phases.items())
+                      if p not in order]
+            lines.append(f"phases: {' '.join(cells)}")
         for node in self.nodes:
             pad = "  " * node["depth"]
             prefix = "+- " if node["depth"] else ""

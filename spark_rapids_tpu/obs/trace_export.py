@@ -15,38 +15,40 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional
 
-PID = 1  # single-process engine: one pid, threads/operators as tids
+PID = 1  # driver pid; workers get distinct pids in merged traces
 
 
-def _meta(name: str, tid: int, value: str) -> Dict:
-    return {"ph": "M", "name": name, "pid": PID, "tid": tid,
+def _meta(name: str, tid: int, value: str, pid: int = PID) -> Dict:
+    return {"ph": "M", "name": name, "pid": pid, "tid": tid,
             "args": {"name": value}}
 
 
 def events_to_chrome(events: Iterable[Dict],
-                     process_name: str = "spark_rapids_tpu") -> List[Dict]:
+                     process_name: str = "spark_rapids_tpu",
+                     pid: int = PID,
+                     base_ns: Optional[int] = None) -> List[Dict]:
     """Map in-process events ({name, start_ns, dur_ns, thread, args?}) to
     complete events on per-thread tracks, rebased so the trace starts at
-    ts=0."""
+    ts=0 (or at the caller's shared ``base_ns`` when merging processes)."""
     evs = list(events)
-    out: List[Dict] = [_meta("process_name", 0, process_name)]
+    out: List[Dict] = [_meta("process_name", 0, process_name, pid)]
     if not evs:
         return out
-    base = min(e["start_ns"] for e in evs)
+    base = min(e["start_ns"] for e in evs) if base_ns is None else base_ns
     tids: Dict[int, int] = {}
     for e in evs:
         thread = e.get("thread", 0)
         if thread not in tids:
             tids[thread] = len(tids) + 1
             out.append(_meta("thread_name", tids[thread],
-                             f"thread-{len(tids)}"))
+                             f"thread-{len(tids)}", pid))
         rec = {
             "ph": "X",
             "name": str(e["name"]),
             "cat": "trace",
-            "pid": PID,
+            "pid": pid,
             "tid": tids[thread],
-            "ts": (e["start_ns"] - base) / 1e3,
+            "ts": max(0.0, (e["start_ns"] - base) / 1e3),
             "dur": e["dur_ns"] / 1e3,
         }
         if e.get("args"):
@@ -95,3 +97,27 @@ def to_chrome_trace(events: Iterable[Dict],
     if nodes is not None:
         trace_events.extend(node_spans_to_chrome(nodes))
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def merge_process_traces(per_process: "Dict[str, List[Dict]]",
+                         nodes: Optional[Iterable[Dict]] = None) -> Dict:
+    """Merge per-worker in-process event captures into ONE Chrome trace
+    with a distinct process track per worker.
+
+    ``per_process`` maps a process label (``"driver"``, ``"exec-0"``, ...)
+    to that process's raw event list (utils/tracing.py shape). Each label
+    gets its own pid with a ``process_name`` metadata record; timestamps
+    are rebased against the global minimum so cross-worker ordering is
+    preserved when the captures share a clock domain (same host —
+    ``time.perf_counter_ns`` of forked workers), and merely cosmetic when
+    they don't. Labels sort deterministically with "driver" first."""
+    starts = [e["start_ns"] for evs in per_process.values() for e in evs]
+    base = min(starts) if starts else None
+    out: List[Dict] = []
+    labels = sorted(per_process, key=lambda s: (s != "driver", s))
+    for pid, label in enumerate(labels, start=PID):
+        out.extend(events_to_chrome(per_process[label], process_name=label,
+                                    pid=pid, base_ns=base))
+    if nodes is not None:
+        out.extend(node_spans_to_chrome(nodes))
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

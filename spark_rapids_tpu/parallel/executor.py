@@ -29,6 +29,7 @@ tests/test_distributed.py and certified by ``__graft_entry__.dryrun_multichip``.
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -125,6 +126,9 @@ class MeshExecutor:
         self.axis = axis
         self.n_dev = int(mesh.devices.size)
         self.min_local_cap = min_local_cap
+        # process identity for merged traces + the health registry: the
+        # mesh is one in-process "worker" spanning n_dev devices
+        self.worker_label = f"mesh-{axis}x{self.n_dev}"
         # plan-coverage accounting (device_plan_stats analog for the judge:
         # how much of the tree actually ran as mesh SPMD vs host)
         self.dist_nodes: List[str] = []
@@ -133,7 +137,18 @@ class MeshExecutor:
     # -- public ------------------------------------------------------------
     def execute(self, plan: TpuExec) -> pa.Table:
         """Run the plan; distributed where its shape allows."""
-        return self._exec(plan)
+        from spark_rapids_tpu.obs import health as _health
+
+        try:
+            return self._exec(plan)
+        finally:
+            # mesh-path heartbeat: completing (or failing out of) a plan is
+            # progress; gauge-style accounting rides along so the merged
+            # health view covers both distributed paths
+            _health.REGISTRY.report(
+                self.worker_label, kind="mesh", progress=True,
+                devices=self.n_dev, dist_nodes=len(self.dist_nodes),
+                host_nodes=len(self.host_nodes))
 
     # -- recursive host/dist split ----------------------------------------
     def _exec(self, node: TpuExec) -> pa.Table:
@@ -285,8 +300,14 @@ class MeshExecutor:
             out_specs=P(axis),
             check_vma=False,
         )
+        _t0 = _time.perf_counter_ns()
         outs = jax.jit(fn)(tuple(flat_sharded), flat_repl)
         outs = [np.asarray(o) for o in jax.device_get(outs)]
+        from spark_rapids_tpu.utils import tracing as _tracing
+        _tracing.record_event(
+            f"mesh:dispatch:{type(root).__name__}", _t0,
+            _time.perf_counter_ns() - _t0,
+            args={"worker": self.worker_label, "devices": self.n_dev})
 
         # unpack: per-column global arrays, per-device row counts, overflows
         tmpl = low.template

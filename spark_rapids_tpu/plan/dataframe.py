@@ -186,10 +186,12 @@ class DataFrame:
         (faults/blacklist.py classification)."""
         from spark_rapids_tpu import faults
         from spark_rapids_tpu.faults import blacklist as _bl
+        from spark_rapids_tpu.obs import events as _journal
 
         base_conf = self.conf or C.RapidsConf()
         key = self._plan_key()
         if _bl.is_listed(key, base_conf):
+            _journal.emit("degraded-to-cpu", reason="blacklisted")
             return self._execute_plan(self._cpu_plan())
         attempt = 0
         while True:
@@ -206,6 +208,8 @@ class DataFrame:
                     return self._execute_plan(self._cpu_plan())
                 if verdict != _bl.RETRY:
                     raise
+                _journal.emit("query-retry", attempt=attempt,
+                              error=type(e).__name__)
 
     def _execute_plan(self, node) -> pa.Table:
         from spark_rapids_tpu.columnar.batch import batch_to_arrow

@@ -585,6 +585,8 @@ class Overrides:
         return L.Project(out, reg_plan)
 
     def apply(self, plan: L.LogicalPlan) -> TpuExec:
+        import time as _time
+
         from spark_rapids_tpu.exec import base as _base
 
         # session settings visible to exec-layer code without a threaded
@@ -595,6 +597,22 @@ class Overrides:
         _faults.configure(self.conf)
         _base.set_sync_metrics(self.conf[C.METRICS_SYNC])
         _base.set_metrics_level(self.conf[C.METRICS_LEVEL])
+        from spark_rapids_tpu.obs import events as _journal
+        from spark_rapids_tpu.obs import histo as _histo
+        _journal.set_enabled(self.conf[C.METRICS_JOURNAL_ENABLED])
+        _journal.set_capacity(self.conf[C.METRICS_JOURNAL_CAPACITY])
+        _histo.set_enabled(self.conf[C.METRICS_HISTOGRAM_ENABLED])
+        prof = None
+        if self.conf[C.PROFILE_ENABLED]:
+            # per-query profile created up front so the planning phases
+            # below journal in lifecycle order (submit -> plan-rewrite ->
+            # reuse -> fusion); gauge/compile baselines are still taken at
+            # start(), after planning, so the execute window stays clean
+            from spark_rapids_tpu.obs import QueryProfile
+
+            prof = QueryProfile(description=plan.describe(), conf=self.conf,
+                                capture_trace=self.conf[C.PROFILE_TRACE])
+        t0 = _time.perf_counter_ns()
         if C.SQL_ENABLED.get(self.conf):
             plan = self._rewrite_distinct(plan)
         self._apply_path_rules(plan)
@@ -604,34 +622,35 @@ class Overrides:
         if self.conf[_cbo.CBO_ENABLED]:
             _cbo.CostBasedOptimizer(self.conf).optimize(meta)
         ex = self._convert(meta)
+        t1 = _time.perf_counter_ns()
         # computation reuse BEFORE fusion: fused stages must see the
         # ReusedExchange/ReusedBroadcast leaves so a deduped subtree is
         # never re-fused (and rebuilt) per consumer (plan/reuse.py)
         from spark_rapids_tpu.plan.reuse import apply_reuse
 
         ex = apply_reuse(ex, self.conf)
+        t2 = _time.perf_counter_ns()
         if C.FUSION_ENABLED.get(self.conf):
             from spark_rapids_tpu.exec.fused import fuse_exec
 
             ex = fuse_exec(ex, min_ops=C.FUSION_MIN_OPERATORS.get(self.conf),
                            agg_window=C.FUSION_AGG_WINDOW.get(self.conf))
+        t3 = _time.perf_counter_ns()
         # async pipeline boundaries go in AFTER fusion: a fused stage is one
         # consumer, and its scan/shuffle inputs are exactly the seams the
         # prefetch workers overlap (exec/pipeline.py)
         from spark_rapids_tpu.exec.pipeline import insert_prefetch
 
         ex = insert_prefetch(ex, self.conf)
+        t4 = _time.perf_counter_ns()
         mode = C.EXPLAIN.get(self.conf)
         if mode != "NONE":
             print(explain(meta, mode))
-        if self.conf[C.PROFILE_ENABLED]:
-            # per-query profile: gauge baseline now, node metrics at finish
-            # (DataFrame.to_arrow, or profile_for(root).finish(root) for
-            # direct executors like bench.py)
-            from spark_rapids_tpu.obs import QueryProfile
-
-            prof = QueryProfile(description=plan.describe(), conf=self.conf,
-                                capture_trace=self.conf[C.PROFILE_TRACE])
+        if prof is not None:
+            prof.note_phase("plan-rewrite", t1 - t0)
+            prof.note_phase("reuse", t2 - t1)
+            prof.note_phase("fusion", t3 - t2)
+            prof.note_phase("prefetch", t4 - t3)
             prof.plan_explain = explain(meta, "ALL")
             prof.start().attach(ex)
         return ex

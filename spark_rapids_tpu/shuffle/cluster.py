@@ -33,6 +33,7 @@ import os
 import pickle
 import re
 import threading
+import time as _time
 import traceback
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -150,7 +151,12 @@ def _worker_main(worker_id: str, ctrl) -> None:
     from spark_rapids_tpu.shuffle.serializer import merge_to_batch
     from spark_rapids_tpu.shuffle.transport import (ShuffleServer, TcpServer,
                                                     connect_tcp)
+    from spark_rapids_tpu.utils import tracing as _tracing
 
+    # every trace event this process records carries its executor identity,
+    # and the driver merges per-worker captures onto distinct process
+    # tracks (obs/trace_export.merge_process_traces)
+    _tracing.set_process_label(worker_id)
     wid_num = int(worker_id.rsplit("-", 1)[1])
     manager = ShuffleManager(
         local_dir=f"/tmp/srtpu_cluster_{os.getpid()}", writer_threads=2,
@@ -199,6 +205,10 @@ def _worker_main(worker_id: str, ctrl) -> None:
             plans[payload] = _build_plan(payload)
         _C.set_active(confs[payload])
         faults.configure(confs[payload])
+        # the driver collects (and clears) this capture via "trace_req"
+        # and merges it into one multi-process Chrome trace
+        if confs[payload][_C.PROFILE_TRACE] and not _tracing.capturing():
+            _tracing.set_capture(True)
         return plans[payload]
 
     try:
@@ -218,12 +228,17 @@ def _worker_main(worker_id: str, ctrl) -> None:
                             child.output_schema,
                             exchange.partitioner.num_partitions)
                     reg = regs[shuffle_id]
+                    _t0 = _time.perf_counter_ns()
                     for p in parts:
                         batches = list(child.execute(p))
                         local_idx = manager.num_map_outputs(reg)
                         manager.write_map_output(reg, exchange.partitioner,
                                                  batches)
                         maps[(shuffle_id, p)] = (reg, local_idx)
+                    _tracing.record_event(
+                        f"task:map:{shuffle_id}", _t0,
+                        _time.perf_counter_ns() - _t0,
+                        args={"task": task_id, "partitions": list(parts)})
                     ctrl.send(("map_done", task_id, worker_id, parts))
                 elif kind == "reduce":
                     (_, task_id, payload, shuffle_id, reduce_id,
@@ -232,6 +247,7 @@ def _worker_main(worker_id: str, ctrl) -> None:
                         plan_for(payload))
                     faults.check("executor", id=wid_num, task="reduce")
                     schema = exchange.children[0].output_schema
+                    _t0 = _time.perf_counter_ns()
                     blocks: List[bytes] = []
                     for host, port, mids in sources:
                         if not mids:
@@ -266,9 +282,24 @@ def _worker_main(worker_id: str, ctrl) -> None:
                     if tbl is not None:
                         with pa.ipc.new_stream(sink, tbl.schema) as w:
                             w.write_table(tbl)
+                    _tracing.record_event(
+                        f"task:reduce:{shuffle_id}", _t0,
+                        _time.perf_counter_ns() - _t0,
+                        args={"task": task_id, "reduce": reduce_id})
                     ctrl.send(("reduce_done", task_id, reduce_id,
                                sink.getvalue().to_pybytes()
                                if tbl is not None else None))
+                elif kind == "ping":
+                    # health heartbeat: ship this process's gauge snapshot
+                    # so the driver's registry can expose a merged view
+                    from spark_rapids_tpu.obs import gauges as _gauges
+                    ctrl.send(("health", msg[1], worker_id,
+                               _gauges.snapshot()))
+                elif kind == "trace_req":
+                    # hand the capture window to the driver (and clear it:
+                    # each collection owns its events exactly once)
+                    ctrl.send(("trace", msg[1], worker_id,
+                               _tracing.trace_events(clear=True)))
                 elif kind == "heartbeat_ack":
                     pass
                 else:
@@ -314,14 +345,19 @@ class TcpShuffleCluster:
             self._procs.append(p)
             self._proc_by[wid] = p
             self._pipes[wid] = parent
+        from spark_rapids_tpu.obs import health as _health
+
         for wid, pipe in self._pipes.items():
             kind, w, host, port = pipe.recv()
             assert kind == "register" and w == wid
             self.heartbeats.register(wid, host, port)
             self._addrs[wid] = (host, port)
+            _health.REGISTRY.report(wid, kind="cluster", progress=True,
+                                    host=host, port=port)
         self._next_shuffle = 0
         self._next_task = 0
         self._dead: set = set()
+        self._suspect: set = set()  # stalled workers (soft avoid set)
         self._lock = threading.Lock()
 
     # sid uniqueness across run_query calls keeps worker block stores from
@@ -359,6 +395,8 @@ class TcpShuffleCluster:
         # drop the peer from discovery immediately (the timed sweep would
         # also catch it once heartbeats stop)
         self.heartbeats.deregister(wid)
+        from spark_rapids_tpu.obs import health as _health
+        _health.REGISTRY.remove(wid, lost=True)
 
     def _recv(self, wid: str):
         """Receive one message from a worker; None = the worker died."""
@@ -398,8 +436,11 @@ class TcpShuffleCluster:
         last_error = None
         while todo:
             alive = self._alive_workers()
-            if avoid:
-                alive = [w for w in alive if w not in avoid] or alive
+            # soft steering: corrupt-block sources and stalled (suspect)
+            # workers lose work only while healthy candidates remain
+            avoid_all = set(avoid or ()) | self._suspect
+            if avoid_all:
+                alive = [w for w in alive if w not in avoid_all] or alive
             if not alive:
                 raise RuntimeError("all executors lost")
             assignment: Dict[str, List[int]] = {}
@@ -478,6 +519,8 @@ class TcpShuffleCluster:
                         sorted(mids))
                        for wid, mids in sorted(by_worker_mids.items())]
             alive = self._alive_workers()
+            if self._suspect:
+                alive = [w for w in alive if w not in self._suspect] or alive
             if not alive:
                 raise RuntimeError("all executors lost")
             pending = []
@@ -580,10 +623,83 @@ class TcpShuffleCluster:
         _, _, known = self.heartbeats.heartbeat(wid, 0)
         if not known:
             self.heartbeats.register(wid, *self._addrs[wid])
+        from spark_rapids_tpu.obs import health as _health
+        _health.REGISTRY.report(wid, progress=True)
+        self._suspect.discard(wid)
 
-    def heartbeat_round(self) -> None:
-        """One liveness sweep (tests exercise the lost-peer machinery)."""
-        self.heartbeats.sweep_lost()
+    # -- health + trace aggregation ---------------------------------------
+    def collect_health(self) -> Dict:
+        """Poll every live executor for its gauge snapshot and return the
+        registry's merged view (per-worker records + summed gauges). The
+        poll itself is a heartbeat; a reply is NOT progress (only task
+        completion moves last_progress, so stalled workers stay visible)."""
+        from spark_rapids_tpu.obs import health as _health
+
+        for wid in self._alive_workers():
+            tid = self._task_id()
+            try:
+                self._pipes[wid].send(("ping", tid))
+            except (BrokenPipeError, OSError):
+                self._on_dead(wid)
+                continue
+            msg = self._recv(wid)
+            if msg is None or msg[0] != "health":
+                continue
+            _health.REGISTRY.report(msg[2], gauges=msg[3], kind="cluster")
+            self._mark_suspect_heartbeat(msg[2])
+        return _health.REGISTRY.view()
+
+    def _mark_suspect_heartbeat(self, wid: str) -> None:
+        _, _, known = self.heartbeats.heartbeat(wid, 0)
+        if not known:
+            self.heartbeats.register(wid, *self._addrs[wid])
+
+    def collect_traces(self) -> Dict[str, List[Dict]]:
+        """Drain each executor's trace capture (plus the driver's own) as
+        {process label -> raw event list}."""
+        from spark_rapids_tpu.utils import tracing as _tracing
+
+        out: Dict[str, List[Dict]] = {"driver": _tracing.trace_events()}
+        for wid in self._alive_workers():
+            tid = self._task_id()
+            try:
+                self._pipes[wid].send(("trace_req", tid))
+            except (BrokenPipeError, OSError):
+                self._on_dead(wid)
+                continue
+            msg = self._recv(wid)
+            if msg is None or msg[0] != "trace":
+                continue
+            out[msg[2]] = msg[3]
+        return out
+
+    def merged_chrome_trace(self) -> Dict:
+        """One Chrome trace with a distinct process track per executor."""
+        from spark_rapids_tpu.obs import trace_export as _te
+
+        return _te.merge_process_traces(self.collect_traces())
+
+    def heartbeat_round(self, progress_timeout_s: Optional[float] = None
+                        ) -> List[str]:
+        """One liveness + stall sweep: lost peers leave discovery and the
+        health registry (journaled); workers that keep heartbeating but
+        report no task progress for ``progress_timeout_s`` (default
+        spark.rapids.tpu.metrics.health.progressTimeoutSeconds) raise a
+        worker-stale journal event and join the soft avoid set task
+        assignment steers around (the PR-4 blacklist idea applied to
+        workers). Returns newly-stalled worker ids."""
+        from spark_rapids_tpu.config import conf as _C
+        from spark_rapids_tpu.obs import events as _journal
+        from spark_rapids_tpu.obs import health as _health
+
+        for wid in self.heartbeats.sweep_lost():
+            _journal.emit("worker-lost", worker=wid, via="heartbeat-sweep")
+        if progress_timeout_s is None:
+            progress_timeout_s = _C.HEALTH_PROGRESS_TIMEOUT_S.get(
+                _C.get_active())
+        stalled = _health.REGISTRY.sweep_stalled(progress_timeout_s)
+        self._suspect.update(w for w in stalled if w in self._pipes)
+        return stalled
 
     def close(self) -> None:
         for wid, pipe in self._pipes.items():

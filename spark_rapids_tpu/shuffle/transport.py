@@ -304,19 +304,28 @@ class ShuffleClient:
             budget = give_up_at - time.monotonic()
             if timeout is not None:
                 budget = min(budget, timeout)
+            t0 = time.perf_counter_ns()
             try:
                 result = self._fetch_once(blocks, max(budget, 0.001))
+                from spark_rapids_tpu.obs import histo as _histo
+                _histo.record("shuffle_fetch_ns",
+                              time.perf_counter_ns() - t0)
                 if attempt > 1:
                     faults.note_recovered("shuffle.fetch")
                 return result
-            except (TimeoutError, ConnectionError, OSError):
+            except (TimeoutError, ConnectionError, OSError) as e:
                 if attempt >= max_attempts:
                     raise
                 pause = (backoff_ms / 1000.0) * (1 << (attempt - 1)) \
                     * (0.5 + random.random())
                 if time.monotonic() + pause >= give_up_at:
                     raise
+                from spark_rapids_tpu.obs import events as _journal
+                from spark_rapids_tpu.obs import histo as _histo
+                _journal.emit("retry", site="shuffle.fetch", attempt=attempt,
+                              error=type(e).__name__)
                 time.sleep(pause)
+                _histo.record("retry_backoff_ns", int(pause * 1e9))
 
     def _fetch_once(self, blocks: List[BlockId],
                     timeout: Optional[float]) -> List[bytes]:

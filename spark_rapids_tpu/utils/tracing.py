@@ -25,6 +25,19 @@ import jax
 _events_lock = threading.Lock()
 _events: List[Dict] = []
 _capture_events = False
+# Worker/process identity stamped onto every recorded event ("exec-0",
+# "mesh", ...). None in the driver: the merged-trace exporter labels the
+# driver's own pid, so only subordinate processes pay the extra field.
+_process_label: Optional[str] = None
+
+
+def set_process_label(label: Optional[str]) -> None:
+    global _process_label
+    _process_label = label
+
+
+def process_label() -> Optional[str]:
+    return _process_label
 
 
 def trace_events(clear: bool = False) -> List[Dict]:
@@ -66,6 +79,10 @@ def record_event(name: str, start_ns: int, dur_ns: int,
         }
         if args:
             ev["args"] = args
+        if _process_label is not None:
+            a = ev.get("args")
+            ev["args"] = dict(a) if a else {}
+            ev["args"].setdefault("worker", _process_label)
         _events.append(ev)
 
 

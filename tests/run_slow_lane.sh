@@ -1,16 +1,27 @@
 #!/bin/sh
 # Slow differential lane: multi-process cluster, distributed-vs-local TPC-H/
-# TPC-DS comparisons, the ScaleTest harness, and the seeded chaos lane —
-# minutes each, opt-in so the default lane stays fast (VERDICT r4 weak #6).
+# TPC-DS comparisons, the ScaleTest harness, the seeded chaos lane, and the
+# obs_report diagnostics-bundle smoke — minutes each, opt-in so the default
+# lane stays fast (VERDICT r4 weak #6).
 # CI should run BOTH:
 #   python -m pytest tests/ -q            # default lane
 #   tests/run_slow_lane.sh                # this lane
 set -e
 cd "$(dirname "$0")/.."
 SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}" \
-    exec python -m pytest \
+    python -m pytest \
     tests/test_distributed.py tests/test_cluster.py \
     tests/test_tpcds.py tests/test_scaletest.py \
     tests/test_fusion_diff.py tests/test_reuse_diff.py \
     tests/test_pipeline.py tests/test_faults.py \
     tests/test_reuse.py -q "$@"
+
+# Diagnostics-bundle smoke: the --demo query must produce a complete bundle
+# (profiles, journal, metrics exposition, trace, config) without raising.
+OBS_OUT="${TMPDIR:-/tmp}/srtpu_obs_report_smoke"
+python tools/obs_report.py --demo --out "$OBS_OUT"
+for f in profiles.json journal.jsonl metrics.prom trace.json config.json \
+         health.json MANIFEST.json; do
+    test -s "$OBS_OUT/$f" || { echo "obs_report smoke: missing $f" >&2; exit 1; }
+done
+echo "obs_report smoke OK: $OBS_OUT"

@@ -106,6 +106,101 @@ def test_cluster_heartbeat_discovery(cluster):
     assert len(cluster.heartbeats.peers()) == 2
 
 
+def test_cluster_health_view(cluster):
+    """Driver polls every executor for its gauge snapshot and merges the
+    per-worker records into one health view."""
+    view = cluster.collect_health()
+    wids = [w["worker_id"] for w in view["workers"]]
+    assert set(cluster.workers) <= set(wids)
+    assert view["alive"] >= 2
+    by_id = {w["worker_id"]: w for w in view["workers"]}
+    for wid in cluster.workers:
+        w = by_id[wid]
+        assert w["kind"] == "cluster" and w["heartbeats"] >= 1
+        # the poll carried the executor's gauge snapshot across the wire
+        assert "pool_used_bytes" in w["gauges"]
+    assert "jit_cache_hit_total" in view["merged_gauges"]
+
+
+def test_cluster_stalled_worker_raises_journal_event(cluster, rng):
+    """A worker that heartbeats but makes no task progress is flagged stale
+    (worker-stale journal event, once per episode) and joins the soft avoid
+    set; completing a task recovers it."""
+    from spark_rapids_tpu.obs import events as journal
+
+    cluster.collect_health()       # heartbeats alone are NOT progress
+    journal.clear()
+    stalled = cluster.heartbeat_round(progress_timeout_s=0.0)
+    assert set(cluster.workers) <= set(stalled)
+    flagged = {e["worker"] for e in journal.recent("worker-stale")}
+    assert set(cluster.workers) <= flagged
+    assert set(cluster.workers) <= cluster._suspect
+    # once per stall episode: a second sweep is silent
+    assert set(cluster.heartbeat_round(progress_timeout_s=0.0)) \
+        .isdisjoint(cluster.workers)
+    view = cluster.collect_health()
+    assert view["stale"] >= 2
+    # a completed task is progress: the worker recovers and leaves the
+    # avoid set (the or-alive fallback kept the query runnable throughout)
+    t = pa.table({"k": pa.array(rng.integers(0, 5, 500), pa.int64()),
+                  "v": pa.array(rng.integers(0, 9, 500), pa.int64())})
+    df = from_arrow(t, _conf(), batch_rows=256, partitions=2)
+    df.shuffle_partitions = 2
+    cluster.run_query(df.group_by("k").agg(E.Sum(col("v")).alias("s")))
+    assert not (set(cluster.workers) & cluster._suspect)
+    assert cluster.collect_health()["stale"] == 0
+    journal.clear()
+
+
+def test_cluster_merged_multiworker_trace(cluster, rng, tmp_path):
+    """A traceCapture query produces per-worker captures the driver merges
+    into ONE Chrome trace with a distinct process track per executor."""
+    import json
+
+    from spark_rapids_tpu.utils import tracing
+    from tools.trace_viewer_check import check_file, validate_trace
+
+    trace_conf = RapidsConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.profile.traceCapture": True,
+    })
+    n = 3000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 17, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 100, n), pa.int64()),
+    })
+    df = from_arrow(t, trace_conf, batch_rows=512, partitions=4)
+    df.shuffle_partitions = 3
+    q = df.group_by("k").agg(E.Sum(col("v")).alias("s"))
+    tracing.set_capture(True, clear=True)
+    try:
+        cluster.run_query(q)
+        obj = cluster.merged_chrome_trace()
+    finally:
+        tracing.set_capture(False)
+        tracing.trace_events(clear=True)
+    assert validate_trace(obj) == []
+    spans = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    # both executors contributed map/reduce task spans on their own tracks
+    task_pids = {e["pid"] for e in spans
+                 if e["name"].startswith(("task:map:", "task:reduce:"))}
+    assert len(task_pids) == 2
+    names = [e["name"] for e in spans]
+    assert any(n.startswith("task:map:") for n in names)
+    assert any(n.startswith("task:reduce:") for n in names)
+    # every process track is labeled; driver sorts first
+    labels = {e["args"]["name"]: e["pid"] for e in obj["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert labels["driver"] == 1
+    assert len(labels) == 3  # driver + 2 executors
+    # worker identity is stamped on the spans themselves too
+    assert all("worker" in e.get("args", {}) for e in spans
+               if e["name"].startswith("task:"))
+    path = tmp_path / "merged_cluster_trace.json"
+    path.write_text(json.dumps(obj))
+    assert check_file(str(path)) == []
+
+
 def test_cluster_executor_sigkill_recovery(rng):
     """One executor SIGKILLed mid-query: its map blocks recompute on
     survivors (lineage) and its reduce tasks reschedule — the query still

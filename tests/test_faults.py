@@ -404,6 +404,50 @@ def test_query_recovers_from_escaped_device_fault(tmp_path):
 
 # -- counters surface through obs -------------------------------------------
 
+def test_journal_records_fault_lifecycle(tmp_path):
+    """Every fault counter tick has a matching journal event: injection,
+    recovery, and CPU degradation all leave an auditable trail."""
+    from spark_rapids_tpu.obs import events as journal
+    from spark_rapids_tpu.plan import read_parquet
+
+    t = pa.table({"v": pa.array(range(60), pa.int64())})
+    path = str(tmp_path / "j.parquet")
+    pq.write_table(t, path)
+
+    # persistent decode faults -> blacklist -> CPU degradation
+    journal.clear()
+    conf = RapidsConf({"spark.rapids.tpu.test.faults":
+                       "io.decode:error@file=*.parquet,count=100"})
+    before = faults.counters()
+    read_parquet(path, conf=conf).to_arrow()
+    after = faults.counters()
+    inj = journal.recent("fault-injected")
+    assert len(inj) == _delta(before, after, "fault_injected_total")
+    assert all(e["site"] == "io.decode" for e in inj)
+    deg = journal.recent("degraded")
+    assert len(deg) == _delta(before, after, "fault_degraded_total") == 1
+    assert journal.recent("query-retry"), "retry attempts journaled"
+
+    # single transient fault -> whole-query retry absorbs it (forget the
+    # first phase's blacklist entry so this plan runs on the device)
+    from spark_rapids_tpu.faults import blacklist
+    blacklist.clear()
+    t2 = pa.table({"w": pa.array(range(40), pa.int64())})
+    path2 = str(tmp_path / "j2.parquet")
+    pq.write_table(t2, path2)
+    journal.clear()
+    conf = RapidsConf({"spark.rapids.tpu.test.faults":
+                       "io.decode:error@file=*.parquet,count=1"})
+    before = faults.counters()
+    read_parquet(path2, conf=conf).to_arrow()
+    after = faults.counters()
+    rec = journal.recent("fault-recovered")
+    assert len(rec) == _delta(before, after, "fault_recovered_total") >= 1
+    assert all("site" in e for e in rec)
+    assert journal.recent("degraded") == []
+    journal.clear()
+
+
 def test_gauges_surface_fault_counters():
     from spark_rapids_tpu.obs import gauges
 
@@ -535,3 +579,29 @@ def test_chaos_exercised_and_recovered():
     ctr = faults.counters()
     assert ctr["fault_injected_total"] > 0
     assert ctr["fault_recovered_total"] > 0
+
+
+@chaos
+def test_chaos_journal_matches_fault_counters():
+    """Chaos acceptance for the journal: a seeded corrupt-block injection
+    absorbed by the refetch path leaves matching fault-injected and
+    fault-recovered journal events — the counters never tick silently."""
+    from spark_rapids_tpu.obs import events as journal
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+
+    mgr = ShuffleManager(cache_only=True, integrity=True)
+    reg, t = _write_one_partition(mgr)
+    journal.clear()
+    before = faults.counters()
+    faults.install(f"shuffle.block:corrupt@count=1,seed={FAULTS_SEED}")
+    out = mgr.read_partition(reg, 0)
+    faults.install("")
+    assert out.to_pylist() == t.to_pylist()
+    after = faults.counters()
+    inj = journal.recent("fault-injected")
+    rec = journal.recent("fault-recovered")
+    assert len(inj) == _delta(before, after, "fault_injected_total") == 1
+    assert len(rec) == _delta(before, after, "fault_recovered_total") == 1
+    assert inj[0]["site"] == "shuffle.block"
+    assert rec[0]["site"]
+    journal.clear()

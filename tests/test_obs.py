@@ -7,6 +7,7 @@ hygiene (docs/observability.md).
 import json
 import pathlib
 import sys
+import threading
 
 import numpy as np
 import pyarrow as pa
@@ -20,6 +21,10 @@ from spark_rapids_tpu.obs import (
     collect_node_stats,
     gauge_snapshot,
     get_profile,
+    health,
+    histo,
+    journal,
+    merge_process_traces,
     render_prometheus,
     to_chrome_trace,
 )
@@ -89,10 +94,11 @@ def test_explain_analyze_renders_metrics_inline():
     text = df.last_profile().explain_analyze()
     lines = text.splitlines()
     assert lines[0].startswith("== Query Profile #")
-    assert f"rows={len(rows)}" in lines[1]  # root line carries its rows
-    assert "opTime=" in lines[1] and "batches=" in lines[1]
+    assert lines[1].startswith("phases: ")  # phase attribution header
+    assert f"rows={len(rows)}" in lines[2]  # root line carries its rows
+    assert "opTime=" in lines[2] and "batches=" in lines[2]
     # children are indented under the root with the explain-style prefix
-    assert any(l.lstrip().startswith("+- ") for l in lines[2:])
+    assert any(l.lstrip().startswith("+- ") for l in lines[3:])
     # ns-suffixed metrics are rendered as milliseconds
     assert "Ns=" not in text
 
@@ -268,3 +274,260 @@ def test_query_profile_owns_capture_only_when_free(tmp_path):
         assert tracing.capturing()  # user window still open
     assert not tracing.capturing()
     tracing.trace_events(clear=True)
+
+
+# -- event journal ---------------------------------------------------------
+
+def test_journal_records_query_lifecycle():
+    journal.clear()
+    df, _ = _run_profiled()
+    qid = df.last_profile().query_id
+    kinds = [e["kind"] for e in journal.recent(query_id=qid)]
+    assert kinds[0] == "submit" and kinds[-1] == "finish"
+    phases = [e["phase"] for e in journal.recent("phase", query_id=qid)]
+    assert {"plan-rewrite", "reuse", "fusion"} <= set(phases)
+    fin = journal.recent("finish", query_id=qid)[0]
+    assert fin["wall_ms"] > 0 and "compile_ms" in fin
+    # phase attribution also lands in the profile itself
+    d = df.last_profile().to_dict()
+    assert {"plan-rewrite", "compile", "execute"} <= set(d["phases"])
+    assert "phases:" in df.last_profile().explain_analyze()
+    assert {"p50", "p95", "p99"} == set(d["latency"]["query_wall"])
+
+
+def test_journal_bounded_eviction():
+    journal.clear()
+    old_cap = journal.capacity()
+    try:
+        journal.set_capacity(16)
+        for i in range(50):
+            journal.emit("evict-test", seq=i)
+        evs = journal.recent("evict-test")
+        assert len(evs) == 16
+        assert evs[-1]["seq"] == 49          # newest retained
+        assert journal.counters()["journal_evicted_total"] >= 34
+    finally:
+        journal.set_capacity(old_cap)
+        journal.clear()
+
+
+def test_journal_disabled_is_silent():
+    journal.clear()
+    try:
+        journal.set_enabled(False)
+        assert journal.emit("off-test") is None
+        assert journal.recent("off-test") == []
+        assert journal.counters()["journal_events_total"] == 0
+    finally:
+        journal.set_enabled(True)
+
+
+def test_journal_concurrent_emits_no_lost_updates():
+    journal.clear()
+    n_threads, per_thread = 8, 500
+    barrier = threading.Barrier(n_threads)
+
+    def worker(t):
+        barrier.wait()
+        for i in range(per_thread):
+            journal.emit("conc-test", thread=t, seq=i)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert journal.counters()["journal_events_total"] == n_threads * per_thread
+    # the bounded ring holds min(capacity, emitted), never more
+    assert len(journal.recent("conc-test")) <= journal.capacity()
+    journal.clear()
+
+
+def test_journal_dump_jsonl_roundtrips(tmp_path):
+    journal.clear()
+    journal.emit("dump-test", query_id=7, note="hello")
+    path = journal.dump_jsonl(str(tmp_path / "journal.jsonl"))
+    lines = [json.loads(l) for l in open(path)]
+    assert any(e["kind"] == "dump-test" and e["query_id"] == 7 for e in lines)
+    journal.clear()
+
+
+# -- latency histograms ----------------------------------------------------
+
+def test_histogram_percentile_within_bucket_resolution():
+    h = histo.Histogram("t")
+    for _ in range(1000):
+        h.record(10_000_000)  # 10ms
+    for p in ("p50", "p95", "p99"):
+        v = h.percentiles_ms()[p]
+        assert 5.0 <= v <= 20.0, (p, v)  # log2 buckets: within 2x
+
+
+def test_histogram_concurrent_records_no_lost_updates():
+    h = histo.Histogram("conc")
+    n_threads, per_thread = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(per_thread):
+            h.record(1_000_000)
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s = h.snapshot()
+    assert s["count"] == n_threads * per_thread
+    assert s["sum"] == n_threads * per_thread * 1_000_000
+
+
+def test_histogram_window_diff():
+    h = histo.get("shuffle_fetch_ns")
+    s0 = h.snapshot()
+    for _ in range(100):
+        h.record(2_000_000)
+    win = histo.diff(s0, h.snapshot())
+    assert win["count"] == 100
+    assert 1.0 <= h.percentiles_ms(win)["p50"] <= 4.0
+
+
+def test_histogram_disabled_and_undeclared():
+    try:
+        histo.set_enabled(False)
+        before = histo.get("retry_backoff_ns").snapshot()["count"]
+        histo.record("retry_backoff_ns", 123)
+        assert histo.get("retry_backoff_ns").snapshot()["count"] == before
+    finally:
+        histo.set_enabled(True)
+    with pytest.raises(KeyError):
+        histo.get("not_declared_ns")
+
+
+def test_prometheus_histogram_families():
+    histo.record("query_wall_ns", 50_000_000)
+    text = render_prometheus()
+    assert "# TYPE srtpu_query_wall_seconds histogram" in text
+    lines = text.splitlines()
+    buckets = [l for l in lines
+               if l.startswith("srtpu_query_wall_seconds_bucket")]
+    assert buckets and buckets[-1].startswith(
+        'srtpu_query_wall_seconds_bucket{le="+Inf"}')
+    # cumulative: counts never decrease along the le ladder
+    counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+    assert counts == sorted(counts)
+    assert any(l.startswith("srtpu_query_wall_seconds_sum ") for l in lines)
+    assert any(l.startswith("srtpu_query_wall_seconds_count ") for l in lines)
+
+
+# -- worker health registry ------------------------------------------------
+
+def test_health_registry_stall_flag_and_recovery():
+    reg = health.HealthRegistry()
+    journal.clear()
+    reg.report("w0", kind="cluster", progress=True)
+    reg.report("w1", kind="cluster", progress=True)
+    assert reg.sweep_stalled(60.0) == []          # fresh progress
+    stalled = reg.sweep_stalled(0.0)
+    assert sorted(stalled) == ["w0", "w1"]
+    assert reg.sweep_stalled(0.0) == []           # flagged once per episode
+    assert {e["worker"] for e in journal.recent("worker-stale")} == \
+        {"w0", "w1"}
+    v = reg.view()
+    assert v["stale"] == 2 and v["alive"] == 0
+    # a heartbeat recovers the worker; the next sweep may re-flag it
+    reg.report("w0", progress=True)
+    assert reg.view()["alive"] == 1
+    assert reg.sweep_stalled(0.0) == ["w0"]
+    assert reg.counters()["worker_stale_total"] == 3
+    journal.clear()
+
+
+def test_health_registry_merged_gauges_and_lost():
+    reg = health.HealthRegistry()
+    journal.clear()
+    reg.report("a", gauges={"pool_used_bytes": 100, "oom": 1})
+    reg.report("b", gauges={"pool_used_bytes": 50})
+    v = reg.view()
+    assert v["merged_gauges"]["pool_used_bytes"] == 150
+    assert [w["worker_id"] for w in v["workers"]] == ["a", "b"]
+    reg.remove("a", lost=True)
+    reg.remove("never-registered", lost=True)     # no-op, no event
+    assert reg.counters()["worker_lost_total"] == 1
+    assert [e["worker"] for e in journal.recent("worker-lost")] == ["a"]
+    journal.clear()
+
+
+# -- merged multi-worker traces --------------------------------------------
+
+def test_merge_process_traces_multiworker(tmp_path):
+    per = {
+        "worker-1": [{"name": "task:map:s1", "start_ns": 2_000_000,
+                      "dur_ns": 500_000, "thread": 11,
+                      "args": {"worker": "worker-1"}}],
+        "driver": [{"name": "plan", "start_ns": 1_000_000,
+                    "dur_ns": 200_000, "thread": 1}],
+        "worker-0": [{"name": "task:reduce:s1", "start_ns": 3_000_000,
+                      "dur_ns": 400_000, "thread": 12}],
+    }
+    obj = merge_process_traces(per)
+    assert validate_trace(obj) == []
+    spans = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert len({e["pid"] for e in spans}) == 3    # one track per process
+    # driver gets pid 1 and the earliest event rebases to ts 0
+    names = {e["args"]["name"]: e["pid"]
+             for e in obj["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names["driver"] == 1
+    assert {"worker-0", "worker-1"} <= set(names)
+    assert min(e["ts"] for e in spans) == 0
+    path = tmp_path / "merged.json"
+    path.write_text(json.dumps(obj))
+    from tools.trace_viewer_check import check_file
+    assert check_file(str(path)) == []
+
+
+def test_tracing_process_label_stamps_events():
+    prev = tracing.process_label()
+    try:
+        tracing.set_process_label("worker-7")
+        tracing.set_capture(True, clear=True)
+        tracing.record_event("labeled", 0, 10)
+        tracing.record_event("labeled2", 0, 10, args={"x": 1})
+        evs = tracing.trace_events(clear=True)
+        assert all(e["args"]["worker"] == "worker-7" for e in evs)
+        assert evs[1]["args"]["x"] == 1
+    finally:
+        tracing.set_capture(False)
+        tracing.set_process_label(prev)
+
+
+# -- gauge catalog static guard --------------------------------------------
+
+def test_gauge_catalog_guard_passes_on_tree():
+    from tools import check_gauge_catalog as G
+    assert G.main() == 0
+
+
+def test_gauge_catalog_guard_catches_undeclared(tmp_path):
+    from tools import check_gauge_catalog as G
+    declared = G.catalog_names()
+    assert "pool_oom_total" in declared
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def counters():\n"
+        "    return {'made_up_thing_total': 1}\n"
+        "_C = {}\n"
+        "_C['other_unknown_total'] = 2\n"
+        "def f(note):\n"
+        "    note('third_unknown_total', 1)\n"
+        "    alias('year_total')\n"   # SQL alias shape: must NOT be flagged
+    )
+    violations = []
+    G._check_file(str(bad), declared, violations)
+    flagged = " ".join(violations)
+    assert "made_up_thing_total" in flagged
+    assert "other_unknown_total" in flagged
+    assert "third_unknown_total" in flagged
+    assert "year_total" not in flagged

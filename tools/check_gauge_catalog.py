@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Static guard for the gauge/counter catalog contract.
+
+``obs/gauges.CATALOG`` is the single source of truth for every metric the
+process exposes: ``snapshot()`` zero-fills exactly the catalog names, the
+Prometheus exposition renders from it, and tests assert
+``set(snapshot()) == {name for name, _, _ in CATALOG}``. A counter that a
+subsystem increments but never declares is invisible to scrapers and to
+QueryProfile diffs — it silently vanishes from the process view.
+
+The convention: counter names end in ``_total``. This checker flags any
+``*_total`` string constant that the runtime uses as a metric name —
+
+1. a dict-literal key (the ``counters()`` / ``cache_stats()`` idiom),
+2. a subscript key (``_COUNTERS["fault_injected_total"] += 1``),
+3. the first argument of a call to ``note(...)`` (the task-metrics feed),
+
+— but that ``CATALOG`` does not declare. SQL column aliases like
+``year_total`` live in ``.alias(...)`` / ``col(...)`` call arguments and
+match none of these shapes.
+
+Pure AST analysis, no imports of the checked code; wired into the default
+test lane via tests/test_obs.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_rapids_tpu")
+
+
+def catalog_names() -> set:
+    """CATALOG metric names, parsed statically from obs/gauges.py."""
+    path = os.path.join(PKG, "obs", "gauges.py")
+    with open(path, "r") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "CATALOG":
+                entries = ast.literal_eval(node.value)
+                return {name for name, _, _ in entries}
+    raise SystemExit("obs/gauges.py: CATALOG assignment not found "
+                     "(update tools/check_gauge_catalog.py)")
+
+
+def _is_metric_name(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.endswith("_total"))
+
+
+def _check_file(path: str, declared: set, violations: list) -> None:
+    with open(path, "r") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        violations.append(f"{path}: not parseable: {e}")
+        return
+    rel = os.path.relpath(path, REPO)
+
+    def flag(const: ast.Constant, how: str) -> None:
+        if const.value not in declared:
+            violations.append(
+                f"{rel}:{const.lineno}: counter '{const.value}' {how} but is "
+                f"not declared in obs/gauges.CATALOG — it would be invisible "
+                f"to snapshot()/Prometheus/QueryProfile diffs")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is not None and _is_metric_name(k):
+                    flag(k, "is a dict-literal metric key")
+        elif isinstance(node, ast.Subscript):
+            sl = node.slice
+            if _is_metric_name(sl):
+                flag(sl, "is used as a subscript metric key")
+        elif isinstance(node, ast.Call):
+            fname = (node.func.id if isinstance(node.func, ast.Name)
+                     else node.func.attr if isinstance(node.func, ast.Attribute)
+                     else None)
+            if fname == "note" and node.args and _is_metric_name(node.args[0]):
+                flag(node.args[0], "is passed to note(...)")
+
+
+def main() -> int:
+    declared = catalog_names()
+    violations: list = []
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                _check_file(os.path.join(dirpath, fn), declared, violations)
+    if violations:
+        print("gauge-catalog guard FAILED:", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"gauge-catalog guard OK ({len(declared)} declared metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,7 +207,10 @@ def main():
     from spark_rapids_tpu.obs import to_chrome_trace
 
     events = tracing.trace_events(clear=True)
-    out_path = os.environ.get("PROBE_TRACE", "trace_perf_probe.json")
+    out_path = os.environ.get("PROBE_TRACE",
+                              os.path.join("artifacts",
+                                           "trace_perf_probe.json"))
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(to_chrome_trace(events, process_name="perf_probe"), f)
     print(f"chrome trace ({len(events)} spans):", out_path)
@@ -375,7 +378,10 @@ def overlap(sf=None, n_files=None, reps=2):
     conc = {ln: round(_intersect_s(iv, compute), 4)
             for ln, iv in merged.items() if ln != "compute"}
 
-    trace_path = os.environ.get("PROBE_TRACE", "trace_overlap.json")
+    trace_path = os.environ.get("PROBE_TRACE",
+                                os.path.join("artifacts",
+                                             "trace_overlap.json"))
+    os.makedirs(os.path.dirname(trace_path) or ".", exist_ok=True)
     with open(trace_path, "w") as f:
         json.dump(to_chrome_trace(events, process_name="overlap"), f)
 
