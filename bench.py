@@ -396,7 +396,22 @@ def main(budget_s=None, faults=None):
     def _r(v, nd):
         return round(v, nd) if v is not None else None
 
-    def suite_line(suite, fresh, reused, cpu_s, rows):
+    def _mem_window_start():
+        """Memory baseline for a suite's timed window: spill byte counters
+        (delta across the window) and the tracked-peak watermark."""
+        from spark_rapids_tpu.utils import task_metrics as TM
+        return TM.aggregate_snapshot()
+
+    def _mem_window_end(tm0):
+        from spark_rapids_tpu.obs import gauges as G
+        from spark_rapids_tpu.utils import task_metrics as TM
+        tm1 = TM.aggregate_snapshot()
+        spill = sum(max(0, tm1.get(f, 0) - tm0.get(f, 0))
+                    for f in ("spill_to_host_bytes", "spill_to_disk_bytes"))
+        return {"peak_hbm_bytes": G.snapshot()["mem_tracked_peak_bytes"],
+                "spill_bytes": spill}
+
+    def suite_line(suite, fresh, reused, cpu_s, rows, mem=None):
         """Per-suite metric line, flushed the moment the suite is measured —
         a run killed during a later suite's setup still reports this one."""
         print(json.dumps({
@@ -407,17 +422,20 @@ def main(budget_s=None, faults=None):
                            "reused_median": _r(reused[1], 4)},
             "cpu_s": round(cpu_s, 3),
             "rows_per_sec": round(rows / fresh[0], 1),
+            **(mem or {}),
         }), flush=True)
 
     # ---- TPC-H timed runs (metric line lands BEFORE TPC-DS setup) ------
     _mark("tpch warmup + timed runs")
     # TPC-DS is still ahead: spend at most half the remaining budget here
+    tm0_h = _mem_window_start()
     h_fresh, h_reused, t_iter_h = warm_and_time(h_plans, h_names, 0.5)
+    mem_h = _mem_window_end(tm0_h)
     li, orders, cust = base_h["lineitem"], base_h["orders"], base_h["customer"]
     rows_h = (2 * li.num_rows                       # q1 + q6
               + li.num_rows + orders.num_rows + cust.num_rows   # q3
               + li.num_rows + orders.num_rows + cust.num_rows)  # q5
-    suite_line("tpch", h_fresh, h_reused, cpu_h_s, rows_h)
+    suite_line("tpch", h_fresh, h_reused, cpu_h_s, rows_h, mem=mem_h)
 
     # ---- TPC-DS sources + plans -----------------------------------------
     _mark("tpcds gen+plans")
@@ -455,10 +473,12 @@ def main(budget_s=None, faults=None):
 
     # ---- TPC-DS timed runs ----------------------------------------------
     _mark("tpcds warmup + timed runs")
+    tm0_ds = _mem_window_start()
     ds_fresh, ds_reused, t_iter_ds = warm_and_time(
         ds_plans, TPCDS_QUERIES, 0.75)
+    mem_ds = _mem_window_end(tm0_ds)
     rows_ds = sum(base_ds["store_sales"].num_rows for _ in TPCDS_QUERIES)
-    suite_line("tpcds", ds_fresh, ds_reused, cpu_ds_s, rows_ds)
+    suite_line("tpcds", ds_fresh, ds_reused, cpu_ds_s, rows_ds, mem=mem_ds)
     t_iter = t_iter_h + t_iter_ds
 
     roofline = None
@@ -488,11 +508,20 @@ def main(budget_s=None, faults=None):
                 for qn in TPCDS_QUERIES]) if do_profiles else []
     from spark_rapids_tpu.obs import histo as _histo
     batch_histo = _histo.get("batch_op_ns")
+    from spark_rapids_tpu.obs import memtrack as _mt
     for suite, qn, tabs, builders, batch_rows in specs:
         node = build_plans(tabs, prof_conf, builders, [qn], batch_rows)[qn]
         prof = profile_for(node)
         b0 = batch_histo.snapshot()
-        fence([run_plan(node)[1]])
+        # run_plan drives the exec tree directly (no DataFrame), so open
+        # the attribution window the dataframe layer would normally own
+        if prof is not None:
+            _mt.begin_query(prof.query_id)
+        try:
+            fence([run_plan(node)[1]])
+        finally:
+            if prof is not None:
+                _mt.end_query(prof.query_id)
         if prof is None:
             continue
         prof.finish(node)
@@ -511,6 +540,11 @@ def main(budget_s=None, faults=None):
                 "execute": ph.get("execute", 0.0),
             },
             "batch_op_ms": batch_histo.percentiles_ms(win),
+            # per-query HBM attribution (obs/memtrack.py via the profile)
+            "peak_hbm_bytes": prof.memory.get("tracked_peak_bytes", 0),
+            "spill_bytes": sum(prof.task_metrics.get(f, 0) for f in
+                               ("spill_to_host_bytes",
+                                "spill_to_disk_bytes")),
         }), flush=True)
         ppath = os.path.join(prof_dir, f"profile_{suite}_{qn}.json")
         with open(ppath, "w") as f:

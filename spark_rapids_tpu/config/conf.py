@@ -257,6 +257,39 @@ METRICS_HISTOGRAM_ENABLED = conf(
         "_bucket/_sum/_count families with p50/p95/p99 in profiles "
         "(obs/histo.py).")
 
+MEM_TRACK_ENABLED = conf(
+    "spark.rapids.tpu.memory.track.enabled", default=True,
+    doc="Attribute every HBM-pool allocation to a (query, operator, site) "
+        "tag (obs/memtrack.py): per-site watermark gauges, memory sections "
+        "in query profiles, OOM post-mortem ranking, and the query-end "
+        "leak audit all read this. Disabled, the pool hooks are one flag "
+        "read per allocation (docs/memory.md).")
+
+MEM_POSTMORTEM_ENABLED = conf(
+    "spark.rapids.tpu.memory.oomPostmortem.enabled", default=True,
+    doc="On an unrecoverable allocation failure (pool denied after "
+        "spilling, or with_retry exhausted), write a ranked snapshot of "
+        "live allocations, spill/semaphore state, and recent retry "
+        "history to oom_postmortem_*.json (docs/memory.md).")
+
+MEM_POSTMORTEM_DIR = conf(
+    "spark.rapids.tpu.memory.oomPostmortem.dir", default="artifacts",
+    doc="Directory OOM post-mortem JSON files are written to (created on "
+        "first dump).")
+
+MEM_LEAK_AUDIT_ENABLED = conf(
+    "spark.rapids.tpu.memory.leakAudit.enabled", default=True,
+    doc="At query end, check that every allocation tagged to the query "
+        "was freed (MemoryCleaner analog; materialization-cache entries "
+        "are exempt while cached). Leaks feed srtpu_mem_leaked_bytes_total "
+        "and a leak-audit journal event (docs/memory.md).")
+
+MEM_LEAK_AUDIT_STRICT = conf(
+    "spark.rapids.tpu.memory.leakAudit.strict", default=False,
+    internal=True,
+    doc="Test-lane flag: raise MemoryLeakError when the query-end leak "
+        "audit finds leaked bytes on an otherwise-successful query.")
+
 HEALTH_PROGRESS_TIMEOUT_S = conf(
     "spark.rapids.tpu.metrics.health.progressTimeoutSeconds", default=60.0,
     doc="A worker that keeps heartbeating but reports no task progress "

@@ -121,6 +121,7 @@ class HashAggregateExec(UnaryExec):
     """
 
     shrink_output = True
+    mem_site = "agg-state"
 
     def __init__(self, group_exprs: Sequence[E.Expression],
                  agg_exprs: Sequence[E.Expression], child: TpuExec,

@@ -119,6 +119,12 @@ class TpuExec:
     # downstream kernels run at the smaller static shape.
     shrink_output = False
 
+    # Memory-attribution site (obs/memtrack.py SITES) pushed with the
+    # operator name around every batch pull, so pool allocations made
+    # inside this operator's iterator (spill-handle registration, retry
+    # splits) attribute to it. None keeps the ambient site.
+    mem_site: Optional[str] = None
+
     def __init__(self, *children: "TpuExec"):
         self.children: List[TpuExec] = list(children)
         self.metrics: Dict[str, Metric] = {}
@@ -146,6 +152,7 @@ class TpuExec:
     # -- execution ---------------------------------------------------------
     def execute(self, partition: int = 0) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.obs import histo as _histo
+        from spark_rapids_tpu.obs import memtrack as _mt
         from spark_rapids_tpu.utils import tracing
         it = self.do_execute(partition)
         op_time = self.metrics["opTime"]
@@ -157,11 +164,18 @@ class TpuExec:
                        if _histo.enabled() else None)
         while True:
             t0 = time.perf_counter_ns()
+            # HBM attribution context: pool allocations made while this
+            # operator's iterator runs tag to (query, operator, site).
+            # Nested execute() frames re-push, so the innermost active
+            # operator wins — two thread-local writes per batch when on
+            mem_tok = _mt.push_op(name, self.mem_site)
             try:
                 batch = next(it)
             except StopIteration:
                 op_time.add(time.perf_counter_ns() - t0)
                 return
+            finally:
+                _mt.pop_op(mem_tok)
             if SYNC_METRICS:
                 from spark_rapids_tpu.utils.sync import fence
                 fence(batch)

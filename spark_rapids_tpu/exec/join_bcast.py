@@ -50,6 +50,8 @@ class BroadcastHashJoinExec(HashJoinExec):
     with a broadcast build side).
     """
 
+    mem_site = "broadcast"
+
     BROADCAST_TYPES = ("inner", "left", "left_semi", "left_anti")
 
     def __init__(self, left_keys, right_keys, join_type, left, right,
@@ -146,6 +148,8 @@ class BroadcastNestedLoopJoinExec(BinaryExec):
     build chunks so each step is one compiled XLA computation; `cross` is
     `inner` with no condition (GpuCartesianProductExec shares this path).
     """
+
+    mem_site = "broadcast"
 
     def __init__(self, join_type: str, left: TpuExec, right: TpuExec,
                  condition: Optional[E.Expression] = None,

@@ -86,10 +86,15 @@ class PrefetchIterator:
 
     ``account=False`` disables HBM-pool registration (host-side sources
     whose footprint the pool does not track).
+
+    ``mem_site`` names the obs/memtrack.py attribution site the worker's
+    pool allocations tag to — the worker runs off-thread, so it carries an
+    explicit tag built here (on the consumer thread) instead of relying on
+    thread-local operator context.
     """
 
     def __init__(self, source, depth: int = 2, label: str = "prefetch",
-                 account: bool = True):
+                 account: bool = True, mem_site: Optional[str] = None):
         self._source = iter(source)
         self._label = label
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
@@ -104,6 +109,11 @@ class PrefetchIterator:
                 self._pool = get_pool()
             except Exception:
                 self._pool = None
+        self._mem_tag = None
+        if self._pool is not None:
+            from spark_rapids_tpu.obs import memtrack as _mt
+            self._mem_tag = _mt.make_tag(mem_site or "other",
+                                         op=label.split("#", 1)[0])
         self._thread = threading.Thread(
             target=self._run, name=f"srtpu-prefetch-{label}", daemon=True)
         self._thread.start()
@@ -118,25 +128,26 @@ class PrefetchIterator:
                 try:
                     item = next(self._source)
                 except StopIteration:
-                    self._q.put((_DONE, None, 0))
+                    self._q.put((_DONE, None, 0, None))
                     return
                 tracing.record_event(f"prefetch:{self._label}", t0,
                                      time.perf_counter_ns() - t0)
                 nbytes = _item_nbytes(item)
+                tag = None
                 if self._pool is not None and nbytes:
                     try:
-                        self._pool.allocate(nbytes)
+                        tag = self._pool.allocate(nbytes, tag=self._mem_tag)
                     except RetryOOM:
                         # no headroom for read-ahead: hand over the batch in
                         # hand unaccounted and degrade to synchronous pulls
                         STATS.add("sheds", 1)
-                        self._put((_ITEM, item, 0))
-                        self._q.put((_SHED, None, 0))
+                        self._put((_ITEM, item, 0, None))
+                        self._q.put((_SHED, None, 0, None))
                         return
-                if not self._put((_ITEM, item, nbytes)):
+                if not self._put((_ITEM, item, nbytes, tag)):
                     return  # closed while blocked on a full queue
         except BaseException as e:  # noqa: BLE001 — must reach the consumer
-            self._q.put((_ERROR, e, 0))
+            self._q.put((_ERROR, e, 0, None))
 
     def _put(self, entry) -> bool:
         """Blocking put that stays responsive to close(); returns False (and
@@ -150,7 +161,7 @@ class PrefetchIterator:
             except queue.Full:
                 continue
         if entry[0] == _ITEM and entry[2] and self._pool is not None:
-            self._pool.release(entry[2])
+            self._pool.release(entry[2], tag=entry[3])
         return False
 
     # -- consumer ----------------------------------------------------------
@@ -168,19 +179,19 @@ class PrefetchIterator:
                     self._finished = True
                     raise
             try:
-                tag, payload, nbytes = self._q.get_nowait()
+                kind, payload, nbytes, mtag = self._q.get_nowait()
             except queue.Empty:
                 STATS.add("stalls", 1)
-                tag, payload, nbytes = self._q.get()
-            if tag == _ITEM:
+                kind, payload, nbytes, mtag = self._q.get()
+            if kind == _ITEM:
                 STATS.add("depth", -1)
                 if nbytes and self._pool is not None:
-                    self._pool.release(nbytes)
+                    self._pool.release(nbytes, tag=mtag)
                 return payload
-            if tag == _DONE:
+            if kind == _DONE:
                 self._finished = True
                 raise StopIteration
-            if tag == _SHED:
+            if kind == _SHED:
                 # the worker has exited; everything it produced was already
                 # dequeued (FIFO), so the source is ours now
                 self._direct = True
@@ -203,13 +214,13 @@ class PrefetchIterator:
     def _drain(self) -> None:
         while True:
             try:
-                tag, _payload, nbytes = self._q.get_nowait()
+                kind, _payload, nbytes, mtag = self._q.get_nowait()
             except queue.Empty:
                 return
-            if tag == _ITEM:
+            if kind == _ITEM:
                 STATS.add("depth", -1)
                 if nbytes and self._pool is not None:
-                    self._pool.release(nbytes)
+                    self._pool.release(nbytes, tag=mtag)
 
     def __enter__(self) -> "PrefetchIterator":
         return self
@@ -235,11 +246,18 @@ class PrefetchExec(UnaryExec):
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         label = f"{type(self.child).__name__}#p{partition}"
         it = PrefetchIterator(self.child.execute(partition),
-                              depth=self.depth, label=label)
+                              depth=self.depth, label=label,
+                              mem_site=self._mem_site())
         try:
             yield from it
         finally:
             it.close()
+
+    def _mem_site(self) -> str:
+        """Attribution site for the read-ahead buffers: the child's own
+        site when it declares one (scan-upload for scans, shuffle for
+        exchange/AQE readers), else "other" (e.g. CPU->TPU transitions)."""
+        return getattr(self.child, "mem_site", None) or "other"
 
 
 def prefetch_settings(conf=None):

@@ -219,6 +219,7 @@ class SharedExchangeEntry:
 
     def _try_cache(self, batches: List[ColumnarBatch]):
         from spark_rapids_tpu.mem.spill import SpillableBatch
+        from spark_rapids_tpu.obs import memtrack as _mt
 
         nbytes = sum(b.nbytes() + 4 for b in batches)
         if not self._cache.admit(self, nbytes):
@@ -226,8 +227,12 @@ class SharedExchangeEntry:
         handles: List = []
         try:
             fw = _framework()
-            for b in batches:
-                handles.append(SpillableBatch(b, fw))
+            # cached handles outlive the query by design: the distinct site
+            # exempts them from the query-end leak audit (reported as
+            # retained, not leaked — obs/memtrack.audit_query)
+            with _mt.site("materialization-cache"):
+                for b in batches:
+                    handles.append(SpillableBatch(b, fw))
         except Exception:
             # a capped pool may refuse the handle registration even after
             # spilling — fall back to passthrough, never fail the query
@@ -314,6 +319,8 @@ class ReusedExchangeExec(LeafExec):
     the skew-join planner and the cluster lane touch (``_ensure_written``,
     ``manager``, ``_reg``, ``partitioner``) by delegation to the survivor,
     so every consumer shares one shuffle registration."""
+
+    mem_site = "shuffle"
 
     def __init__(self, target, schema: T.Schema, reuse_id: int, entry=None):
         super().__init__()

@@ -77,6 +77,8 @@ class FileScanBase(LeafExec):
     ``_partition_items``/``_read_item`` instead. The base owns the
     scanTimeNs timer around ``_read_item``."""
 
+    mem_site = "scan-upload"
+
     def __init__(self, paths: Sequence[str],
                  columns: Optional[Sequence[str]] = None,
                  reader_type: str = "MULTITHREADED",

@@ -52,6 +52,8 @@ class SortExec(UnaryExec):
     runs are held spillable, and output batches are produced by boundary
     splitting + merge so no step needs the whole partition in HBM."""
 
+    mem_site = "sort-spill"
+
     def __init__(self, orders: Sequence[SortOrder], child: TpuExec,
                  each_batch: bool = False, out_of_core: bool = False,
                  target_rows: int = 1 << 17, spill_framework=None):

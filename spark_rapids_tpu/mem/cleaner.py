@@ -76,6 +76,13 @@ def sweep() -> List[str]:
                 leaks.append(
                     f"ShuffleManager: shuffle {sid} never cleaned "
                     f"({len(files)} files)")
+    # attributed view of the same leftovers: WHO still holds tracked bytes
+    # (obs/memtrack.py tags); only reported when a pool leak above makes
+    # the sweep non-clean anyway, so attribution noise (e.g. tests driving
+    # the pool directly with mismatched tags) never fails a clean run
+    if any(l.startswith("HbmPool") for l in leaks):
+        from spark_rapids_tpu.obs import memtrack as _mt
+        leaks.extend(_mt.sweep_report())
     return leaks
 
 

@@ -106,41 +106,60 @@ class HbmPool:
     def free(self) -> int:
         return self.limit - self._used
 
-    def allocate(self, nbytes: int) -> None:
-        """Account nbytes; spill then raise RetryOOM if over budget."""
+    def allocate(self, nbytes: int, tag=None):
+        """Account nbytes; spill then raise RetryOOM if over budget.
+
+        Returns the attribution tag memtrack resolved for this allocation
+        (None when tracking is off) — holders of long-lived accounted state
+        (SpillableBatch, prefetch queue entries) store it and hand it back
+        to ``release`` so frees attribute to the allocating operator even
+        when they happen on another thread.
+        """
         # injection site, outside the pool lock so slow/stall rules cannot
         # serialize unrelated allocators
+        from spark_rapids_tpu.obs import memtrack as _mt
         faults.check("mem.alloc", nbytes=nbytes)
         with self._lock:
             self.alloc_count += 1
             if self._injector is not None:
                 self._injector.on_alloc()
-            if self._used + nbytes <= self.limit:
+            fits = self._used + nbytes <= self.limit
+            if fits:
                 self._used += nbytes
                 self.max_used = max(self.max_used, self._used)
-                return
-            needed = self._used + nbytes - self.limit
+            else:
+                needed = self._used + nbytes - self.limit
+        if fits:  # attribution outside the pool lock (memtrack has its own)
+            return _mt.on_alloc(nbytes, tag)
         # spill outside the lock (spill does host/disk I/O)
         freed = 0
         if self._spill_fn is not None:
             self.spill_request_count += 1
             freed = self._spill_fn(needed)
         with self._lock:
-            if self._used + nbytes <= self.limit:
+            fits = self._used + nbytes <= self.limit
+            if fits:
                 self._used += nbytes
                 self.max_used = max(self.max_used, self._used)
-                return
-            self.oom_count += 1
-            from spark_rapids_tpu.utils import task_metrics as TM
-            TM.add("oom_count", 1)
-            raise RetryOOM(
-                f"HBM pool exhausted: need {nbytes}, used {self._used}, "
-                f"limit {self.limit}, spill freed {freed}")
+            else:
+                self.oom_count += 1
+                from spark_rapids_tpu.utils import task_metrics as TM
+                TM.add("oom_count", 1)
+        if fits:
+            return _mt.on_alloc(nbytes, tag)
+        # ranked post-mortem snapshot, rate-limited to one per query (the
+        # RetryOOM below is recoverable by design — mem/retry.py)
+        _mt.on_pool_denied(nbytes, pool=self, freed=freed)
+        raise RetryOOM(
+            f"HBM pool exhausted: need {nbytes}, used {self._used}, "
+            f"limit {self.limit}, spill freed {freed}")
 
-    def release(self, nbytes: int) -> None:
+    def release(self, nbytes: int, tag=None) -> None:
         with self._lock:
             self._used -= nbytes
             assert self._used >= 0, "pool accounting underflow"
+        from spark_rapids_tpu.obs import memtrack as _mt
+        _mt.on_free(nbytes, tag)
 
 
 _default_pool: Optional[HbmPool] = None

@@ -113,11 +113,18 @@ def with_retry(
                     work = list(pieces) + work
                     item = work.pop(0)
                     attempts = 0
-                except RetryOOM:
+                except RetryOOM as oom:
                     oom_seen = True
                     from spark_rapids_tpu.utils import task_metrics as TM
                     TM.add("retry_count", 1)
                     if attempts >= max_attempts:
+                        # terminal: the retry loop is giving up, so this is
+                        # a real failure — always worth a ranked snapshot
+                        # (the pool's own dump is rate-limited per query)
+                        from spark_rapids_tpu.obs import memtrack as _mt
+                        _mt.dump_postmortem(
+                            "retry-exhausted", pool=None,
+                            error=f"{attempts} attempts: {oom}")
                         raise
                     # the pool already spilled what it could; loop retries
                     # the same input (it re-materializes on get())

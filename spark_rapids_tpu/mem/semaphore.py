@@ -74,6 +74,21 @@ class TaskSemaphore:
         with self._cv:
             return task_id in self._holders
 
+    def snapshot(self) -> Dict:
+        """Holder/waiter view for OOM post-mortems (obs/memtrack.py): who
+        was on the device, and who had been waiting how long, when an
+        allocation was denied."""
+        now = time.perf_counter_ns()
+        with self._cv:
+            return {
+                "permits": self._permits,
+                "holders": {tid: n for tid, n in self._holders.items()},
+                "waiters": {tid: round((now - t0) / 1e6, 3)  # ms waited
+                            for tid, t0 in self._waiters.items()},
+                "acquire_count": self.acquire_count,
+                "max_waiters": self.max_waiters,
+            }
+
     class _Ctx:
         def __init__(self, sem: "TaskSemaphore", task_id: int):
             self.sem = sem

@@ -49,7 +49,10 @@ class SpillableBatch:
         self._closed = False
         self._lock = threading.RLock()
         self._mat_lock = threading.Lock()  # serializes concurrent unspills
-        framework._register(self)
+        # attribution tag resolved at registration; spill/unspill/close
+        # re-use it so the bytes stay attributed to the operator that
+        # created the handle, whatever thread moves them later
+        self._mem_tag = framework._register(self)
 
     @property
     def nbytes(self) -> int:
@@ -127,17 +130,18 @@ class SpillFramework:
         pool.set_spill_fn(self.spill_device_bytes)
 
     # -- registration ------------------------------------------------------
-    def _register(self, h: SpillableBatch) -> None:
-        self.pool.allocate(h.nbytes)
+    def _register(self, h: SpillableBatch):
+        tag = self.pool.allocate(h.nbytes)
         with self._lock:
             self._handles.append(h)
+        return tag
 
     def _deregister(self, h: SpillableBatch, state: str) -> None:
         with self._lock:
             if h in self._handles:
                 self._handles.remove(h)
         if state == DEVICE:
-            self.pool.release(h.nbytes)
+            self.pool.release(h.nbytes, tag=h._mem_tag)
         elif state == HOST:
             with self._lock:
                 self.host_used -= h.nbytes
@@ -185,8 +189,10 @@ class SpillFramework:
             h._device = None
             h._host = host
             h._state = HOST
-        self.pool.release(h.nbytes)
+        self.pool.release(h.nbytes, tag=h._mem_tag)
         self.spilled_to_host_count += 1
+        from spark_rapids_tpu.obs import memtrack as _mt
+        _mt.note_spilled(h._mem_tag, h.nbytes)
         from spark_rapids_tpu.utils import task_metrics as TM
         TM.add("spill_to_host_bytes", h.nbytes)
         from spark_rapids_tpu.obs import events as _journal
@@ -261,7 +267,9 @@ class SpillFramework:
                 host = h._host
             # account device bytes BEFORE materializing (may itself spill
             # others; the handle is pinned so it cannot become its own victim)
-            self.pool.allocate(h.nbytes)
+            tag = self.pool.allocate(h.nbytes, tag=h._mem_tag)
+            if h._mem_tag is None:  # tracking enabled after registration
+                h._mem_tag = tag
             cols = []
             for dt, (d, v, o, dinfo, d2) in zip(h._dtypes, host["cols"]):
                 if dinfo is None:

@@ -11,6 +11,8 @@ package is the standalone unification of the repo's fragments:
                     ``explain_analyze`` rendering
 - ``events``        bounded thread-safe lifecycle event journal (JSONL)
 - ``histo``         log-bucketed latency histograms (p50/p95/p99)
+- ``memtrack``      per-query HBM attribution: site/operator watermarks,
+                    OOM post-mortems, query-end leak audit (docs/memory.md)
 - ``health``        worker heartbeat + health registry (merged driver view)
 - ``trace_export``  Chrome trace_event JSON for chrome://tracing / Perfetto,
                     incl. multi-worker merge with per-process tracks
@@ -20,6 +22,7 @@ package is the standalone unification of the repo's fragments:
 See docs/observability.md for the metric catalog and workflows.
 """
 
+from spark_rapids_tpu.obs import memtrack  # noqa: F401
 from spark_rapids_tpu.obs.gauges import snapshot as gauge_snapshot  # noqa: F401
 from spark_rapids_tpu.obs.profile import (  # noqa: F401
     QueryProfile,

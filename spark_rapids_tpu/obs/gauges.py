@@ -82,6 +82,28 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "Workers flagged stalled by the health registry (no task progress)"),
     ("worker_lost_total", "counter",
      "Workers removed from the health registry as dead/lost"),
+    ("mem_tracked_live_bytes", "gauge",
+     "Attributed live pool bytes (obs/memtrack.py tags)"),
+    ("mem_tracked_peak_bytes", "gauge",
+     "High-water mark of attributed pool bytes"),
+    ("mem_site_scan_upload_peak_bytes", "gauge",
+     "Peak attributed bytes at the scan-upload site"),
+    ("mem_site_shuffle_peak_bytes", "gauge",
+     "Peak attributed bytes at the shuffle site"),
+    ("mem_site_agg_state_peak_bytes", "gauge",
+     "Peak attributed bytes at the agg-state site"),
+    ("mem_site_broadcast_peak_bytes", "gauge",
+     "Peak attributed bytes at the broadcast site"),
+    ("mem_site_materialization_cache_peak_bytes", "gauge",
+     "Peak attributed bytes held by the materialization cache"),
+    ("mem_site_sort_spill_peak_bytes", "gauge",
+     "Peak attributed bytes at the out-of-core sort site"),
+    ("mem_site_other_peak_bytes", "gauge",
+     "Peak attributed bytes with no declared site"),
+    ("oom_postmortem_total", "counter",
+     "OOM post-mortem snapshots written (docs/memory.md)"),
+    ("mem_leaked_bytes_total", "counter",
+     "Bytes still attributed to a query at its leak audit"),
 ]
 
 
@@ -136,6 +158,8 @@ def snapshot() -> Dict[str, int]:
     out.update(_ev.counters())
     from spark_rapids_tpu.obs import health as _health
     out.update(_health.counters())
+    from spark_rapids_tpu.obs import memtrack as _mt
+    out.update(_mt.counters())
     return out
 
 

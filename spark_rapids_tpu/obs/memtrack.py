@@ -1,0 +1,578 @@
+"""Per-query HBM attribution, watermark timelines, OOM post-mortems, and
+the query-end leak audit.
+
+The reference treats memory as a first-class observable: GpuTaskMetrics
+attaches device/host watermarks to every task (GpuTaskMetrics.scala:185-311),
+DeviceMemoryEventHandler tracks OOM-retry escalation state, and the jni
+MemoryCleaner runs a refcount leak check at shutdown (Plugin.scala:575-590).
+This module is the standalone unification over the HBM accounting pool
+(mem/pool.py):
+
+- **Attribution**: every pool allocation resolves a tag
+  ``(query_id, operator, site)`` from ambient context — a process-global
+  current query (the engine runs one query at a time), a thread-local
+  operator name pushed by ``exec/base.TpuExec.execute`` around each batch
+  pull, and a thread-local *site* (one of ``SITES``) pushed by the code
+  that creates spillable state. Workers that allocate off-thread (prefetch,
+  spill handles) carry an explicit tag instead. Disabled, the hook is one
+  module-flag read.
+- **Timelines**: per-site live bytes are sampled (rate-limited) into a
+  bounded ring, the lifecycle journal (``mem-sample`` events), and — while
+  a trace-capture window is open — Chrome counter tracks (``ph:"C"``).
+- **OOM post-mortem**: when the pool denies an allocation after spilling,
+  or ``with_retry`` exhausts its attempts, ``dump_postmortem`` writes a
+  ranked snapshot of live allocations by tag, spill-framework state,
+  semaphore holders, and recent retry/split history to
+  ``<dir>/oom_postmortem_*.json`` (journal event +
+  ``srtpu_oom_postmortem_total``) — the durable core-dump-for-postmortem
+  analog (see also utils/core_dump.py for the device-state flavor).
+- **Leak audit** (MemoryCleaner analog): at query end every allocation
+  tagged to that query must be freed; MaterializationCache entries are
+  exempt while cached (they outlive queries by design, reported as
+  *retained*). Leaks feed ``srtpu_mem_leaked_bytes_total`` + a
+  ``leak-audit`` journal event, and raise under the strict test-lane flag.
+
+See docs/memory.md for the attribution model and how to read a post-mortem.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Deque, Dict, List, Optional, Tuple
+
+# Canonical allocation sites. Arbitrary strings are accepted (folded into
+# the per-tag stats) but only these get per-site Prometheus peak gauges —
+# the catalog (obs/gauges.py) must stay a static literal.
+SITES = ("scan-upload", "shuffle", "agg-state", "broadcast",
+         "materialization-cache", "sort-spill", "other")
+
+_SITE_GAUGE = {s: "mem_site_" + s.replace("-", "_") + "_peak_bytes"
+               for s in SITES}
+
+# tag = (query_id | None, operator name, site)
+Tag = Tuple[Optional[int], str, str]
+
+_STAT_FIELDS = ("live", "peak", "allocd", "freed", "spilled")
+
+SAMPLE_MIN_GAP_NS = 25_000_000       # ring/trace sample floor: 25 ms
+JOURNAL_MIN_GAP_NS = 250_000_000     # mem-sample journal floor: 250 ms
+MAX_SAMPLES = 4096
+POSTMORTEM_TOP_N = 50
+
+_enabled = True
+_lock = threading.Lock()
+_tls = threading.local()
+
+# Single-query engine (ROADMAP #1 is the multi-query scheduler this layer
+# is the prerequisite for): the current query id is process-global, so
+# worker threads spawned mid-query inherit it without plumbing.
+_current_query: Optional[int] = None
+
+_stats: "Dict[Tag, Dict[str, int]]" = {}
+_site_live: Dict[str, int] = {}
+_site_peak: Dict[str, int] = {}
+_total_live = 0
+_total_peak = 0
+_query_live: Dict[Optional[int], int] = {}
+_query_peak: Dict[Optional[int], int] = {}
+
+_counters = {
+    "oom_postmortem_total": 0,
+    "mem_leaked_bytes_total": 0,
+}
+
+_samples: "Deque[Dict]" = collections.deque(maxlen=MAX_SAMPLES)
+_last_sample_ns = 0
+_last_journal_ns = 0
+
+# post-mortem / leak-audit knobs (configure() refreshes from the conf)
+_pm_enabled = True
+_pm_dir = "artifacts"
+_pm_paths: List[str] = []          # files written by THIS process
+_pm_seen_queries: set = set()      # pool-denied rate limit: one per query
+_audit_enabled = True
+_audit_strict = False
+
+
+class MemoryLeakError(AssertionError):
+    """Strict-lane leak audit failure: a query finished with live
+    allocations still attributed to it."""
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+
+def configure(conf=None) -> None:
+    """Refresh module switches from the (active) conf — called by
+    Overrides.apply alongside the journal/histogram/fault plumbing."""
+    global _enabled, _pm_enabled, _pm_dir, _audit_enabled, _audit_strict
+    from spark_rapids_tpu.config import conf as C
+    if conf is None:
+        conf = C.get_active()
+    _enabled = bool(C.MEM_TRACK_ENABLED.get(conf))
+    _pm_enabled = bool(C.MEM_POSTMORTEM_ENABLED.get(conf))
+    _pm_dir = str(C.MEM_POSTMORTEM_DIR.get(conf))
+    _audit_enabled = bool(C.MEM_LEAK_AUDIT_ENABLED.get(conf))
+    _audit_strict = bool(C.MEM_LEAK_AUDIT_STRICT.get(conf))
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Drop all attribution state (tests). Counters persist — they are
+    process totals, like every other srtpu counter."""
+    global _total_live, _total_peak, _current_query
+    global _last_sample_ns, _last_journal_ns
+    with _lock:
+        _stats.clear()
+        _site_live.clear()
+        _site_peak.clear()
+        _query_live.clear()
+        _query_peak.clear()
+        _samples.clear()
+        _total_live = 0
+        _total_peak = 0
+        _current_query = None
+        _last_sample_ns = 0
+        _last_journal_ns = 0
+    _pm_seen_queries.clear()
+
+
+# ---------------------------------------------------------------------------
+# ambient context (who is allocating)
+# ---------------------------------------------------------------------------
+
+
+def begin_query(query_id: Optional[int]) -> None:
+    global _current_query
+    _current_query = query_id
+
+
+def end_query(query_id: Optional[int]) -> None:
+    global _current_query
+    if _current_query == query_id:
+        _current_query = None
+
+
+def current_query() -> Optional[int]:
+    return _current_query
+
+
+def push_op(op: str, site: Optional[str] = None):
+    """Set the thread's (operator, site) context; returns the token
+    ``pop_op`` restores. One attribute write when tracking is off."""
+    if not _enabled:
+        return None
+    d = _tls.__dict__
+    prev = (d.get("op"), d.get("site"))
+    d["op"] = op
+    if site is not None:
+        d["site"] = site
+    return prev
+
+
+def pop_op(token) -> None:
+    if token is None:
+        return
+    _tls.op, _tls.site = token
+
+
+@contextmanager
+def site(name: str):
+    """Scoped site override for allocation-creating code (e.g. the
+    materialization cache wraps handle registration in
+    ``site("materialization-cache")``)."""
+    if not _enabled:
+        yield
+        return
+    d = _tls.__dict__
+    prev = d.get("site")
+    d["site"] = name
+    try:
+        yield
+    finally:
+        d["site"] = prev
+
+
+def make_tag(site_name: str = "other", op: Optional[str] = None) -> Tag:
+    """Explicit tag for off-thread allocators (prefetch workers) that
+    cannot rely on the consumer's thread-local context."""
+    d = _tls.__dict__
+    return (_current_query, op or d.get("op") or "?", site_name)
+
+
+def _resolve_tag() -> Tag:
+    d = _tls.__dict__
+    return (_current_query, d.get("op") or "?", d.get("site") or "other")
+
+
+# ---------------------------------------------------------------------------
+# accounting hooks (mem/pool.py calls these)
+# ---------------------------------------------------------------------------
+
+
+def on_alloc(nbytes: int, tag: Optional[Tag] = None) -> Optional[Tag]:
+    """Attribute a successful pool allocation; returns the resolved tag
+    (the caller stores it and hands it back to ``on_free``)."""
+    if not _enabled:
+        return None
+    if tag is None:
+        tag = _resolve_tag()
+    global _total_live, _total_peak
+    with _lock:
+        st = _stats.get(tag)
+        if st is None:
+            st = _stats[tag] = dict.fromkeys(_STAT_FIELDS, 0)
+        st["live"] += nbytes
+        st["allocd"] += nbytes
+        if st["live"] > st["peak"]:
+            st["peak"] = st["live"]
+        s = tag[2]
+        sl = _site_live.get(s, 0) + nbytes
+        _site_live[s] = sl
+        if sl > _site_peak.get(s, 0):
+            _site_peak[s] = sl
+        _total_live += nbytes
+        if _total_live > _total_peak:
+            _total_peak = _total_live
+        q = tag[0]
+        ql = _query_live.get(q, 0) + nbytes
+        _query_live[q] = ql
+        if ql > _query_peak.get(q, 0):
+            _query_peak[q] = ql
+    _maybe_sample()
+    return tag
+
+
+def on_free(nbytes: int, tag: Optional[Tag] = None) -> None:
+    if not _enabled:
+        return
+    if tag is None:
+        tag = _resolve_tag()
+    global _total_live
+    with _lock:
+        st = _stats.get(tag)
+        if st is None:
+            st = _stats[tag] = dict.fromkeys(_STAT_FIELDS, 0)
+        st["live"] -= nbytes
+        st["freed"] += nbytes
+        s = tag[2]
+        _site_live[s] = _site_live.get(s, 0) - nbytes
+        _total_live -= nbytes
+        q = tag[0]
+        _query_live[q] = _query_live.get(q, 0) - nbytes
+
+
+def note_spilled(tag: Optional[Tag], nbytes: int) -> None:
+    """A tagged allocation left the device tier (mem/spill.py). Pool bytes
+    are released separately via ``on_free``; this keeps the per-tag spill
+    tally for profiles and post-mortems."""
+    if not _enabled or tag is None:
+        return
+    with _lock:
+        st = _stats.get(tag)
+        if st is None:
+            st = _stats[tag] = dict.fromkeys(_STAT_FIELDS, 0)
+        st["spilled"] += nbytes
+
+
+# ---------------------------------------------------------------------------
+# timelines
+# ---------------------------------------------------------------------------
+
+
+def _maybe_sample() -> None:
+    """Rate-limited watermark sample: ring + Chrome counter track always
+    (when due), journal event on the slower floor."""
+    global _last_sample_ns, _last_journal_ns
+    now = time.perf_counter_ns()
+    if now - _last_sample_ns < SAMPLE_MIN_GAP_NS:
+        return
+    with _lock:
+        if now - _last_sample_ns < SAMPLE_MIN_GAP_NS:
+            return
+        _last_sample_ns = now
+        total = _total_live
+        sites = {s: v for s, v in _site_live.items() if v}
+        sample = {"t_ns": now, "ts": time.time(), "total_bytes": total,
+                  "sites": dict(sites)}
+        _samples.append(sample)
+        journal_due = now - _last_journal_ns >= JOURNAL_MIN_GAP_NS
+        if journal_due:
+            _last_journal_ns = now
+    from spark_rapids_tpu.utils import tracing
+    tracing.record_counter("mem:tracked_bytes",
+                           {"total": total, **sites}, ts_ns=now)
+    if journal_due:
+        from spark_rapids_tpu.obs import events as _ev
+        _ev.emit("mem-sample", query_id=_current_query,
+                 total_bytes=total, sites=sites)
+
+
+def timeline() -> List[Dict]:
+    """The bounded watermark sample ring, oldest first."""
+    with _lock:
+        return list(_samples)
+
+
+# ---------------------------------------------------------------------------
+# views
+# ---------------------------------------------------------------------------
+
+
+def _tag_rows(stats: Dict[Tag, Dict[str, int]]) -> List[Dict]:
+    rows = []
+    for (qid, op, site_name), st in stats.items():
+        rows.append({"query_id": qid, "op": op, "site": site_name, **st})
+    return rows
+
+
+def live_by_tag() -> List[Dict]:
+    """Live allocations by tag, largest first (post-mortem ranking)."""
+    with _lock:
+        rows = _tag_rows({t: dict(s) for t, s in _stats.items()})
+    rows.sort(key=lambda r: r["live"], reverse=True)
+    return rows
+
+
+def _group(rows: List[Dict], key: str) -> Dict[str, Dict[str, int]]:
+    out: Dict[str, Dict[str, int]] = {}
+    for r in rows:
+        g = out.setdefault(str(r[key]), dict.fromkeys(_STAT_FIELDS, 0))
+        for f in _STAT_FIELDS:
+            g[f] += r[f]
+    return out
+
+
+def query_summary(query_id: Optional[int]) -> Dict:
+    """Per-query memory section for QueryProfile: peaks, and per-site /
+    per-op aggregates of this query's tags. Per-group ``peak`` sums tag
+    peaks, an upper bound on the group's true concurrent peak."""
+    with _lock:
+        rows = _tag_rows({t: dict(s) for t, s in _stats.items()
+                          if t[0] == query_id})
+        peak = _query_peak.get(query_id, 0)
+        live = _query_live.get(query_id, 0)
+    return {
+        "query_id": query_id,
+        "tracked_peak_bytes": peak,
+        "live_bytes": live,
+        "sites": _group(rows, "site"),
+        "ops": _group(rows, "op"),
+    }
+
+
+def process_summary() -> Dict:
+    """Whole-process view (tools/obs_report.py memory.json)."""
+    with _lock:
+        rows = _tag_rows({t: dict(s) for t, s in _stats.items()})
+        out = {
+            "tracked_live_bytes": _total_live,
+            "tracked_peak_bytes": _total_peak,
+            "site_peaks": dict(_site_peak),
+            "counters": dict(_counters),
+        }
+    out["sites"] = _group(rows, "site")
+    out["ops"] = _group(rows, "op")
+    return out
+
+
+def counters() -> Dict[str, int]:
+    """Catalog-declared gauges/counters for obs/gauges.snapshot()."""
+    with _lock:
+        out = {
+            "mem_tracked_live_bytes": max(0, _total_live),
+            "mem_tracked_peak_bytes": _total_peak,
+            "oom_postmortem_total": _counters["oom_postmortem_total"],
+            "mem_leaked_bytes_total": _counters["mem_leaked_bytes_total"],
+        }
+        for s, gauge in _SITE_GAUGE.items():
+            out[gauge] = _site_peak.get(s, 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# OOM post-mortem
+# ---------------------------------------------------------------------------
+
+
+def _spill_states() -> List[Dict]:
+    from spark_rapids_tpu.mem import cleaner as _cleaner
+    with _cleaner._lock:
+        fws = list(_cleaner._frameworks)
+    out = []
+    for fw in fws:
+        try:
+            handles = list(getattr(fw, "_handles", ()))
+            by_state: Dict[str, Dict[str, int]] = {}
+            for h in handles:
+                b = by_state.setdefault(h.state, {"count": 0, "bytes": 0})
+                b["count"] += 1
+                b["bytes"] += h.nbytes
+            out.append({"handles": len(handles), "by_state": by_state,
+                        "host_used": getattr(fw, "host_used", 0),
+                        "spilled_to_host": fw.spilled_to_host_count,
+                        "spilled_to_disk": fw.spilled_to_disk_count,
+                        "unspilled": fw.unspilled_count})
+        except Exception as ex:
+            out.append({"error": repr(ex)})
+    return out
+
+
+def _pool_states(pool=None) -> List[Dict]:
+    from spark_rapids_tpu.mem import cleaner as _cleaner
+    with _cleaner._lock:
+        pools = list(_cleaner._pools)
+    if pool is not None and pool not in pools:
+        pools.append(pool)
+    return [{"limit": p.limit, "used": p.used, "max_used": p.max_used,
+             "alloc_count": p.alloc_count, "oom_count": p.oom_count,
+             "spill_request_count": p.spill_request_count} for p in pools]
+
+
+def dump_postmortem(reason: str, requested_bytes: int = 0,
+                    pool=None, error: Optional[str] = None,
+                    out_dir: Optional[str] = None) -> Optional[str]:
+    """Write the ranked OOM snapshot; returns the path (None when the
+    post-mortem sink is disabled)."""
+    if not _pm_enabled:
+        return None
+    from spark_rapids_tpu.mem import semaphore as _sem
+    from spark_rapids_tpu.obs import events as _ev
+    from spark_rapids_tpu.utils import task_metrics as TM
+
+    ranked = live_by_tag()[:POSTMORTEM_TOP_N]
+    with _lock:
+        site_summary = {"live": dict(_site_live), "peak": dict(_site_peak)}
+        total_live, total_peak = _total_live, _total_peak
+    tm = TM.aggregate_snapshot()
+    retry_history = {k: tm.get(k, 0) for k in (
+        "retry_count", "split_and_retry_count", "oom_count",
+        "spill_to_host_bytes", "spill_to_disk_bytes", "read_spill_bytes",
+        "semaphore_wait_ns")}
+    snap = {
+        "reason": reason,
+        "ts": time.time(),
+        "query_id": _current_query,
+        "requested_bytes": requested_bytes,
+        "error": error,
+        "tracked": {"live_bytes": total_live, "peak_bytes": total_peak,
+                    "sites": site_summary},
+        "top_consumer": ranked[0] if ranked else None,
+        "live_allocations": ranked,
+        "pools": _pool_states(pool),
+        "spill": _spill_states(),
+        "semaphores": [s.snapshot() for s in _sem.instances()],
+        "retry_history": retry_history,
+        "journal_tail": _ev.recent(limit=120),
+    }
+    d = out_dir or _pm_dir
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"oom_postmortem_{int(time.time() * 1000)}.json")
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=1, default=str)
+    with _lock:
+        _counters["oom_postmortem_total"] += 1
+        _pm_paths.append(path)
+    top = ranked[0] if ranked else None
+    _ev.emit("oom-postmortem", query_id=_current_query, reason=reason,
+             path=path, requested_bytes=requested_bytes,
+             top_consumer=(f"{top['op']}@{top['site']}={top['live']}"
+                           if top else None))
+    return path
+
+
+def on_pool_denied(nbytes: int, pool=None, freed: int = 0) -> None:
+    """Pool exhausted even after spilling: dump a post-mortem, rate-limited
+    to one per query — a RetryOOM is *recoverable by design* and a capped
+    pool can throw thousands per run."""
+    if not _enabled or not _pm_enabled:
+        return
+    q = _current_query
+    with _lock:
+        if q in _pm_seen_queries:
+            return
+        _pm_seen_queries.add(q)
+    dump_postmortem("pool-denied", requested_bytes=nbytes, pool=pool,
+                    error=f"spill freed {freed} of {nbytes} needed")
+
+
+def postmortem_paths() -> List[str]:
+    with _lock:
+        return list(_pm_paths)
+
+
+# ---------------------------------------------------------------------------
+# query-end leak audit (MemoryCleaner analog)
+# ---------------------------------------------------------------------------
+
+
+def audit_query(query_id: Optional[int], had_error: bool = False,
+                strict: Optional[bool] = None) -> Dict:
+    """Assert every allocation tagged to ``query_id`` was freed.
+
+    MaterializationCache entries are exempt while cached — they outlive the
+    query by design (exec/reuse.py) and are reported as ``retained_bytes``.
+    Leaked bytes feed ``srtpu_mem_leaked_bytes_total`` and a ``leak-audit``
+    journal event; under strict mode (the test lane flag) a leak on an
+    otherwise-successful query raises ``MemoryLeakError`` — raising over an
+    in-flight exception would mask the real failure."""
+    if not _enabled or not _audit_enabled:
+        return {"skipped": True}
+    strict = _audit_strict if strict is None else strict
+    with _lock:
+        rows = _tag_rows({t: dict(s) for t, s in _stats.items()
+                          if t[0] == query_id and s["live"] > 0})
+    retained = [r for r in rows if r["site"] == "materialization-cache"]
+    leaks = [r for r in rows if r["site"] != "materialization-cache"]
+    leaked_bytes = sum(r["live"] for r in leaks)
+    retained_bytes = sum(r["live"] for r in retained)
+    if leaked_bytes > 0:
+        with _lock:
+            _counters["mem_leaked_bytes_total"] += leaked_bytes
+    # journal only findings: a clean audit stays silent so "finish" remains
+    # the last journal event of a healthy query
+    if leaked_bytes > 0 or retained_bytes > 0:
+        from spark_rapids_tpu.obs import events as _ev
+        _ev.emit("leak-audit", query_id=query_id, leaked_bytes=leaked_bytes,
+                 retained_bytes=retained_bytes,
+                 leaks=[{"op": r["op"], "site": r["site"], "bytes": r["live"]}
+                        for r in leaks[:10]])
+    report = {
+        "query_id": query_id,
+        "leaked_bytes": leaked_bytes,
+        "retained_bytes": retained_bytes,
+        "leaks": leaks,
+        "retained": retained,
+    }
+    if strict and leaked_bytes > 0 and not had_error:
+        raise MemoryLeakError(
+            f"query {query_id} leaked {leaked_bytes} tracked bytes: "
+            + "; ".join(f"{r['op']}@{r['site']}={r['live']}"
+                        for r in leaks[:5]))
+    return report
+
+
+def sweep_report() -> List[str]:
+    """Process-shutdown leftovers for mem/cleaner.sweep(): tags whose live
+    bytes never returned to zero (materialization-cache retention included:
+    by shutdown the straggler release has already run)."""
+    if not _enabled:
+        return []
+    return [f"memtrack: {r['op']}@{r['site']} (query {r['query_id']}) "
+            f"holds {r['live']} bytes"
+            for r in live_by_tag() if r["live"] > 0]

@@ -67,6 +67,7 @@ class QueryProfile:
         self.metrics: Dict[str, int] = {}
         self.gauges: Dict[str, Dict] = {}
         self.task_metrics: Dict[str, int] = {}
+        self.memory: Dict = {}
         self.events: List[Dict] = []
         self._t0 = 0
         self._gauges0: Dict[str, int] = {}
@@ -126,6 +127,13 @@ class QueryProfile:
             tracing.set_capture(False)
             self._owned_capture = False
         self.events = tracing.trace_events()
+        # per-query HBM attribution (obs/memtrack.py): peaks and per-site/
+        # per-op aggregates of allocations tagged to this query. Updated in
+        # place so a later leak_audit entry (plan/dataframe.py) survives a
+        # re-finish.
+        from spark_rapids_tpu.obs import memtrack as _mt
+        if _mt.enabled():
+            self.memory.update(_mt.query_summary(self.query_id))
         if root is not None:
             self.nodes = collect_node_stats(root)
             self.metrics = root.collect_metrics()
@@ -152,6 +160,7 @@ class QueryProfile:
             "metrics": self.metrics,
             "gauges": self.gauges,
             "task_metrics": self.task_metrics,
+            "memory": self.memory,
             "num_trace_events": len(self.events),
             "plan_explain": self.plan_explain,
         }
@@ -173,6 +182,15 @@ class QueryProfile:
             cells += [f"{p}={v}ms" for p, v in sorted(self.phases.items())
                       if p not in order]
             lines.append(f"phases: {' '.join(cells)}")
+        if self.memory.get("tracked_peak_bytes"):
+            audit = self.memory.get("leak_audit", {})
+            mem_cells = [f"peak={self.memory['tracked_peak_bytes']}B"]
+            if audit:
+                mem_cells.append(f"leaked={audit.get('leaked_bytes', 0)}B")
+                if audit.get("retained_bytes"):
+                    mem_cells.append(f"retained={audit['retained_bytes']}B")
+            lines.append(f"memory: {' '.join(mem_cells)}")
+        mem_ops = self.memory.get("ops", {})
         for node in self.nodes:
             pad = "  " * node["depth"]
             prefix = "+- " if node["depth"] else ""
@@ -194,6 +212,14 @@ class QueryProfile:
             lines.append(f"{pad}{prefix}{node['description']}  "
                          f"[{' '.join(cells)}]" if cells else
                          f"{pad}{prefix}{node['description']}")
+            # per-operator HBM line, only for operators that actually
+            # touched the pool — most demo queries never allocate, so the
+            # tree shape (and line-offset expectations) stays unchanged
+            ms = mem_ops.get(node["name"])
+            if ms and (ms.get("peak") or ms.get("allocd")):
+                lines.append(f"{pad}   mem: peak={ms['peak']}B "
+                             f"alloc={ms['allocd']}B "
+                             f"spilled={ms['spilled']}B")
         return "\n".join(lines)
 
     def chrome_trace(self) -> Dict:

@@ -37,6 +37,21 @@ def events_to_chrome(events: Iterable[Dict],
     base = min(e["start_ns"] for e in evs) if base_ns is None else base_ns
     tids: Dict[int, int] = {}
     for e in evs:
+        if e.get("counter"):
+            # counter sample (utils/tracing.record_counter): one pid-level
+            # stacked-area track per name; args values are the series
+            args = {k: v for k, v in (e.get("args") or {}).items()
+                    if isinstance(v, (int, float))}
+            out.append({
+                "ph": "C",
+                "name": str(e["name"]),
+                "cat": "counter",
+                "pid": pid,
+                "tid": 0,
+                "ts": max(0.0, (e["start_ns"] - base) / 1e3),
+                "args": args,
+            })
+            continue
         thread = e.get("thread", 0)
         if thread not in tids:
             tids[thread] = len(tids) + 1

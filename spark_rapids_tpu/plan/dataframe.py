@@ -213,11 +213,20 @@ class DataFrame:
 
     def _execute_plan(self, node) -> pa.Table:
         from spark_rapids_tpu.columnar.batch import batch_to_arrow
+        from spark_rapids_tpu.obs import memtrack as _mt
+        from spark_rapids_tpu.obs import profile_for
         from spark_rapids_tpu.plan.cpu import CpuExec
         from spark_rapids_tpu.shuffle import ShuffleExchangeExec
 
         schema = node.output_schema
         tables = []
+        prof = profile_for(node)
+        qid = prof.query_id if prof is not None else None
+        # allocations from here to the end of the finally block attribute
+        # to this query (process-global: the engine runs one query at a
+        # time); the leak audit at the end settles the account
+        _mt.begin_query(qid)
+        had_error = True
         try:
             if isinstance(node, CpuExec):
                 for p in range(node.num_partitions()):
@@ -226,12 +235,10 @@ class DataFrame:
                 for p in range(node.num_partitions()):
                     for b in node.execute(p):
                         tables.append(batch_to_arrow(b, schema))
+            had_error = False
         finally:
             # close out the per-query profile (plan/overrides.py installed
             # it at plan time) before shuffle state is released
-            from spark_rapids_tpu.obs import profile_for
-
-            prof = profile_for(node)
             if prof is not None:
                 prof.finish(node)
             self._last_profile = prof
@@ -242,10 +249,31 @@ class DataFrame:
             def walk(n):
                 if isinstance(n, (ShuffleExchangeExec, ReusedExchangeExec)):
                     n.cleanup()
+                # a fused stage's constituents are not structural children,
+                # but an absorbed join's build subtree hangs off the
+                # constituent (exec/fused.py) and can contain exchanges
+                # whose files would otherwise never be released
+                for op in getattr(n, "fused_ops", ()):
+                    if len(op.children) == 2:
+                        walk(op.children[1])
                 for c in n.children:
                     walk(c)
 
             walk(node)
+
+            # query-end leak audit (MemoryCleaner analog): everything this
+            # query allocated must be freed by now — cached materialization
+            # entries are exempt (retained by design). Runs AFTER the
+            # cleanup walk so legitimate releases have happened.
+            try:
+                audit = _mt.audit_query(qid, had_error=had_error)
+                if prof is not None and not audit.get("skipped"):
+                    prof.memory["leak_audit"] = {
+                        "leaked_bytes": audit["leaked_bytes"],
+                        "retained_bytes": audit["retained_bytes"],
+                    }
+            finally:
+                _mt.end_query(qid)
         if not tables:
             return schema.to_arrow().empty_table()
         return pa.concat_tables(tables)

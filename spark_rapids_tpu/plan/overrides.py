@@ -602,6 +602,8 @@ class Overrides:
         _journal.set_enabled(self.conf[C.METRICS_JOURNAL_ENABLED])
         _journal.set_capacity(self.conf[C.METRICS_JOURNAL_CAPACITY])
         _histo.set_enabled(self.conf[C.METRICS_HISTOGRAM_ENABLED])
+        from spark_rapids_tpu.obs import memtrack as _mt
+        _mt.configure(self.conf)
         prof = None
         if self.conf[C.PROFILE_ENABLED]:
             # per-query profile created up front so the planning phases

@@ -157,6 +157,8 @@ class AQEShuffleReadExec(UnaryExec):
     joins) may inject the specs instead.
     """
 
+    mem_site = "shuffle"
+
     def __init__(self, exchange: ShuffleExchangeExec,
                  conf: Optional[C.RapidsConf] = None,
                  target_batch_rows: int = 1 << 20):

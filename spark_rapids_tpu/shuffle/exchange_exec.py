@@ -26,6 +26,8 @@ from spark_rapids_tpu.utils import tracing
 
 
 class ShuffleExchangeExec(UnaryExec):
+    mem_site = "shuffle"
+
     def __init__(self, partitioner: Partitioner, child: TpuExec,
                  manager: Optional[ShuffleManager] = None,
                  target_batch_rows: int = None):

@@ -86,6 +86,28 @@ def record_event(name: str, start_ns: int, dur_ns: int,
         _events.append(ev)
 
 
+def record_counter(name: str, values: Dict,
+                   ts_ns: Optional[int] = None) -> None:
+    """Append one counter sample if a capture window is open (the Chrome
+    exporter renders it as a 'ph: C' counter track — obs/memtrack.py uses
+    this for memory watermark timelines)."""
+    with _events_lock:
+        if not _capture_events:
+            return
+        ev = {
+            "name": name,
+            "start_ns": ts_ns if ts_ns is not None
+            else time.perf_counter_ns(),
+            "dur_ns": 0,
+            "thread": threading.get_ident(),
+            "counter": True,
+            "args": {k: v for k, v in values.items()},
+        }
+        if _process_label is not None:
+            ev["args"].setdefault("worker", _process_label)
+        _events.append(ev)
+
+
 class TraceRange:
     """NvtxRange analog: annotates the jax profiler timeline and (during a
     Profiler window or when event capture is on) records an event."""

@@ -21,7 +21,7 @@ SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}
 OBS_OUT="${TMPDIR:-/tmp}/srtpu_obs_report_smoke"
 python tools/obs_report.py --demo --out "$OBS_OUT"
 for f in profiles.json journal.jsonl metrics.prom trace.json config.json \
-         health.json MANIFEST.json; do
+         health.json memory.json memory.txt MANIFEST.json; do
     test -s "$OBS_OUT/$f" || { echo "obs_report smoke: missing $f" >&2; exit 1; }
 done
 echo "obs_report smoke OK: $OBS_OUT"

@@ -1,0 +1,541 @@
+"""Memory observability suite (obs/memtrack.py + mem/* hooks).
+
+Fast-lane sections: attribution tag resolution from ambient context
+(query/operator/site), balanced accounting under concurrent writers on a
+capped pool (including the pool-denied path), the retry-exhausted and
+pool-denied OOM post-mortems (file exists, parses, names the top
+consumer, rate-limited per query), the query-end leak audit
+(negative/positive, MaterializationCache retention exemption, strict-lane
+raise semantics), the disabled-tracking no-op contract, the gauge-catalog
+surface, the DataFrame-level memory section + clean audit, and the
+satellite fix that a query raising mid-execute still drains the shared
+exchange materialization cache — including exchanges reachable only
+through a fused stage's absorbed build subtree.
+
+Chaos lane (``SRTPU_CHAOS_LANE=1``, tests/run_chaos_lane.sh): spill and
+retry activity driven by a capped pool must reconcile with the journal
+and task-metrics views — per-tag spilled bytes equal the task-metric
+spill deltas, and every post-mortem counter tick has a matching
+``oom-postmortem`` journal event.
+"""
+
+import json
+import os
+import threading
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.columnar.batch import batch_from_arrow
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.mem.pool import HbmPool, OomInjector, RetryOOM
+from spark_rapids_tpu.mem.retry import with_retry
+from spark_rapids_tpu.mem.spill import SpillableBatch, SpillFramework
+from spark_rapids_tpu.obs import events as journal
+from spark_rapids_tpu.obs import memtrack as mt
+from spark_rapids_tpu.plan.dataframe import from_arrow
+
+CHAOS_LANE = os.environ.get("SRTPU_CHAOS_LANE") == "1"
+
+chaos = pytest.mark.skipif(
+    not CHAOS_LANE, reason="chaos lane; run tests/run_chaos_lane.sh")
+
+
+@pytest.fixture(autouse=True)
+def _clean_memtrack(tmp_path, monkeypatch):
+    """Fresh attribution state per test; post-mortems land in tmp_path so
+    no test writes into the repo's artifacts/ directory."""
+    mt.reset()
+    mt.set_enabled(True)
+    monkeypatch.setattr(mt, "_pm_dir", str(tmp_path / "pm"))
+    monkeypatch.setattr(mt, "_pm_paths", [])
+    yield
+    faults.reset()
+    mt.reset()
+    mt.set_enabled(True)
+
+
+# -- attribution ------------------------------------------------------------
+
+
+def test_attribution_resolves_ambient_context():
+    pool = HbmPool(1 << 20)
+    mt.begin_query(7)
+    tok = mt.push_op("ScanExec", "scan-upload")
+    try:
+        tag = pool.allocate(1000)
+        assert tag == (7, "ScanExec", "scan-upload")
+        with mt.site("agg-state"):
+            tag2 = pool.allocate(500)
+        assert tag2 == (7, "ScanExec", "agg-state")
+        pool.release(1000, tag=tag)
+        pool.release(500, tag=tag2)
+    finally:
+        mt.pop_op(tok)
+        mt.end_query(7)
+    s = mt.query_summary(7)
+    assert s["tracked_peak_bytes"] == 1500
+    assert s["live_bytes"] == 0
+    assert s["sites"]["scan-upload"] == {
+        "live": 0, "peak": 1000, "allocd": 1000, "freed": 1000, "spilled": 0}
+    assert s["ops"]["ScanExec"]["allocd"] == 1500
+    assert pool.used == 0
+
+
+def test_make_tag_for_off_thread_allocators():
+    mt.begin_query(8)
+    tok = mt.push_op("PrefetchExec")
+    try:
+        tag = mt.make_tag("shuffle", op="ShuffleExchangeExec")
+        assert tag == (8, "ShuffleExchangeExec", "shuffle")
+        # op defaults to the thread's current operator
+        assert mt.make_tag("other") == (8, "PrefetchExec", "other")
+    finally:
+        mt.pop_op(tok)
+        mt.end_query(8)
+
+
+def test_concurrent_writers_on_capped_pool_balance():
+    """Eight writer threads churn a pool capped tight enough that denials
+    happen; per-tag accounting must still balance exactly, and the tracked
+    watermark must agree with the pool's own high-water mark to within the
+    in-flight window (attribution happens outside the pool lock)."""
+    N, PER, NB = 8, 200, 2048
+    pool = HbmPool(N * NB // 2)  # half the worst-case concurrent demand
+    mt.begin_query(11)
+    errs = []
+
+    def worker(i):
+        tok = mt.push_op(f"Writer{i}", "shuffle")
+        try:
+            for _ in range(PER):
+                for _attempt in range(100):
+                    try:
+                        tag = pool.allocate(NB)
+                        break
+                    except RetryOOM:
+                        continue
+                else:
+                    raise RuntimeError("allocation never admitted")
+                pool.release(NB, tag=tag)
+        except Exception as e:  # surfaced to the main thread below
+            errs.append(e)
+        finally:
+            mt.pop_op(tok)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mt.end_query(11)
+    assert not errs, errs
+    assert pool.used == 0
+    rows = {r["op"]: r for r in mt.live_by_tag() if r["query_id"] == 11}
+    for i in range(N):
+        r = rows[f"Writer{i}"]
+        assert r["allocd"] == r["freed"] == PER * NB
+        assert r["live"] == 0
+    assert mt.query_summary(11)["live_bytes"] == 0
+    tracked_peak = mt.counters()["mem_tracked_peak_bytes"]
+    assert tracked_peak > 0
+    assert abs(tracked_peak - pool.max_used) <= N * NB
+    # the capped pool denied at least once and the audit is still clean
+    assert mt.audit_query(11)["leaked_bytes"] == 0
+
+
+def test_disabled_tracking_is_a_noop():
+    mt.set_enabled(False)
+    pool = HbmPool(1 << 20)
+    assert mt.push_op("ScanExec", "scan-upload") is None
+    tag = pool.allocate(4096)
+    assert tag is None
+    pool.release(4096)
+    assert mt.live_by_tag() == []
+    assert mt.audit_query(None) == {"skipped": True}
+    assert mt.sweep_report() == []
+
+
+# -- OOM post-mortems -------------------------------------------------------
+
+
+def test_retry_exhausted_postmortem_parses_and_ranks(tmp_path):
+    """with_retry giving up writes a ranked snapshot: the file parses and
+    the top consumer is the operator actually holding the bytes."""
+    pool = HbmPool(1 << 20)
+    mt.begin_query(21)
+    tok = mt.push_op("HashAggregateExec", "agg-state")
+    hold = pool.allocate(48 << 10)  # the bytes the post-mortem should rank
+    pool.set_injector(OomInjector(kind="RETRY", skip=0, count=10_000))
+    t = pa.table({"v": pa.array(range(64), pa.int64())})
+    batch = batch_from_arrow(t)
+    c0 = mt.counters()["oom_postmortem_total"]
+    journal.clear()
+    from spark_rapids_tpu.utils import task_metrics as TM
+    TM.start_task(992101)  # retries are task-scoped metrics
+    try:
+        with pytest.raises(RetryOOM):
+            list(with_retry([batch], lambda b: pool.allocate(64),
+                            max_attempts=3))
+    finally:
+        TM.finish_task()
+        pool.set_injector(None)
+    paths = mt.postmortem_paths()
+    assert len(paths) == 1
+    assert paths[0].startswith(str(tmp_path))
+    with open(paths[0]) as f:
+        pm = json.load(f)
+    assert pm["reason"] == "retry-exhausted"
+    assert pm["query_id"] == 21
+    assert pm["top_consumer"]["op"] == "HashAggregateExec"
+    assert pm["top_consumer"]["site"] == "agg-state"
+    assert pm["top_consumer"]["live"] == 48 << 10
+    assert pm["retry_history"]["retry_count"] >= 3
+    assert mt.counters()["oom_postmortem_total"] - c0 == 1
+    ev = journal.recent("oom-postmortem")
+    assert len(ev) == 1 and ev[0]["reason"] == "retry-exhausted"
+    pool.release(48 << 10, tag=hold)
+    mt.pop_op(tok)
+    mt.end_query(21)
+    assert mt.audit_query(21)["leaked_bytes"] == 0
+
+
+def test_pool_denied_postmortem_rate_limited_per_query():
+    """A capped pool can throw thousands of recoverable RetryOOMs; the
+    denial snapshot is written once per query, not once per OOM."""
+    pool = HbmPool(4096)
+    mt.begin_query(41)
+    tok = mt.push_op("ProjectExec", "other")
+    hold = pool.allocate(4096)
+    c0 = mt.counters()["oom_postmortem_total"]
+    for _ in range(3):
+        with pytest.raises(RetryOOM):
+            pool.allocate(1 << 20)
+    assert len(mt.postmortem_paths()) == 1
+    assert mt.counters()["oom_postmortem_total"] - c0 == 1
+    with open(mt.postmortem_paths()[0]) as f:
+        pm = json.load(f)
+    assert pm["reason"] == "pool-denied"
+    assert pm["requested_bytes"] == 1 << 20
+    assert pm["top_consumer"]["op"] == "ProjectExec"
+    assert any(p["limit"] == 4096 for p in pm["pools"])
+    pool.release(4096, tag=hold)
+    mt.pop_op(tok)
+    mt.end_query(41)
+
+
+def test_fault_injected_alloc_exhaustion_postmortem():
+    """The general fault registry drives the same path: a persistent
+    mem.alloc retry schedule exhausts with_retry and dumps the snapshot."""
+    pool = HbmPool(1 << 20)
+    mt.begin_query(22)
+    tok = mt.push_op("SortExec", "sort-spill")
+    hold = pool.allocate(8192)
+    faults.install("mem.alloc:retry@p=1.0,seed=5")
+    try:
+        with pytest.raises(RetryOOM):
+            list(with_retry([object()], lambda b: pool.allocate(64),
+                            max_attempts=2))
+    finally:
+        faults.install("")
+    assert mt.postmortem_paths()
+    with open(mt.postmortem_paths()[-1]) as f:
+        pm = json.load(f)
+    assert pm["reason"] == "retry-exhausted"
+    assert pm["top_consumer"]["op"] == "SortExec"
+    pool.release(8192, tag=hold)
+    mt.pop_op(tok)
+    mt.end_query(22)
+
+
+# -- query-end leak audit ---------------------------------------------------
+
+
+def test_leak_audit_clean_query():
+    pool = HbmPool(1 << 20)
+    mt.begin_query(31)
+    tok = mt.push_op("ScanExec", "scan-upload")
+    tag = pool.allocate(2048)
+    pool.release(2048, tag=tag)
+    mt.pop_op(tok)
+    mt.end_query(31)
+    journal.clear()
+    before = mt.counters()["mem_leaked_bytes_total"]
+    rep = mt.audit_query(31)
+    assert rep["leaked_bytes"] == 0
+    assert rep["retained_bytes"] == 0
+    assert rep["leaks"] == []
+    assert mt.counters()["mem_leaked_bytes_total"] == before
+    # a clean audit stays out of the journal: "finish" must remain the
+    # last event of a healthy query
+    assert journal.recent("leak-audit") == []
+
+
+def test_leak_audit_reports_leak_and_counts_bytes():
+    pool = HbmPool(1 << 20)
+    mt.begin_query(32)
+    tok = mt.push_op("BroadcastHashJoinExec", "broadcast")
+    tag = pool.allocate(4096)
+    mt.pop_op(tok)
+    mt.end_query(32)
+    journal.clear()
+    before = mt.counters()["mem_leaked_bytes_total"]
+    rep = mt.audit_query(32)
+    assert rep["leaked_bytes"] == 4096
+    assert rep["leaks"][0]["op"] == "BroadcastHashJoinExec"
+    assert mt.counters()["mem_leaked_bytes_total"] - before == 4096
+    ev = journal.recent("leak-audit")
+    assert ev[0]["leaked_bytes"] == 4096
+    assert ev[0]["leaks"][0]["site"] == "broadcast"
+    # another query's tags are out of scope for this audit
+    assert mt.audit_query(999)["leaked_bytes"] == 0
+    pool.release(4096, tag=tag)  # balance for the end-of-suite sweep
+
+
+def test_leak_audit_materialization_cache_is_retained_not_leaked():
+    pool = HbmPool(1 << 20)
+    mt.begin_query(33)
+    with mt.site("materialization-cache"):
+        tok = mt.push_op("ShuffleExchangeExec")
+        tag = pool.allocate(1024)
+        mt.pop_op(tok)
+    mt.end_query(33)
+    rep = mt.audit_query(33)
+    assert rep["leaked_bytes"] == 0
+    assert rep["retained_bytes"] == 1024
+    # strict mode must not raise on retention: cached entries outlive the
+    # query by design (exec/reuse.py)
+    mt.audit_query(33, strict=True)
+    pool.release(1024, tag=tag)
+
+
+def test_leak_audit_strict_raise_semantics():
+    pool = HbmPool(1 << 20)
+    mt.begin_query(34)
+    tok = mt.push_op("SortExec", "sort-spill")
+    tag = pool.allocate(512)
+    mt.pop_op(tok)
+    mt.end_query(34)
+    with pytest.raises(mt.MemoryLeakError, match="SortExec@sort-spill=512"):
+        mt.audit_query(34, strict=True)
+    # an in-flight query error suppresses the raise — it would mask the
+    # real failure — but the report still carries the leak
+    rep = mt.audit_query(34, had_error=True, strict=True)
+    assert rep["leaked_bytes"] == 512
+    # non-strict never raises
+    mt.audit_query(34, strict=False)
+    pool.release(512, tag=tag)
+
+
+def test_sweep_report_names_holders():
+    pool = HbmPool(1 << 20)
+    mt.begin_query(35)
+    tok = mt.push_op("AggExec", "agg-state")
+    tag = pool.allocate(256)
+    mt.pop_op(tok)
+    mt.end_query(35)
+    lines = mt.sweep_report()
+    assert any("AggExec@agg-state" in l and "256" in l for l in lines)
+    pool.release(256, tag=tag)
+    assert mt.sweep_report() == []
+
+
+# -- surfaces ---------------------------------------------------------------
+
+
+def test_gauges_surface_memory_catalog():
+    from spark_rapids_tpu.obs import gauges
+    snap = gauges.snapshot()
+    for name in ("mem_tracked_live_bytes", "mem_tracked_peak_bytes",
+                 "oom_postmortem_total", "mem_leaked_bytes_total"):
+        assert name in snap
+    for s in mt.SITES:
+        assert "mem_site_" + s.replace("-", "_") + "_peak_bytes" in snap
+
+
+def test_site_peak_gauges_track_watermarks():
+    pool = HbmPool(1 << 20)
+    mt.begin_query(51)
+    tok = mt.push_op("ScanExec", "scan-upload")
+    tag = pool.allocate(10_000)
+    pool.release(10_000, tag=tag)
+    mt.pop_op(tok)
+    mt.end_query(51)
+    c = mt.counters()
+    assert c["mem_site_scan_upload_peak_bytes"] == 10_000
+    assert c["mem_tracked_live_bytes"] == 0
+    assert c["mem_tracked_peak_bytes"] == 10_000
+
+
+def test_dataframe_query_memory_section_and_clean_audit():
+    """End to end: a profiled DataFrame query carries the memory section
+    and finishes with a clean leak audit."""
+    t = pa.table({
+        "k": pa.array([i % 4 for i in range(256)], pa.int64()),
+        "v": pa.array(range(256), pa.int64()),
+    })
+    conf = RapidsConf({C.PROFILE_ENABLED.key: True})
+    df = (from_arrow(t, conf, batch_rows=64, partitions=2)
+          .group_by("k")
+          .agg(E.Sum(E.col("v")).alias("s")))
+    out = df.to_arrow()
+    assert out.num_rows == 4
+    prof = df.last_profile()
+    assert prof is not None
+    for key in ("query_id", "tracked_peak_bytes", "live_bytes",
+                "sites", "ops", "leak_audit"):
+        assert key in prof.memory, prof.memory
+    assert prof.memory["leak_audit"]["leaked_bytes"] == 0
+    assert "memory" in prof.to_dict()
+    # the query cleared its ambient context
+    assert mt.current_query() is None
+
+
+def test_mem_report_renders_demo_postmortem(tmp_path):
+    """tools/mem_report.py --demo writes a parseable pool-denied snapshot
+    and the renderers accept it (the obs_report bundle uses the same
+    functions)."""
+    from tools import mem_report
+    path = mem_report._run_demo()
+    assert path is not None and path.startswith(str(tmp_path))
+    with open(path) as f:
+        pm = json.load(f)
+    text = mem_report.render_postmortem(pm)
+    assert "pool-denied" in text
+    assert "DemoScanExec" in text
+    assert "top consumers" in text
+    timeline = mem_report.render_timeline(mt.timeline())
+    assert isinstance(timeline, str)
+    table = mem_report.top_consumers(mt.live_by_tag())
+    assert isinstance(table, str)
+
+
+# -- satellite: exchange cleanup on mid-query failure -----------------------
+
+
+def _reuse_conf(fusion):
+    # AQE off: its coalesced reader pulls blocks straight from the shuffle
+    # manager, bypassing the exchange's do_execute and therefore the
+    # SharedExchangeEntry — this test wants the cached-materialization path
+    return RapidsConf({
+        "spark.rapids.tpu.sql.exchange.reuse.enabled": True,
+        "spark.rapids.tpu.sql.fusion.enabled": fusion,
+        "spark.rapids.tpu.sql.adaptive.enabled": False,
+    })
+
+
+def _cte_df(conf):
+    """q2's shape in miniature: one CTE referenced twice by a self-join,
+    so the plan carries a shared (reused) exchange materialization."""
+    t = pa.table({
+        "k": pa.array([i % 8 for i in range(240)], pa.int64()),
+        "v": pa.array(range(240), pa.int64()),
+    })
+
+    def wk():
+        return (from_arrow(t, conf, batch_rows=64, partitions=2)
+                .group_by("k").agg(E.Sum(E.col("v")).alias("s")))
+
+    return wk().join(wk(), on="k")
+
+
+@pytest.mark.parametrize("fusion", [False, True])
+def test_exchange_cache_drains_when_query_raises_midway(fusion, monkeypatch):
+    """A query that raises mid-execute must still run the exchange cleanup
+    walk: every SharedExchangeEntry is released and the materialization
+    cache returns to its baseline — including exchanges that are reachable
+    only through a fused stage's absorbed build subtree (the fused_ops
+    descent in plan/dataframe.py)."""
+    from spark_rapids_tpu.columnar import batch as B
+    from spark_rapids_tpu.exec import reuse as R
+
+    baseline = R.MATERIALIZATION_CACHE.stats()
+    conf = _reuse_conf(fusion)
+
+    # negative control: a successful run drains the cache
+    df = _cte_df(conf)
+    df.to_arrow()
+    stats = R.MATERIALIZATION_CACHE.stats()
+    assert stats["bytes_used"] == baseline["bytes_used"]
+    assert stats["entries"] == baseline["entries"]
+
+    # failure run: raise from the driver's output-materialization loop the
+    # first time the shared exchange holds cached bytes, i.e. mid-execute
+    real = B.batch_to_arrow
+    seen = {"mid": None}
+
+    def boom(batch, *a, **k):
+        live = R.MATERIALIZATION_CACHE.stats()["bytes_used"]
+        if live > baseline["bytes_used"]:
+            seen["mid"] = live
+            raise RuntimeError("injected mid-query failure")
+        return real(batch, *a, **k)
+
+    monkeypatch.setattr(B, "batch_to_arrow", boom)
+    df2 = _cte_df(conf)
+    with pytest.raises(RuntimeError, match="injected mid-query failure"):
+        df2.to_arrow()
+    monkeypatch.setattr(B, "batch_to_arrow", real)
+
+    assert seen["mid"], "failure was not injected while the cache held bytes"
+    stats = R.MATERIALIZATION_CACHE.stats()
+    assert stats["bytes_used"] == baseline["bytes_used"]
+    assert stats["entries"] == baseline["entries"]
+    # and the shared framework's pool bytes for those entries are gone, so
+    # the query-end audit saw no materialization-cache leak survive
+    assert not [r for r in mt.live_by_tag()
+                if r["site"] == "materialization-cache" and r["live"] > 0]
+
+
+# -- chaos lane -------------------------------------------------------------
+
+
+@chaos
+def test_chaos_spill_retry_journal_crosscheck(tmp_path):
+    """Under memory pressure the three views must reconcile: per-tag
+    spilled bytes == task-metric spill deltas, and the post-mortem counter
+    matches the ``oom-postmortem`` journal events one for one."""
+    from spark_rapids_tpu.utils import task_metrics as TM
+
+    journal.clear()
+    tm0 = TM.aggregate_snapshot()
+    c0 = mt.counters()["oom_postmortem_total"]
+
+    pool = HbmPool(32 << 10)
+    fw = SpillFramework(pool, host_limit_bytes=8 << 30,
+                        spill_dir=str(tmp_path / "spill"))
+    mt.begin_query(88)
+    tok = mt.push_op("SortExec", "sort-spill")
+    TM.start_task(992102)  # spill bytes are task-scoped metrics
+    try:
+        t = pa.table({"v": pa.array(range(4096), pa.int64())})
+        # registration allocates from the capped pool; later handles force
+        # the framework to spill earlier ones
+        handles = [SpillableBatch(batch_from_arrow(t.slice(i * 512, 512)), fw)
+                   for i in range(8)]
+        # force a denial too: nothing left to spill for a request over the cap
+        with pytest.raises(RetryOOM):
+            for h in handles:
+                h.get()
+                h.unpin()
+            pool.allocate(1 << 20)
+        for h in handles:
+            h.close()
+    finally:
+        TM.finish_task()
+        mt.pop_op(tok)
+        mt.end_query(88)
+
+    tm1 = TM.aggregate_snapshot()
+    tm_spilled = sum(tm1.get(f, 0) - tm0.get(f, 0)
+                     for f in ("spill_to_host_bytes", "spill_to_disk_bytes"))
+    tag_spilled = sum(r["spilled"] for r in mt.live_by_tag()
+                      if r["query_id"] == 88)
+    assert tag_spilled == tm_spilled > 0
+    pm_events = journal.recent("oom-postmortem")
+    assert mt.counters()["oom_postmortem_total"] - c0 == len(pm_events) >= 1
+    assert mt.audit_query(88)["leaked_bytes"] == 0
+    assert pool.used == 0

@@ -19,6 +19,18 @@ The convention: counter names end in ``_total``. This checker flags any
 ``year_total`` live in ``.alias(...)`` / ``col(...)`` call arguments and
 match none of these shapes.
 
+Two sibling catalogs ride the same guard:
+
+- the per-site memory gauges ``obs/memtrack.py`` derives from its
+  ``SITES`` tuple (``mem_site_<site>_peak_bytes``) plus its fixed
+  tracked-bytes gauges must all be declared in ``CATALOG`` — adding a
+  site without declaring its gauge would silently drop it from the
+  Prometheus view;
+- every ``*_ns`` histogram name passed to ``record(...)`` / ``get(...)``
+  must be declared in ``obs/histo.CATALOG`` (``histo.record`` raises at
+  runtime on undeclared names; the static check catches cold paths tests
+  never drive).
+
 Pure AST analysis, no imports of the checked code; wired into the default
 test lane via tests/test_obs.py.
 """
@@ -52,12 +64,64 @@ def catalog_names() -> set:
                      "(update tools/check_gauge_catalog.py)")
 
 
+def _module_literal(relpath: str, name: str):
+    """Top-level literal assignment ``name = <literal>`` in a package
+    module, or None when absent."""
+    path = os.path.join(PKG, relpath)
+    with open(path, "r") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                return ast.literal_eval(node.value)
+    return None
+
+
+def histo_names() -> set:
+    """obs/histo.py CATALOG names (2-tuples of name, help)."""
+    entries = _module_literal(os.path.join("obs", "histo.py"), "CATALOG")
+    if entries is None:
+        raise SystemExit("obs/histo.py: CATALOG assignment not found "
+                         "(update tools/check_gauge_catalog.py)")
+    return {name for name, _ in entries}
+
+
+def check_memtrack_site_gauges(declared: set, violations: list) -> None:
+    """Every memtrack site must have its derived peak gauge declared, and
+    the fixed tracked-bytes gauges must be declared too."""
+    sites = _module_literal(os.path.join("obs", "memtrack.py"), "SITES")
+    if sites is None:
+        violations.append("obs/memtrack.py: SITES tuple not found "
+                          "(update tools/check_gauge_catalog.py)")
+        return
+    expected = {"mem_site_" + s.replace("-", "_") + "_peak_bytes"
+                for s in sites}
+    expected |= {"mem_tracked_live_bytes", "mem_tracked_peak_bytes"}
+    for name in sorted(expected - declared):
+        violations.append(
+            f"spark_rapids_tpu/obs/memtrack.py: memory gauge '{name}' is "
+            f"emitted by memtrack.counters() but not declared in "
+            f"obs/gauges.CATALOG — it would be invisible to "
+            f"snapshot()/Prometheus")
+
+
 def _is_metric_name(node: ast.AST) -> bool:
     return (isinstance(node, ast.Constant) and isinstance(node.value, str)
             and node.value.endswith("_total"))
 
 
-def _check_file(path: str, declared: set, violations: list) -> None:
+def _is_histo_name(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.endswith("_ns"))
+
+
+def _check_file(path: str, declared: set, violations: list,
+                histos: set = frozenset()) -> None:
     with open(path, "r") as f:
         src = f.read()
     try:
@@ -89,22 +153,36 @@ def _check_file(path: str, declared: set, violations: list) -> None:
                      else None)
             if fname == "note" and node.args and _is_metric_name(node.args[0]):
                 flag(node.args[0], "is passed to note(...)")
+            # histogram-catalog guard: record()/get() with a *_ns name
+            # constant must reference a declared obs/histo.CATALOG entry
+            if (fname in ("record", "get") and node.args
+                    and _is_histo_name(node.args[0])
+                    and node.args[0].value not in histos):
+                violations.append(
+                    f"{rel}:{node.args[0].lineno}: histogram "
+                    f"'{node.args[0].value}' is passed to {fname}(...) but "
+                    f"is not declared in obs/histo.CATALOG — record() "
+                    f"raises on undeclared names at runtime")
 
 
 def main() -> int:
     declared = catalog_names()
+    histos = histo_names()
     violations: list = []
+    check_memtrack_site_gauges(declared, violations)
     for dirpath, dirnames, filenames in os.walk(PKG):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in sorted(filenames):
             if fn.endswith(".py"):
-                _check_file(os.path.join(dirpath, fn), declared, violations)
+                _check_file(os.path.join(dirpath, fn), declared, violations,
+                            histos)
     if violations:
         print("gauge-catalog guard FAILED:", file=sys.stderr)
         for v in violations:
             print(f"  {v}", file=sys.stderr)
         return 1
-    print(f"gauge-catalog guard OK ({len(declared)} declared metrics)")
+    print(f"gauge-catalog guard OK ({len(declared)} declared metrics, "
+          f"{len(histos)} histograms)")
     return 0
 
 

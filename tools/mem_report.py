@@ -1,0 +1,205 @@
+#!/usr/bin/env python3
+"""Memory attribution report: who held HBM, when, and what an OOM saw.
+
+Reads the live process's obs/memtrack.py state (or a saved post-mortem
+JSON) and renders it for humans:
+
+- a per-site watermark timeline (the sampled ring, bucketed into a
+  fixed-width text chart)
+- a top-consumers table ranked by peak bytes per (query, operator, site)
+  tag
+- a post-mortem rendering: reason, top consumer, ranked live allocations,
+  pool/spill/semaphore state, recent retry history
+
+CLI:
+  python tools/mem_report.py                  # report on the live process
+                                              # (useful under pytest/bench
+                                              # via build-and-call)
+  python tools/mem_report.py --postmortem artifacts/oom_postmortem_X.json
+  python tools/mem_report.py --demo           # synthetic allocations + a
+                                              # forced post-mortem, so the
+                                              # output paths are exercised
+
+The same render functions back the ``memory.txt`` section of the
+diagnostics bundle (tools/obs_report.py). See docs/memory.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BAR_WIDTH = 40
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB"):
+        if abs(n) < 1024:
+            return f"{int(n)}B" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def render_timeline(samples: List[Dict], width: int = _BAR_WIDTH) -> str:
+    """Fixed-width text chart of the sampled total-bytes ring; one row per
+    sample (the ring is already rate-limited), bar scaled to the max."""
+    if not samples:
+        return "(no memory samples recorded)"
+    peak = max(s["total_bytes"] for s in samples) or 1
+    t0 = samples[0]["t_ns"]
+    lines = [f"tracked-bytes timeline ({len(samples)} samples, "
+             f"peak {_fmt_bytes(peak)}):"]
+    for s in samples:
+        bar = "#" * max(1 if s["total_bytes"] else 0,
+                        round(s["total_bytes"] / peak * width))
+        top_site = max(s["sites"].items(), key=lambda kv: kv[1])[0] \
+            if s.get("sites") else "-"
+        lines.append(f"  +{(s['t_ns'] - t0) / 1e6:9.1f}ms "
+                     f"{_fmt_bytes(s['total_bytes']):>10s} "
+                     f"|{bar:<{width}s}| {top_site}")
+    return "\n".join(lines)
+
+
+def top_consumers(rows: List[Dict], n: int = 15) -> str:
+    """Table of tags ranked by peak bytes: the 'who used the memory'
+    answer. ``rows`` is memtrack.live_by_tag() shape (or the
+    live_allocations list of a post-mortem)."""
+    if not rows:
+        return "(no attributed allocations)"
+    ranked = sorted(rows, key=lambda r: r.get("peak", r.get("live", 0)),
+                    reverse=True)[:n]
+    head = (f"{'query':>6s} {'operator':<28s} {'site':<22s} "
+            f"{'peak':>10s} {'live':>10s} {'alloc':>10s} {'spilled':>10s}")
+    lines = ["top consumers (by peak bytes):", "  " + head]
+    for r in ranked:
+        lines.append(
+            "  "
+            f"{str(r.get('query_id', '-')):>6s} "
+            f"{str(r.get('op', '?')):<28.28s} "
+            f"{str(r.get('site', '?')):<22.22s} "
+            f"{_fmt_bytes(r.get('peak', 0)):>10s} "
+            f"{_fmt_bytes(r.get('live', 0)):>10s} "
+            f"{_fmt_bytes(r.get('allocd', 0)):>10s} "
+            f"{_fmt_bytes(r.get('spilled', 0)):>10s}")
+    return "\n".join(lines)
+
+
+def render_postmortem(pm: Dict) -> str:
+    """Human rendering of one oom_postmortem_*.json snapshot."""
+    lines = [f"OOM post-mortem: {pm.get('reason', '?')}"]
+    if pm.get("query_id") is not None:
+        lines.append(f"  query: #{pm['query_id']}")
+    if pm.get("requested_bytes"):
+        lines.append(f"  requested: {_fmt_bytes(pm['requested_bytes'])}")
+    if pm.get("error"):
+        lines.append(f"  error: {pm['error']}")
+    tracked = pm.get("tracked", {})
+    lines.append(f"  tracked: live {_fmt_bytes(tracked.get('live_bytes', 0))}"
+                 f" / peak {_fmt_bytes(tracked.get('peak_bytes', 0))}")
+    top = pm.get("top_consumer")
+    if top:
+        lines.append(f"  top consumer: {top.get('op')}@{top.get('site')} "
+                     f"(query {top.get('query_id')}) "
+                     f"live {_fmt_bytes(top.get('live', 0))}")
+    for p in pm.get("pools", []):
+        lines.append(f"  pool: used {_fmt_bytes(p.get('used', 0))} / "
+                     f"limit {_fmt_bytes(p.get('limit', 0))}  "
+                     f"(max {_fmt_bytes(p.get('max_used', 0))}, "
+                     f"ooms {p.get('oom_count', 0)}, "
+                     f"spill-requests {p.get('spill_request_count', 0)})")
+    for s in pm.get("spill", []):
+        if "error" in s:
+            continue
+        lines.append(f"  spill: {s.get('handles', 0)} handles "
+                     f"{s.get('by_state', {})}  host {_fmt_bytes(s.get('host_used', 0))}")
+    for sem in pm.get("semaphores", []):
+        lines.append(f"  semaphore: {len(sem.get('holders', {}))} holders / "
+                     f"{sem.get('permits')} permits, "
+                     f"waiters {sem.get('waiters', {})}")
+    rh = {k: v for k, v in pm.get("retry_history", {}).items() if v}
+    if rh:
+        lines.append("  retry history: "
+                     + " ".join(f"{k}={v}" for k, v in rh.items()))
+    alloc = pm.get("live_allocations", [])
+    if alloc:
+        lines.append(top_consumers(alloc, n=10))
+    return "\n".join(lines)
+
+
+def live_report() -> str:
+    """Full report on the current process's memtrack state."""
+    from spark_rapids_tpu.obs import memtrack as mt
+    summary = mt.process_summary()
+    parts = ["== memory attribution report ==",
+             f"tracked: live {_fmt_bytes(summary['tracked_live_bytes'])} / "
+             f"peak {_fmt_bytes(summary['tracked_peak_bytes'])}"]
+    peaks = {s: v for s, v in summary["site_peaks"].items() if v}
+    if peaks:
+        parts.append("site peaks: " + "  ".join(
+            f"{s}={_fmt_bytes(v)}" for s, v in
+            sorted(peaks.items(), key=lambda kv: -kv[1])))
+    parts.append(top_consumers(mt.live_by_tag()))
+    parts.append(render_timeline(mt.timeline()))
+    pms = mt.postmortem_paths()
+    if pms:
+        parts.append(f"post-mortems written: {pms}")
+    return "\n\n".join(parts)
+
+
+def _run_demo() -> Optional[str]:
+    """Synthetic exercise: tagged allocations under a tiny capped pool,
+    forced past its limit so a pool-denied post-mortem is written."""
+    from spark_rapids_tpu.mem.pool import HbmPool, RetryOOM
+    from spark_rapids_tpu.obs import memtrack as mt
+
+    mt.begin_query(999)
+    pool = HbmPool(64 << 10)
+    tok = mt.push_op("DemoScanExec", "scan-upload")
+    try:
+        pool.allocate(48 << 10)
+        with mt.site("agg-state"):
+            mt.push_op("DemoAggExec")
+            try:
+                pool.allocate(32 << 10)   # over the cap -> denial + dump
+            except RetryOOM:
+                pass
+    finally:
+        mt.pop_op(tok)
+        pool.release(48 << 10, tag=(999, "DemoScanExec", "scan-upload"))
+        mt.end_query(999)
+    paths = mt.postmortem_paths()
+    return paths[-1] if paths else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--postmortem", metavar="FILE",
+                    help="render a saved oom_postmortem_*.json instead of "
+                         "the live process state")
+    ap.add_argument("--demo", action="store_true",
+                    help="run synthetic tagged allocations incl. one "
+                         "forced OOM post-mortem first")
+    args = ap.parse_args(argv)
+    if args.postmortem:
+        with open(args.postmortem) as f:
+            print(render_postmortem(json.load(f)))
+        return 0
+    if args.demo:
+        path = _run_demo()
+        if path:
+            print(f"demo post-mortem: {path}")
+            with open(path) as f:
+                print(render_postmortem(json.load(f)))
+            print()
+    print(live_report())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

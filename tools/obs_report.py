@@ -13,12 +13,18 @@ process,
 - ``health.json``      merged worker health view (heartbeat registry)
 - ``trace.json``       Chrome trace; merged across workers when a
                        ``TcpShuffleCluster`` is passed, else driver-only
+- ``memory.json``      HBM attribution summary + watermark timeline
+                       (obs/memtrack.py)
+- ``memory.txt``       human top-consumers table + timeline chart
+                       (tools/mem_report.py renderers)
+- ``oom_postmortem_*.json``  copies of post-mortems this process wrote
 - ``config.json``      resolved active configuration (every registered key)
 - ``MANIFEST.json``    what was written, with sizes
 
 CLI: ``python tools/obs_report.py --out DIR [--demo]``. ``--demo`` runs a
-tiny in-memory query with profiling + trace capture on first, so the bundle
-is non-empty — the smoke path tests/run_slow_lane.sh exercises.
+tiny in-memory query with profiling + trace capture on first — plus one
+synthetic OOM post-mortem — so the bundle is non-empty; the smoke path
+tests/run_slow_lane.sh exercises it.
 """
 
 from __future__ import annotations
@@ -72,6 +78,25 @@ def build_bundle(out_dir: str, cluster=None) -> dict:
         trace = obs.merge_process_traces({"driver": tracing.trace_events()})
     write("health.json", json.dumps(health, indent=1, default=str))
     write("trace.json", json.dumps(trace))
+
+    # memory attribution section (obs/memtrack.py + tools/mem_report.py)
+    from spark_rapids_tpu.obs import memtrack as _mt
+    from tools import mem_report as _mr
+    write("memory.json", json.dumps({
+        **_mt.process_summary(),
+        "timeline": _mt.timeline(),
+        "postmortems": _mt.postmortem_paths(),
+    }, indent=1, default=str))
+    write("memory.txt",
+          _mr.top_consumers(_mt.live_by_tag()) + "\n\n"
+          + _mr.render_timeline(_mt.timeline()))
+    for pm_path in _mt.postmortem_paths():
+        if not os.path.exists(pm_path):
+            continue
+        name = os.path.basename(pm_path)
+        with open(pm_path) as f:
+            write(name, f.read())
+
     write("config.json", json.dumps(_resolved_config(), indent=1, default=str))
 
     manifest = {
@@ -106,6 +131,11 @@ def _run_demo_query() -> None:
           .agg(Sum(col("v")).alias("total"), Count().alias("n")))
     rows = df.collect()
     assert len(rows) == 4, rows
+
+    # one synthetic OOM post-mortem so the bundle's memory section carries
+    # a ranked snapshot (tools/mem_report.py renders the same file)
+    from tools import mem_report as _mr
+    _mr._run_demo()
 
 
 def main(argv=None) -> int:
