@@ -226,24 +226,26 @@ class _Budget:
         return self.total - (time.perf_counter() - self.t0)
 
 
-def _faults_guard(faults_spec, environ):
-    """Chaos runs must never shrink correctness coverage: with a fault
-    schedule active, refuse the BENCH_* env overrides that scale down the
-    inputs/runs the differential gates compare. (The --budget shrinkage of
-    statistical knobs is already gate-safe by construction; the envs are
-    not — they change WHAT is checked, not how often.)"""
-    if not faults_spec:
+def _faults_guard(faults_spec, environ, pool_cap=None):
+    """Chaos and capped-pool runs must never shrink correctness coverage:
+    with a fault schedule or a --pool-cap active, refuse the BENCH_* env
+    overrides that scale down the inputs/runs the differential gates
+    compare. (The --budget shrinkage of statistical knobs is already
+    gate-safe by construction; the envs are not — they change WHAT is
+    checked, not how often.)"""
+    if not faults_spec and not pool_cap:
         return
+    flag = "--faults" if faults_spec else "--pool-cap"
     banned = [k for k in ("BENCH_SF_H", "BENCH_SF_DS", "BENCH_RUNS",
                           "BENCH_DEPTH") if k in environ]
     if banned:
         raise SystemExit(
-            f"--faults is set: refusing to run with correctness-gate "
-            f"overrides {banned} (chaos runs must execute the full "
-            f"differential check)")
+            f"{flag} is set: refusing to run with correctness-gate "
+            f"overrides {banned} (chaos/memory-pressure runs must execute "
+            f"the full differential check)")
 
 
-def main(budget_s=None, faults=None):
+def main(budget_s=None, faults=None, pool_cap=None):
     import jax
     from spark_rapids_tpu.bench import tpch
     from spark_rapids_tpu.bench import tpcds_queries as DSQ
@@ -252,7 +254,15 @@ def main(budget_s=None, faults=None):
     from spark_rapids_tpu.plan import from_arrow
     from spark_rapids_tpu.utils.sync import fence
 
-    _faults_guard(faults, os.environ)
+    _faults_guard(faults, os.environ, pool_cap=pool_cap)
+    if pool_cap:
+        # memory-pressure run: replace the process pool with a capped one so
+        # every device allocation contends for the reduced budget — spill,
+        # retry, and agg repartition all fire for real (the correctness
+        # gates below then prove results are unchanged under pressure)
+        from spark_rapids_tpu.mem.pool import HbmPool, set_pool
+        set_pool(HbmPool(int(pool_cap)))
+        _mark(f"pool capped at {int(pool_cap)} bytes")
     dev_conf = RapidsConf(
         {"spark.rapids.tpu.test.faults": faults} if faults else {})
     cpu_conf = RapidsConf({"spark.rapids.tpu.sql.enabled": False})
@@ -545,6 +555,12 @@ def main(budget_s=None, faults=None):
             "spill_bytes": sum(prof.task_metrics.get(f, 0) for f in
                                ("spill_to_host_bytes",
                                 "spill_to_disk_bytes")),
+            # oversized-agg evidence (docs/oversized_state.md): passes this
+            # query triggered and the deepest recursion level reached
+            "repartitions": prof.task_metrics.get(
+                "agg_repartition_count", 0),
+            "repartition_depth": prof.task_metrics.get(
+                "max_agg_repartition_depth", 0),
         }), flush=True)
         ppath = os.path.join(prof_dir, f"profile_{suite}_{qn}.json")
         with open(ppath, "w") as f:
@@ -599,6 +615,7 @@ def main(budget_s=None, faults=None):
         "tpch_bytes_per_iter_GB": round(bytes_h / 1e9, 3),
         "queries": {"tpch": h_names, "tpcds": TPCDS_QUERIES,
                     "sf": {"tpch": SF_H, "tpcds": SF_DS}},
+        "pool_cap": int(pool_cap) if pool_cap else None,
         "profiles": profile_files,
         "traces": trace_files,
         "prometheus": prom_path,
@@ -630,10 +647,18 @@ if __name__ == "__main__":
                          "refuses BENCH_* correctness-gate overrides so "
                          "chaos runs always execute the full differential "
                          "check (docs/fault_injection.md)")
+    ap.add_argument("--pool-cap", type=int, default=None, metavar="BYTES",
+                    help="cap the HBM accounting pool at BYTES for the "
+                         "whole run (memory-pressure gauntlet: spill, "
+                         "retry, and agg repartition fire for real while "
+                         "the correctness gates still compare full "
+                         "results; refuses BENCH_* overrides like "
+                         "--faults, docs/oversized_state.md)")
     _args = ap.parse_args()
     if _args.budget is None and not sys.stdout.isatty():
         # non-interactive bare run (CI/harness): a full unbudgeted sweep can
         # outlive the caller's timeout and lose the final metric line —
         # default to a conservative budget instead
         _args.budget = float(os.environ.get("SRTPU_BENCH_BUDGET_S", "600"))
-    main(budget_s=_args.budget, faults=_args.faults)
+    main(budget_s=_args.budget, faults=_args.faults,
+         pool_cap=_args.pool_cap)

@@ -113,6 +113,51 @@ SPILL_DIR = conf(
     "spark.rapids.tpu.memory.spillDir", default="/tmp/srtpu_spill",
     doc="Directory for disk-tier spill files.")
 
+SPILL_CHUNK_BYTES = conf(
+    "spark.rapids.tpu.memory.spill.chunkBytes", default=8 << 20,
+    doc="Fixed chunk size for spilled batches. A batch is serialized into "
+        "CRC-guarded chunks of this size so host/disk tiers move bounded "
+        "pieces through a small reusable bounce buffer instead of "
+        "whole-batch copies, and unspill can stream one chunk at a time "
+        "(reference: GpuDeviceManager bounce buffer pools).",
+    check=lambda v: None if v >= 4096 else "must be >= 4096")
+
+SPILL_CODEC = conf(
+    "spark.rapids.tpu.memory.spill.codec", default="none",
+    doc="Compression codec applied per spill chunk: none, zlib, lz4, zstd. "
+        "lz4/zstd need their python modules importable; selecting a missing "
+        "codec fails fast at spill-framework construction "
+        "(reference: spark.rapids.shuffle.compression.codec).",
+    check=lambda v: None if v in ("none", "zlib", "lz4", "zstd")
+    else "must be one of none, zlib, lz4, zstd")
+
+AGG_REPARTITION_ENABLED = conf(
+    "spark.rapids.tpu.sql.agg.repartition.enabled", default=True,
+    doc="When hash-aggregate merge state outgrows the target (or a "
+        "retryable OOM fires mid-merge), recursively hash-repartition the "
+        "partial buffers into buckets and aggregate each bucket "
+        "independently instead of split-retrying the input "
+        "(reference: GpuAggregateExec repartition-based fallback).")
+
+AGG_REPARTITION_TARGET_BYTES = conf(
+    "spark.rapids.tpu.sql.agg.repartition.targetBytes", default=0,
+    doc="Merge-state byte threshold that triggers the aggregate "
+        "repartition fallback; 0 derives a quarter of the HBM pool budget.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+AGG_REPARTITION_NUM_BUCKETS = conf(
+    "spark.rapids.tpu.sql.agg.repartition.numBuckets", default=16,
+    doc="Hash buckets per repartition level; each level re-seeds the bucket "
+        "hash so a skewed bucket re-splits on a different boundary "
+        "(reference: GpuAggregateExec.scala hashSeed + 7).",
+    check=lambda v: None if v >= 2 else "must be >= 2")
+
+AGG_REPARTITION_MAX_DEPTH = conf(
+    "spark.rapids.tpu.sql.agg.repartition.maxDepth", default=3,
+    doc="Maximum recursion depth for aggregate hash-repartition; past it "
+        "the engine falls back to split-retry as the last resort.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
 OOM_INJECT_MODE = conf(
     "spark.rapids.tpu.test.injectRetryOOM.mode", default="NONE",
     doc="Test-only fault injection: NONE, RETRY, SPLIT (reference: "
@@ -130,8 +175,9 @@ TEST_FAULTS = conf(
     "spark.rapids.tpu.test.faults", default="",
     doc="Fault-injection schedule: 'site:action@k=v,...;site:action@...' "
         "(e.g. 'mem.alloc:retry@skip=3;shuffle.fetch:drop@p=0.1,seed=42'). "
-        "Sites: mem.alloc, io.decode, shuffle.serialize, shuffle.fetch, "
-        "shuffle.block, parallel.exchange, executor. Actions: retry, split, "
+        "Sites: mem.alloc, mem.spill, io.decode, shuffle.serialize, "
+        "shuffle.fetch, shuffle.block, parallel.exchange, executor, "
+        "agg.repartition. Actions: retry, split, "
         "drop, error, corrupt, slow, stall, kill. Empty = injection off, "
         "zero overhead. Generalizes the reference's OomInjectionConf "
         "(RapidsConf.scala:2753) to every layer; see docs/fault_injection.md.",
@@ -486,6 +532,13 @@ SCAN_COMBINE_WINDOW = conf(
     doc="Files decoded per threadpool window in the multithreaded parquet "
         "reader before device upload (reference: MULTITHREADED reader "
         "combine settings).")
+
+SCAN_METADATA_THREADS = conf(
+    "spark.rapids.tpu.sql.scan.metadataThreads", default=4,
+    doc="Threads reading parquet footers + row-group metadata ahead of the "
+        "decode pool; large scans are otherwise serialized on per-file "
+        "metadata I/O (reference: MULTITHREADED reader footer threads).",
+    check=lambda v: None if v >= 1 else "must be >= 1")
 
 WRITER_ASYNC_MAX_IN_FLIGHT = conf(
     "spark.rapids.tpu.sql.write.async.maxInFlightBytes", default=256 << 20,

@@ -22,9 +22,10 @@ Aggregate buffer layout per function (Spark-exact result types):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +56,59 @@ class _AggSpec:
     @property
     def result_type(self) -> T.DataType:
         return self.func.dtype
+
+
+# ---------------------------------------------------------------------------
+# oversized-state repartition bookkeeping (reference: the repartition-based
+# fallback of GpuAggregateExec.scala:208-314). Module-level so obs/gauges can
+# export ``agg_repartition_total`` and obs/memtrack postmortems can name the
+# bucket a thread was merging when the pool denied it.
+# ---------------------------------------------------------------------------
+
+_repart_lock = threading.Lock()
+_repart_stats = {"total": 0, "max_depth": 0}
+_active_repart: Dict[int, Tuple[int, int]] = {}  # thread id -> (depth, bucket)
+
+
+def _note_repartition(level: int) -> None:
+    with _repart_lock:
+        _repart_stats["total"] += 1
+        _repart_stats["max_depth"] = max(_repart_stats["max_depth"], level + 1)
+
+
+def repartition_snapshot() -> Dict[str, int]:
+    """Process-wide repartition stats: {"total", "max_depth"} (monotonic)."""
+    with _repart_lock:
+        return dict(_repart_stats)
+
+
+def counters() -> Dict[str, int]:
+    """obs/gauges feed."""
+    with _repart_lock:
+        return {"agg_repartition_total": _repart_stats["total"]}
+
+
+def active_repartitions() -> List[Dict[str, int]]:
+    """Threads currently merging a repartition bucket (postmortem context)."""
+    with _repart_lock:
+        return [{"thread": t, "depth": d, "bucket": b}
+                for t, (d, b) in _active_repart.items()]
+
+
+@contextlib.contextmanager
+def _bucket_ctx(depth: int, bucket: int):
+    tid = threading.get_ident()
+    with _repart_lock:
+        prev = _active_repart.get(tid)
+        _active_repart[tid] = (depth, bucket)
+    try:
+        yield
+    finally:
+        with _repart_lock:
+            if prev is None:
+                _active_repart.pop(tid, None)
+            else:
+                _active_repart[tid] = prev
 
 
 _MERGE_OP = {"sum": "sum", "count": "sum", "count_all": "sum", "min": "min",
@@ -146,6 +200,7 @@ class HashAggregateExec(UnaryExec):
         self._prepare_lock = threading.Lock()
         self._register_metric("numAggBatches")
         self._register_metric("concatTimeNs")
+        self._register_metric("numRepartitions")
 
     # -- lowering ----------------------------------------------------------
     def _prepare(self):
@@ -1088,11 +1143,11 @@ class HashAggregateExec(UnaryExec):
                 merged = self._merge_pass_fn(buf)
                 yield self._final_project_fn(merged)
             return
-        merged = self._merge_to_one(partials)
-        if self.mode == "partial":
-            yield merged
-        else:
-            yield self._final_project_fn(merged)
+        for merged in self._merge_all(partials):
+            if self.mode == "partial":
+                yield merged
+            else:
+                yield self._final_project_fn(merged)
 
     def _merge_to_one(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
         """Concat partial buffers on device and merge until one batch."""
@@ -1109,6 +1164,208 @@ class HashAggregateExec(UnaryExec):
                 cat = concat_jit(group)
             partials.insert(0, self._merge_pass_fn(cat))
         return partials[0]
+
+    # -- oversized-state fallback ------------------------------------------
+    # Reference: GpuAggregateExec.scala:208-314 — when the merged state will
+    # not fit, hash-REPARTITION the partials into buckets (re-seeded hash per
+    # level, bounded depth) and aggregate each bucket independently, instead
+    # of asking split-retry to save a merge that is too big by construction.
+    # Buckets hold disjoint key sets, so one merged batch per bucket is a
+    # globally correct result and do_execute may emit several batches.
+
+    def _repart_conf(self) -> Tuple[bool, int, int, int]:
+        from spark_rapids_tpu.config import conf as C
+        from spark_rapids_tpu.mem.pool import get_pool
+
+        cfg = C.get_active()
+        enabled = bool(C.AGG_REPARTITION_ENABLED.get(cfg)) and self._n_keys > 0
+        target = int(C.AGG_REPARTITION_TARGET_BYTES.get(cfg))
+        if target <= 0:
+            # the merge working set is concat(inputs) + merged output: give
+            # the cascade at most a quarter of the budget before bucketing
+            target = max(get_pool().limit // 4, 1)
+        return (enabled, target, int(C.AGG_REPARTITION_NUM_BUCKETS.get(cfg)),
+                int(C.AGG_REPARTITION_MAX_DEPTH.get(cfg)))
+
+    def _merge_all(self,
+                   partials: List[ColumnarBatch]) -> Iterator[ColumnarBatch]:
+        """Merge partials into one batch — or, when the combined state is
+        oversized (or the pool denies the direct merge), into one batch per
+        hash bucket via recursive repartitioning."""
+        from spark_rapids_tpu.mem.pool import RetryOOM, SplitAndRetryOOM
+
+        enabled, target, nbuckets, max_depth = self._repart_conf()
+        state = sum(p.nbytes() for p in partials)
+        if not enabled:
+            yield self._merge_to_one(partials)
+            return
+        if len(partials) == 1 or state <= target:
+            try:
+                yield self._merge_to_one(list(partials))
+                return
+            except (RetryOOM, SplitAndRetryOOM):
+                if len(partials) == 1:
+                    raise  # nothing to bucket; with_retry paths own this
+                # pool denied the merge mid-flight: fall through and
+                # repartition from the (still referenced) original partials
+        from spark_rapids_tpu import faults
+        from spark_rapids_tpu.mem import retry as R
+
+        attempts = 0
+        while True:
+            try:
+                out = list(self._repartition_merge(
+                    list(partials), 0, target, nbuckets, max_depth))
+                break
+            except RetryOOM:
+                attempts += 1
+                if attempts >= 3:
+                    raise
+                R._oom_backoff(attempts)
+        if attempts:
+            faults.note_recovered("agg.repartition")
+        for merged in out:
+            yield merged
+
+    def _bucket_ids(self, batch: ColumnarBatch, salt: jax.Array,
+                    nbuckets: int) -> jax.Array:
+        """Per-row bucket id (traced). The carried #gh1 hash is re-seeded
+        through splitmix64 with a level salt so every recursion level cuts
+        the key space along an independent boundary."""
+        if self._buffers_have_carry(batch):
+            h = batch.columns[self._n_keys].data.astype(jnp.uint64)
+        else:
+            h = K.hash_keys(batch, list(range(self._n_keys)))
+        return (K._splitmix64(h ^ salt)
+                % jnp.uint64(nbuckets)).astype(jnp.int32)
+
+    def _bucket_counts(self, batch: ColumnarBatch, salt: jax.Array,
+                       nbuckets: int) -> jax.Array:
+        ids = self._bucket_ids(batch, salt, nbuckets)
+        active = jnp.arange(batch.capacity, dtype=jnp.int32) < batch.num_rows
+        ids = jnp.where(active, ids, nbuckets)  # park inactive rows
+        return jnp.bincount(ids, length=nbuckets + 1)[:nbuckets]
+
+    def _bucket_extract(self, batch: ColumnarBatch, salt: jax.Array,
+                        b: jax.Array, nbuckets: int,
+                        out_cap: int) -> ColumnarBatch:
+        ids = self._bucket_ids(batch, salt, nbuckets)
+        active = jnp.arange(batch.capacity, dtype=jnp.int32) < batch.num_rows
+        idx, n = K.filter_indices(ids == b, active)
+        return K.gather_batch(batch, idx[:out_cap], n)
+
+    def _repartition_merge(self, inputs: List, level: int, target: int,
+                           nbuckets: int,
+                           max_depth: int) -> Iterator[ColumnarBatch]:
+        """Recursively hash-repartition ``inputs`` and merge each bucket.
+
+        Two passes per input batch: a jitted count pass (one host sync),
+        then one jitted extract per NON-EMPTY bucket with a static capacity
+        sized to that bucket — only one bucket sub-batch is live at a time.
+        Sub-batches go straight into SpillableBatch handles, so pool
+        pressure sheds waiting buckets to host/disk through the same door
+        as every other operator. ``inputs`` items may be plain batches
+        (level 0) or SpillableBatch handles (recursion)."""
+        from spark_rapids_tpu import faults
+        from spark_rapids_tpu.exec.jit_cache import shared_jit
+        from spark_rapids_tpu.mem import spill as S
+        from spark_rapids_tpu.mem.pool import RetryOOM, SplitAndRetryOOM
+        from spark_rapids_tpu.obs import events as _journal
+        from spark_rapids_tpu.utils import task_metrics as TM
+
+        faults.check("agg.repartition", level=level)
+        _note_repartition(level)
+        TM.add("agg_repartition_count", 1)
+        TM.watermark("max_agg_repartition_depth", level + 1)
+        self.metrics["numRepartitions"].add(1)
+        _journal.emit("agg-repartition", level=level, buckets=nbuckets,
+                      inputs=len(inputs))
+
+        fw = S.get_framework()
+        salt = jnp.uint64(((level + 1) * 0x9E3779B97F4A7C15)
+                          & 0xFFFFFFFFFFFFFFFF)
+        counts_fn = shared_jit(
+            self._base_key + ("repart-counts", nbuckets),
+            lambda: lambda batch, s: self._bucket_counts(batch, s, nbuckets))
+
+        def _extract_fn(cap):
+            return shared_jit(
+                self._base_key + ("repart-extract", nbuckets, cap),
+                lambda: lambda batch, s, b: self._bucket_extract(
+                    batch, s, b, nbuckets, cap))
+
+        buckets: List[List[S.SpillableBatch]] = [[] for _ in range(nbuckets)]
+        try:
+            for item in inputs:
+                if isinstance(item, S.SpillableBatch):
+                    with item as batch:
+                        self._scatter_one(batch, salt, counts_fn, _extract_fn,
+                                          buckets, fw)
+                    item.close()  # bucketed: the source copy is dead weight
+                else:
+                    self._scatter_one(item, salt, counts_fn, _extract_fn,
+                                      buckets, fw)
+            del inputs  # device refs now live only in the bucket handles
+            for b, hs in enumerate(buckets):
+                if not hs:
+                    continue
+                with _bucket_ctx(level, b):
+                    bucket_bytes = sum(h.nbytes for h in hs)
+                    if (bucket_bytes > target and len(hs) > 1
+                            and level + 1 < max_depth):
+                        yield from self._repartition_merge(
+                            hs, level + 1, target, nbuckets, max_depth)
+                        continue
+                    pinned: List[S.SpillableBatch] = []
+                    try:
+                        batches = []
+                        for h in hs:
+                            batches.append(h.get())
+                            pinned.append(h)
+                        merged = self._merge_to_one(batches)
+                    except (RetryOOM, SplitAndRetryOOM):
+                        del batches
+                        for h in pinned:
+                            h.unpin()
+                        if level + 1 < max_depth and len(hs) > 1:
+                            yield from self._repartition_merge(
+                                hs, level + 1, target, nbuckets, max_depth)
+                        else:
+                            yield self._merge_last_resort(hs, fw)
+                        continue
+                    for h in pinned:
+                        h.unpin()
+                    for h in hs:
+                        h.close()
+                    yield merged
+        finally:
+            for hs in buckets:
+                for h in hs:
+                    h.close()  # idempotent; frees survivors on error exits
+
+    def _scatter_one(self, batch: ColumnarBatch, salt: jax.Array, counts_fn,
+                     extract_fn, buckets: List[List], fw) -> None:
+        """Split one materialized batch across the bucket lists."""
+        from spark_rapids_tpu.mem import spill as S
+
+        counts = jax.device_get(counts_fn(batch, salt))
+        for b, n in enumerate(counts):
+            n = int(n)
+            if n == 0:
+                continue
+            cap = bucket_capacity(n, 16)
+            sub = extract_fn(cap)(batch, salt, jnp.int32(b))
+            buckets[b].append(S.SpillableBatch(sub, fw))
+
+    def _merge_last_resort(self, handles: List,
+                           fw) -> ColumnarBatch:
+        """Max repartition depth reached: merge each piece under the
+        split-retry machinery (the true last resort), then cascade."""
+        from spark_rapids_tpu.mem import retry as R
+
+        merged = list(R.with_retry(handles, self._merge_pass_fn,
+                                   framework=fw))
+        return self._merge_to_one(merged)
 
     @staticmethod
     def final_from_partial(partial: "HashAggregateExec",

@@ -119,13 +119,30 @@ class HashJoinExec(BinaryExec):
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._prepare()
         with self.timer("buildTimeNs"):
-            build_batches = list(self.right.execute(partition))
-            if build_batches:
-                build = (build_batches[0] if len(build_batches) == 1
-                         else concat_jit(build_batches))
-            else:
-                from spark_rapids_tpu.columnar.batch import empty_batch
-                build = empty_batch(self.right.output_schema.types(), 16)
+            # collect the build side as spillable handles: while later build
+            # batches are still being produced, earlier ones can shed to
+            # host/disk under pool pressure (same door as agg buckets and
+            # out-of-core sort runs), then re-materialize for the concat
+            from spark_rapids_tpu.mem.spill import SpillableBatch, get_framework
+
+            fw = get_framework()
+            handles = [SpillableBatch(b, fw)
+                       for b in self.right.execute(partition)]
+            try:
+                if handles:
+                    build_batches = [h.get() for h in handles]
+                    try:
+                        build = (build_batches[0] if len(build_batches) == 1
+                                 else concat_jit(build_batches))
+                    finally:
+                        for h in handles:
+                            h.unpin()
+                else:
+                    from spark_rapids_tpu.columnar.batch import empty_batch
+                    build = empty_batch(self.right.output_schema.types(), 16)
+            finally:
+                for h in handles:
+                    h.close()
             dense = self._prepare_dense(build)
             table = jh = None
             if dense is None:

@@ -64,30 +64,14 @@ def reset_counters() -> None:
 # spill framework acquisition
 # ---------------------------------------------------------------------------
 
-_fw_lock = threading.Lock()
-_owned_fw = None  # strong ref: cleaner._frameworks is a WeakSet
-
-
 def _framework():
-    """A SpillFramework over the active pool. An already-registered
-    framework for that pool is reused — SpillFramework.__init__ installs
-    itself as the pool's spill callback, so stacking a second one over the
-    same pool would silently disconnect the first."""
-    from spark_rapids_tpu.mem import cleaner
-    from spark_rapids_tpu.mem.pool import get_pool
-    from spark_rapids_tpu.mem.spill import SpillFramework
+    """The shared SpillFramework over the active pool (mem/spill.py
+    get_framework): one framework serves the materialization cache,
+    aggregate repartition buckets, out-of-core sort and join build state,
+    so pool pressure sheds all of them through the same callback."""
+    from spark_rapids_tpu.mem.spill import get_framework
 
-    global _owned_fw
-    pool = get_pool()
-    with _fw_lock:
-        with cleaner._lock:
-            existing = [fw for fw in cleaner._frameworks
-                        if isinstance(fw, SpillFramework)
-                        and getattr(fw, "pool", None) is pool]
-        if existing:
-            return existing[0]
-        _owned_fw = SpillFramework(pool)
-        return _owned_fw
+    return get_framework()
 
 
 # ---------------------------------------------------------------------------

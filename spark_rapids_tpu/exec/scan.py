@@ -362,22 +362,49 @@ class ParquetScanExec(FileScanBase):
                 f" {self.reader_type}]{cols}")
 
     # -- planning ----------------------------------------------------------
+    def _plan_file(self, path: str):
+        """Footer + row-group metadata for ONE file (threadpool worker).
+
+        Returns (kept_row_groups, total, pruned, dyn_pruned); metric counters
+        are applied by the caller on the planning thread so concurrent
+        workers never race the metric objects."""
+        md = pq.ParquetFile(path).metadata
+        keep, pruned, dyn_pruned = [], 0, 0
+        for rg in range(md.num_row_groups):
+            if (self.predicate is not None and _rg_pruning_on()
+                    and self._prune(md, rg)):
+                pruned += 1
+                continue
+            if self.dynamic_filters and self._dyn_prune(md, rg):
+                dyn_pruned += 1
+                continue
+            keep.append(rg)
+        return keep, md.num_row_groups, pruned, dyn_pruned
+
     def _tasks_for_partition(self, partition: int) -> List[RowGroupTask]:
         files = self._files_for_partition(partition)
+        if not files:
+            return []
+        # footer reads are small random I/O: a bounded pool overlaps them
+        # across files (the reference reads footers on the multithreaded
+        # reader's pool for the same reason)
+        from spark_rapids_tpu.config import conf as C
+
+        n_threads = min(int(C.SCAN_METADATA_THREADS.get(C.get_active())),
+                        len(files))
+        if n_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=n_threads,
+                                    thread_name_prefix="pq-meta") as ex:
+                planned = list(ex.map(self._plan_file, files))
+        else:
+            planned = [self._plan_file(p) for p in files]
         tasks = []
-        for path in files:
-            md = pq.ParquetFile(path).metadata
-            keep = []
-            for rg in range(md.num_row_groups):
-                self.metrics["numRowGroups"].add(1)
-                if (self.predicate is not None and _rg_pruning_on()
-                        and self._prune(md, rg)):
-                    self.metrics["numPrunedRowGroups"].add(1)
-                    continue
-                if self.dynamic_filters and self._dyn_prune(md, rg):
-                    self.metrics["numDynPrunedRowGroups"].add(1)
-                    continue
-                keep.append(rg)
+        for path, (keep, total, pruned, dyn_pruned) in zip(files, planned):
+            self.metrics["numRowGroups"].add(total)
+            self.metrics["numPrunedRowGroups"].add(pruned)
+            self.metrics["numDynPrunedRowGroups"].add(dyn_pruned)
             if keep:
                 tasks.append(RowGroupTask(path, keep))
         return tasks

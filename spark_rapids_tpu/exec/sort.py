@@ -96,9 +96,16 @@ class SortExec(UnaryExec):
                     yield self._run(b)
             return
         if self.out_of_core:
+            fw = self.spill_framework
+            if fw is None:
+                # same-door default: runs shed through the process spill
+                # framework under pool pressure like agg buckets and join
+                # build state, instead of pinning every run in HBM
+                from spark_rapids_tpu.mem.spill import get_framework
+                fw = get_framework()
             yield from OutOfCoreSortIterator(
                 self.child.execute(partition), tuple(self._specs),
-                self.target_rows, self.spill_framework)
+                self.target_rows, fw)
             return
         batches = list(self.child.execute(partition))
         if not batches:

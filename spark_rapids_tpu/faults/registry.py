@@ -16,8 +16,9 @@ Schedule grammar (``spark.rapids.tpu.test.faults``)::
     io.decode:error@file=*.parquet;executor:kill@id=1
 
 Sites (see docs/fault_injection.md for the catalog): ``mem.alloc``,
-``io.decode``, ``shuffle.serialize``, ``shuffle.fetch``, ``shuffle.block``,
-``parallel.exchange``, ``executor``.
+``mem.spill``, ``io.decode``, ``shuffle.serialize``, ``shuffle.fetch``,
+``shuffle.block``, ``parallel.exchange``, ``executor``,
+``agg.repartition``.
 
 Actions: ``retry`` (RetryOOM), ``split`` (SplitAndRetryOOM), ``drop``
 (TimeoutError), ``error`` (FaultInjectedError), ``corrupt`` (bit-flip,
@@ -43,8 +44,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-_SITES = ("mem.alloc", "io.decode", "shuffle.serialize", "shuffle.fetch",
-          "shuffle.block", "parallel.exchange", "executor")
+_SITES = ("mem.alloc", "mem.spill", "io.decode", "shuffle.serialize",
+          "shuffle.fetch", "shuffle.block", "parallel.exchange", "executor",
+          "agg.repartition")
 _ACTIONS = ("retry", "split", "drop", "error", "corrupt", "slow", "stall",
             "kill")
 
@@ -66,6 +68,7 @@ class _Rule:
         self.action = action
         self.params = params
         self.file_glob: Optional[str] = params.get("file")  # type: ignore
+        self.op: Optional[str] = params.get("op")  # type: ignore
         self.worker_id: Optional[int] = params.get("id")  # type: ignore
         self.ms = float(params.get("ms", 2000 if action == "stall" else 50))
         self.p: Optional[float] = params.get("p")  # type: ignore
@@ -82,6 +85,9 @@ class _Rule:
             f = ctx.get("file")
             if f is None or not fnmatch.fnmatch(str(f), self.file_glob):
                 return False
+        if self.op is not None and ctx.get("op") != self.op:
+            # sub-operation selector (e.g. mem.spill write vs read paths)
+            return False
         if self.worker_id is not None:
             wid = ctx.get("id")
             if wid is None or int(wid) != self.worker_id:
@@ -133,7 +139,7 @@ def parse_spec(spec: str) -> List[_Rule]:
                 params[k] = int(v)
             elif k in ("p", "ms"):
                 params[k] = float(v)
-            elif k == "file":
+            elif k in ("file", "op"):
                 params[k] = v.strip()
             else:
                 raise ValueError(f"unknown fault param {k!r} in {part!r}")

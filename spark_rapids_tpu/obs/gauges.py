@@ -33,6 +33,12 @@ CATALOG: "List[Tuple[str, str, str]]" = [
     ("spill_to_host_total", "counter", "Device->host spill events"),
     ("spill_to_disk_total", "counter", "Host->disk spill events"),
     ("spill_unspill_total", "counter", "Rematerializations of spilled batches"),
+    ("spill_chunks_total", "counter",
+     "Fixed-size spill chunks written (host or disk tier, docs/memory.md)"),
+    ("spill_chunk_bytes_total", "counter",
+     "Payload bytes written into spill chunks (post-codec)"),
+    ("agg_repartition_total", "counter",
+     "Oversized agg-state hash-repartition passes (docs/oversized_state.md)"),
     ("semaphore_wait_ns_total", "counter",
      "Nanoseconds tasks waited to enter the device"),
     ("semaphore_acquire_total", "counter", "Semaphore acquire calls"),
@@ -132,6 +138,8 @@ def snapshot() -> Dict[str, int]:
         out["spill_to_host_total"] += fw.spilled_to_host_count
         out["spill_to_disk_total"] += fw.spilled_to_disk_count
         out["spill_unspill_total"] += fw.unspilled_count
+        out["spill_chunks_total"] += fw.chunks_written_count
+        out["spill_chunk_bytes_total"] += fw.chunk_bytes_written
     for sem in _sem.instances():
         out["semaphore_wait_ns_total"] += sem.total_wait_ns
         out["semaphore_acquire_total"] += sem.acquire_count
@@ -160,6 +168,8 @@ def snapshot() -> Dict[str, int]:
     out.update(_health.counters())
     from spark_rapids_tpu.obs import memtrack as _mt
     out.update(_mt.counters())
+    from spark_rapids_tpu.exec import aggregate as _agg
+    out.update(_agg.counters())
     return out
 
 

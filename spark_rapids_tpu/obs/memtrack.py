@@ -427,10 +427,31 @@ def _spill_states() -> List[Dict]:
                         "host_used": getattr(fw, "host_used", 0),
                         "spilled_to_host": fw.spilled_to_host_count,
                         "spilled_to_disk": fw.spilled_to_disk_count,
-                        "unspilled": fw.unspilled_count})
+                        "unspilled": fw.unspilled_count,
+                        "chunks_written": getattr(
+                            fw, "chunks_written_count", 0),
+                        "chunk_bytes_written": getattr(
+                            fw, "chunk_bytes_written", 0),
+                        "chunk_bytes": getattr(fw, "chunk_bytes", 0),
+                        "codec": getattr(fw, "codec", "none")})
         except Exception as ex:
             out.append({"error": repr(ex)})
     return out
+
+
+def _repartition_state() -> Optional[Dict]:
+    """Oversized-agg repartition context: which (depth, bucket) each thread
+    was merging, plus the process totals. Only reported when the aggregate
+    module is already loaded — a postmortem must not drag in the exec layer."""
+    import sys
+    agg = sys.modules.get("spark_rapids_tpu.exec.aggregate")
+    if agg is None:
+        return None
+    try:
+        return {"active": agg.active_repartitions(),
+                **agg.repartition_snapshot()}
+    except Exception as ex:
+        return {"error": repr(ex)}
 
 
 def _pool_states(pool=None) -> List[Dict]:
@@ -463,7 +484,8 @@ def dump_postmortem(reason: str, requested_bytes: int = 0,
     retry_history = {k: tm.get(k, 0) for k in (
         "retry_count", "split_and_retry_count", "oom_count",
         "spill_to_host_bytes", "spill_to_disk_bytes", "read_spill_bytes",
-        "semaphore_wait_ns")}
+        "semaphore_wait_ns", "agg_repartition_count",
+        "max_agg_repartition_depth")}
     snap = {
         "reason": reason,
         "ts": time.time(),
@@ -476,6 +498,7 @@ def dump_postmortem(reason: str, requested_bytes: int = 0,
         "live_allocations": ranked,
         "pools": _pool_states(pool),
         "spill": _spill_states(),
+        "agg_repartition": _repartition_state(),
         "semaphores": [s.snapshot() for s in _sem.instances()],
         "retry_history": retry_history,
         "journal_tail": _ev.recent(limit=120),

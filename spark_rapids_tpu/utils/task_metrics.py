@@ -22,8 +22,9 @@ class TaskMetrics:
         "retry_count", "split_and_retry_count", "oom_count",
         "spill_to_host_bytes", "spill_to_disk_bytes",
         "read_spill_bytes", "spill_time_ns", "read_spill_time_ns",
-        "semaphore_wait_ns",
+        "semaphore_wait_ns", "agg_repartition_count",
         "max_device_bytes", "max_host_bytes", "max_disk_bytes",
+        "max_agg_repartition_depth",
     )
 
     def __init__(self, task_id: int = 0):

@@ -25,3 +25,13 @@ for f in profiles.json journal.jsonl metrics.prom trace.json config.json \
     test -s "$OBS_OUT/$f" || { echo "obs_report smoke: missing $f" >&2; exit 1; }
 done
 echo "obs_report smoke OK: $OBS_OUT"
+
+# Capped-pool gauntlet smoke at SF1 (~2 min): same three gates as the full
+# SF10 scale lane (tests/run_scale_lane.sh), scaled down so this lane stays
+# in its minutes-each budget. Smaller batches keep store_sales multi-batch
+# at SF1 (a single partial has nothing to merge, hence no pressure to
+# prove). The SF10 artifact run is its own lane.
+SCALE_SF=1 SCALE_BATCH_ROWS=1048576 \
+    SCALE_OUT="${TMPDIR:-/tmp}/srtpu_scale_smoke.md" \
+    tests/run_scale_lane.sh
+echo "scale gauntlet smoke OK"

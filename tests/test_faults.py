@@ -524,10 +524,16 @@ def test_bench_refuses_gate_shrinkage_with_faults():
 
 def _chaos_spec():
     s = FAULTS_SEED
+    # mem.spill retry fires on the write path (recoverable: state untouched)
+    # and agg.repartition retries with backoff; corrupt on mem.spill reads
+    # is deliberately NOT here — a corrupted spilled chunk is unrecoverable
+    # by design and lives in its dedicated error-path test
     return (f"mem.alloc:retry@p=0.02,seed={s};"
             f"shuffle.block:corrupt@p=0.2,seed={s + 1};"
             f"shuffle.serialize:slow@p=0.05,ms=1,seed={s + 2};"
-            f"shuffle.fetch:drop@p=0.1,seed={s + 3}")
+            f"shuffle.fetch:drop@p=0.1,seed={s + 3};"
+            f"mem.spill:retry@op=write,p=0.05,seed={s + 4};"
+            f"agg.repartition:retry@p=0.1,seed={s + 5}")
 
 
 @pytest.fixture(scope="module")

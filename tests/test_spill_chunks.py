@@ -1,0 +1,220 @@
+"""Chunked spill tests (docs/memory.md): fixed-size CRC-guarded chunks,
+codec knob, bounce-buffer reuse, partial unspill, and the corrupt-chunk
+error path through the ``mem.spill`` fault site."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import (
+    batch_from_arrow,
+    batch_to_arrow,
+    dictionary_encode_table,
+)
+from spark_rapids_tpu.mem.pool import HbmPool
+from spark_rapids_tpu.mem.spill import (
+    DEFAULT_CHUNK_BYTES,
+    SpillCorruptionError,
+    SpillFramework,
+    SpillableBatch,
+)
+
+D = decimal.Decimal
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_jit_programs():
+    # Same rationale as tests/test_agg_repartition.py: the tiny-chunk
+    # round-trips compile one-off programs whose executables otherwise stay
+    # live all session and push XLA:CPU's cumulative jit-code footprint
+    # toward a compiler segfault in later unrelated compiles.
+    yield
+    import jax
+    jax.clear_caches()
+
+
+def _table(n=500):
+    rng = np.random.default_rng(7)
+    return pa.table({
+        "i": pa.array(rng.integers(0, 10_000, n), pa.int64()),
+        "f": pa.array(rng.random(n), pa.float64()),
+        "s": pa.array([f"str-{i % 97}" if i % 11 else None
+                       for i in range(n)], pa.string()),
+        "w": pa.array([D(f"{i}.123456789012345678") if i % 5 else None
+                       for i in range(n)], pa.decimal128(38, 18)),
+    })
+
+
+def _rows(batch, schema):
+    return batch_to_arrow(batch, schema).to_pylist()
+
+
+def _fw(tmp_path, pool_bytes=1 << 30, host_limit=1 << 30,
+        chunk_bytes=4096, codec="none"):
+    return SpillFramework(HbmPool(pool_bytes), host_limit_bytes=host_limit,
+                          spill_dir=str(tmp_path), chunk_bytes=chunk_bytes,
+                          codec=codec)
+
+
+def _spill_all(fw):
+    moved = fw.spill_device_bytes(1 << 62)
+    assert moved > 0
+    return moved
+
+
+@pytest.mark.parametrize("codec", ["none", "zlib"])
+def test_chunked_roundtrip_host_tier(tmp_path, codec):
+    """Mixed-type batch (int, float, strings, DECIMAL128 hi limbs) survives
+    the cut into many small chunks and back, per codec."""
+    t = _table()
+    schema = T.Schema.from_arrow(t.schema)
+    b = batch_from_arrow(t)
+    fw = _fw(tmp_path, chunk_bytes=4096, codec=codec)
+    h = SpillableBatch(b, fw)
+    expected = _rows(b, schema)
+
+    _spill_all(fw)
+    assert h.state == "HOST"
+    # the batch is far bigger than one 4KB chunk — the stream really was cut
+    assert fw.chunks_written_count > 4
+    assert fw.chunk_bytes_written > 0
+    if codec == "zlib":
+        # compressed payload accounting must reflect post-codec bytes
+        assert fw.chunk_bytes_written < h.nbytes
+
+    with h as back:
+        assert _rows(back, schema) == expected
+    assert h.state == "DEVICE"
+    h.close()
+    assert fw.pool.used == 0 and fw.host_used == 0
+
+
+def test_chunked_roundtrip_disk_tier(tmp_path):
+    """Chunks survive the host->disk append (one block file + index) and
+    stream back one chunk at a time."""
+    t = _table()
+    schema = T.Schema.from_arrow(t.schema)
+    b = batch_from_arrow(t)
+    fw = _fw(tmp_path, host_limit=16, chunk_bytes=4096)
+    h = SpillableBatch(b, fw)
+    expected = _rows(b, schema)
+    _spill_all(fw)
+    assert h.state == "DISK"
+    spill_files = list(tmp_path.iterdir())
+    assert len(spill_files) == 1
+    with h as back:
+        assert _rows(back, schema) == expected
+    # unspill-from-disk removes the block file
+    assert list(tmp_path.iterdir()) == []
+    h.close()
+
+
+def test_dictionary_column_roundtrip(tmp_path):
+    """Dict columns spill their codes + dictionary buffers as-is and come
+    back still dictionary-encoded."""
+    t = pa.table({"k": pa.array([f"k{i % 5}" for i in range(400)],
+                                pa.string())})
+    enc = dictionary_encode_table(t)
+    b = batch_from_arrow(enc)
+    assert b.columns[0].is_dict
+    schema = T.Schema.from_arrow(t.schema)
+    fw = _fw(tmp_path, chunk_bytes=1024)
+    h = SpillableBatch(b, fw)
+    _spill_all(fw)
+    with h as back:
+        assert back.columns[0].is_dict
+        assert _rows(back, schema) == t.to_pylist()
+    h.close()
+
+
+def test_missing_codec_modules_fail_fast(tmp_path):
+    """lz4/zstd are gated on their modules; this environment has neither,
+    so construction (not first spill) must raise a clear ValueError."""
+    for codec in ("lz4", "zstd"):
+        if codec == "lz4":
+            pytest.importorskip_not = None
+        try:
+            __import__("lz4.frame" if codec == "lz4" else "zstandard")
+            pytest.skip(f"{codec} module present in this environment")
+        except ImportError:
+            pass
+        with pytest.raises(ValueError, match=codec):
+            _fw(tmp_path, codec=codec)
+    with pytest.raises(ValueError, match="unknown spill codec"):
+        _fw(tmp_path, codec="snappy")
+
+
+def test_corrupt_chunk_detected_on_read(tmp_path):
+    """A chaos rule corrupting one written chunk payload must surface as
+    SpillCorruptionError at read-back (CRC is computed before the fault),
+    not as silent wrong data."""
+    t = _table()
+    b = batch_from_arrow(t)
+    fw = _fw(tmp_path, chunk_bytes=4096)
+    h = SpillableBatch(b, fw)
+    faults.install("mem.spill:corrupt@count=1,seed=5")
+    try:
+        _spill_all(fw)
+        assert h.state == "HOST"
+        with pytest.raises(SpillCorruptionError, match="CRC"):
+            h.get()
+    finally:
+        faults.install("")
+    # the failed get() released its pin; the handle is still closeable
+    h.close()
+    assert fw.pool.used == 0 and fw.host_used == 0
+
+
+def test_injected_write_fault_leaves_handle_recoverable(tmp_path):
+    """mem.spill:retry on the write path fires BEFORE any state moves: the
+    handle stays on device and a later spill succeeds."""
+    t = _table(100)
+    schema = T.Schema.from_arrow(t.schema)
+    b = batch_from_arrow(t)
+    fw = _fw(tmp_path)
+    h = SpillableBatch(b, fw)
+    expected = _rows(b, schema)
+    faults.install("mem.spill:retry@op=write,count=1")
+    try:
+        from spark_rapids_tpu.mem.pool import RetryOOM
+        with pytest.raises(RetryOOM):
+            fw.spill_device_bytes(1 << 62)
+        assert h.state == "DEVICE"
+    finally:
+        faults.install("")
+    _spill_all(fw)
+    assert h.state == "HOST"
+    with h as back:
+        assert _rows(back, schema) == expected
+    h.close()
+
+
+def test_bounce_buffer_reuse(tmp_path):
+    """Steady-state spill traffic leases the same staging buffers instead
+    of allocating per chunk."""
+    fw = _fw(tmp_path, chunk_bytes=2048)
+    assert fw.bounce.buf_bytes == 2048
+    handles = []
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        t = pa.table({"x": pa.array(rng.integers(0, 9, 2000), pa.int64())})
+        handles.append(SpillableBatch(batch_from_arrow(t), fw))
+    _spill_all(fw)
+    assert fw.bounce.leases >= 4
+    assert fw.bounce.reuses >= fw.bounce.leases - fw.bounce.max_buffers
+    for h in handles:
+        h.close()
+
+
+def test_default_chunk_bytes_from_conf(tmp_path):
+    """chunk_bytes/codec default from the active conf (SPILL_CHUNK_BYTES /
+    SPILL_CODEC) when not passed explicitly."""
+    fw = SpillFramework(HbmPool(1 << 30), host_limit_bytes=1 << 30,
+                        spill_dir=str(tmp_path))
+    assert fw.chunk_bytes == DEFAULT_CHUNK_BYTES
+    assert fw.codec == "none"
+    assert fw.bounce.buf_bytes == fw.chunk_bytes
