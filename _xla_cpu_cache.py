@@ -46,3 +46,11 @@ def cpu_feature_fingerprint() -> str:
 def cpu_cache_dir(tag: str = "srtpu_xla_cpu") -> str:
     return os.path.join(tempfile.gettempdir(),
                         f"{tag}_{cpu_feature_fingerprint()}")
+
+
+def program_cache_dir() -> str:
+    """Default location of the persistent jitted-program cache
+    (exec/jit_persist.py). Same feature-hash scheme as the XLA:CPU kernel
+    cache: the entry digest also folds the fingerprint in, so the
+    directory keying is belt-and-braces against cross-host sharing."""
+    return cpu_cache_dir("srtpu_jit_persist")

@@ -630,6 +630,164 @@ def main(budget_s=None, faults=None, pool_cap=None):
     }))
 
 
+def _latency_guard(environ):
+    """--latency is a regression gate (warm must beat cold); refuse the
+    BENCH_* env overrides that would change what the gate compares — the
+    same refuse-to-shrink contract as --faults/--pool-cap. LAT_* knobs
+    (scale, iteration counts) stay overridable: cold and warm always run
+    at the same scale, so they tune noise, not the comparison."""
+    banned = [k for k in ("BENCH_SF_H", "BENCH_SF_DS", "BENCH_RUNS",
+                          "BENCH_DEPTH") if k in environ]
+    if banned:
+        raise SystemExit(
+            f"--latency is set: refusing to run with correctness-gate "
+            f"overrides {banned} (the latency lane gates warm-vs-cold "
+            f"regressions and must control its own inputs)")
+
+
+def _pctiles_ms(samples_s):
+    """Exact nearest-rank p50/p95/p99 of wall-clock samples, in ms."""
+    s = sorted(samples_s)
+    if not s:
+        return {"p50": None, "p95": None, "p99": None}
+
+    def pct(q):
+        return s[min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))]
+
+    return {p: round(pct(q) * 1e3, 3)
+            for p, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))}
+
+
+def latency_main(budget_s=None, out_path="artifacts/latency.json"):
+    """Interactive-latency lane: N cold + N warm iterations of q1/q6/q3 at
+    a small scale factor, reporting wall p50/p95/p99 plus per-phase
+    (plan/compile/execute) percentiles read through the obs/histo.py
+    snapshot/diff windows. Cold iterations clear the in-process plan memo
+    and jit cache (a fresh process with the persistent program cache still
+    primed); warm iterations repeat the query so the plan memo and shared
+    jits serve it. Writes an artifact and gates warm-vs-cold regressions;
+    the final driver-metric line is emitted even when the budget truncates
+    iterations (partial samples still summarize)."""
+    from spark_rapids_tpu.bench import tpch
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.exec import jit_cache
+    from spark_rapids_tpu.obs import gauges as G
+    from spark_rapids_tpu.obs import histo as _histo
+    from spark_rapids_tpu.plan import from_arrow
+    from spark_rapids_tpu.plan import plan_cache
+
+    _latency_guard(os.environ)
+    sf = float(os.environ.get("LAT_SF", 0.1))
+    cold_n = int(os.environ.get("LAT_COLD_ITERS", 4))
+    warm_n = int(os.environ.get("LAT_WARM_ITERS", 12))
+    names = ["q1", "q6", "q3"]
+    bud = _Budget(budget_s)
+    conf = RapidsConf()
+
+    _mark(f"latency lane: sf={sf} cold={cold_n} warm={warm_n}")
+    tables = {
+        "lineitem": tpch.gen_lineitem(sf, seed=7),
+        "orders": tpch.gen_orders(sf, seed=8),
+        "customer": tpch.gen_customer(sf, seed=9),
+        "supplier": tpch.gen_supplier(sf, seed=10),
+        "nation": tpch.gen_nation(),
+        "region": tpch.gen_region(),
+    }
+
+    def run_once(qn):
+        """Build the DataFrame fresh (the interactive arrival shape) and
+        execute; returns end-to-end seconds including planning."""
+        d = {k: from_arrow(v, conf) for k, v in tables.items()}
+        t0 = time.perf_counter()
+        tpch.DF_QUERIES[qn](d).to_arrow()
+        return time.perf_counter() - t0
+
+    phase_names = ("plan_phase_ns", "compile_phase_ns", "execute_phase_ns")
+
+    def phase_window(snap0):
+        snap1 = _histo.snapshot_all()
+        out = {}
+        for n in phase_names:
+            d = _histo.diff(snap0[n], snap1[n])
+            out[n.removesuffix("_phase_ns")] = \
+                _histo.get(n).percentiles_ms(d)
+        return out
+
+    g0 = G.snapshot()
+    results = {}
+    gates = {}
+    try:
+        for qn in names:
+            cold_walls, warm_walls = [], []
+            snap = _histo.snapshot_all()
+            for i in range(cold_n):
+                # cold = fresh-process shape: no plan memo, no in-process
+                # jits (the persistent program cache still serves, which
+                # is exactly the warm-start story being measured)
+                plan_cache.clear()
+                jit_cache._CACHE.clear()
+                cold_walls.append(run_once(qn))
+                if bud.enabled and bud.remaining() < 0.25 * bud.total:
+                    break
+            cold_phases = phase_window(snap)
+            snap = _histo.snapshot_all()
+            for i in range(warm_n):
+                warm_walls.append(run_once(qn))
+                if bud.enabled and bud.remaining() < 0.15 * bud.total:
+                    break
+            warm_phases = phase_window(snap)
+            results[qn] = {
+                "cold": {"iters": len(cold_walls),
+                         "wall_ms": _pctiles_ms(cold_walls),
+                         "phases_ms": cold_phases},
+                "warm": {"iters": len(warm_walls),
+                         "wall_ms": _pctiles_ms(warm_walls),
+                         "phases_ms": warm_phases},
+            }
+            _mark(f"{qn}: cold p50 "
+                  f"{results[qn]['cold']['wall_ms']['p50']}ms, warm p50 "
+                  f"{results[qn]['warm']['wall_ms']['p50']}ms")
+    finally:
+        g1 = G.snapshot()
+        counters = {k: g1[k] - g0.get(k, 0) for k in
+                    ("plan_cache_hit_total", "plan_cache_miss_total",
+                     "jit_persist_hit_total", "jit_persist_store_total",
+                     "jit_cache_miss_total")}
+        # regression gates: a warm repeat must actually be served by the
+        # caches (hits observed) and must not be slower than cold
+        for qn, r in results.items():
+            cold50 = r["cold"]["wall_ms"]["p50"]
+            warm50 = r["warm"]["wall_ms"]["p50"]
+            ok = (cold50 is not None and warm50 is not None
+                  and warm50 <= cold50 * 1.10)  # 10% noise allowance
+            gates[f"{qn}_warm_not_slower"] = bool(ok)
+        gates["plan_cache_served"] = counters["plan_cache_hit_total"] > 0
+        artifact = {
+            "sf": sf, "queries": names,
+            "results": results, "counters": counters, "gates": gates,
+        }
+        out_dir = os.path.dirname(out_path)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        warm50s = [r["warm"]["wall_ms"]["p50"] for r in results.values()
+                   if r["warm"]["wall_ms"]["p50"] is not None]
+        print(json.dumps({"latency": results, "counters": counters,
+                          "gates": gates, "artifact": out_path}))
+        print(json.dumps({
+            "metric": "latency_warm_wall_p50_ms",
+            "value": (round(sum(warm50s) / len(warm50s), 3)
+                      if warm50s else None),
+            "unit": "ms",
+            "queries": names,
+            "gates_passed": all(gates.values()) if gates else False,
+        }))
+    if gates and not all(gates.values()):
+        raise SystemExit(f"latency gates failed: "
+                         f"{[k for k, v in gates.items() if not v]}")
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -654,11 +812,24 @@ if __name__ == "__main__":
                          "the correctness gates still compare full "
                          "results; refuses BENCH_* overrides like "
                          "--faults, docs/oversized_state.md)")
+    ap.add_argument("--latency", action="store_true",
+                    help="run the interactive-latency lane instead of the "
+                         "throughput sweep: N cold + N warm iterations of "
+                         "q1/q6/q3, cold/warm p50/p95/p99 wall and "
+                         "per-phase (plan/compile/execute) percentiles, "
+                         "an artifact, and warm-vs-cold regression gates "
+                         "(docs/latency.md)")
+    ap.add_argument("--latency-out", type=str,
+                    default="artifacts/latency.json", metavar="PATH",
+                    help="artifact path for --latency results")
     _args = ap.parse_args()
     if _args.budget is None and not sys.stdout.isatty():
         # non-interactive bare run (CI/harness): a full unbudgeted sweep can
         # outlive the caller's timeout and lose the final metric line —
         # default to a conservative budget instead
         _args.budget = float(os.environ.get("SRTPU_BENCH_BUDGET_S", "600"))
-    main(budget_s=_args.budget, faults=_args.faults,
-         pool_cap=_args.pool_cap)
+    if _args.latency:
+        latency_main(budget_s=_args.budget, out_path=_args.latency_out)
+    else:
+        main(budget_s=_args.budget, faults=_args.faults,
+             pool_cap=_args.pool_cap)

@@ -653,6 +653,67 @@ REUSE_CACHE_MAX_ENTRIES = conf(
     check=lambda v: None if v >= 1 else "must be >= 1")
 
 
+# ---------------------------------------------------------------------------
+# Round-9 interactive-latency knobs (plan/plan_cache.py, exec/jit_persist.py,
+# the small-query fast path; docs/latency.md)
+# ---------------------------------------------------------------------------
+
+PLAN_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.plan.cache.enabled", default=True,
+    doc="Memoize the full Overrides.apply rewrite pipeline (rewrite -> "
+        "reuse -> fusion -> prefetch insertion) keyed by a canonical "
+        "logical-plan fingerprint plus the session configuration. A repeat "
+        "arrival of a rename-equal query reuses the already-built physical "
+        "plan instead of re-running every rule; any conf change or "
+        "plan_cache.bump_epoch() invalidates (plan/plan_cache.py; the "
+        "plan-rewrite analog of the reference plugin's kernel amortization, "
+        "docs/latency.md).")
+
+PLAN_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.plan.cache.maxEntries", default=128,
+    doc="Cap on memoized physical plans held by the plan-rewrite cache; "
+        "least-recently-used entries are evicted past the cap.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+JIT_PERSIST_ENABLED = conf(
+    "spark.rapids.tpu.jit.persist.enabled", default=True,
+    doc="Persist jitted programs (per-expression and fused-stage batch "
+        "functions) to an on-disk cache via jax.export so a fresh process "
+        "reloads serialized executables instead of re-tracing and "
+        "re-compiling them. Entries are keyed by the semantic shared_jit "
+        "key plus jax version, backend, and the host CPU-feature "
+        "fingerprint; a corrupt or mismatched entry is discarded and the "
+        "program recompiled (exec/jit_persist.py, docs/latency.md).")
+
+JIT_PERSIST_DIR = conf(
+    "spark.rapids.tpu.jit.persist.dir", default="",
+    doc="Directory for the persistent jitted-program cache. Empty (the "
+        "default) selects a temp-dir path keyed by the CPU-feature "
+        "fingerprint, the same scheme the XLA:CPU kernel cache uses "
+        "(_xla_cpu_cache.py), so feature-set changes land in a fresh cache.")
+
+FASTPATH_ENABLED = conf(
+    "spark.rapids.tpu.fastpath.enabled", default=True,
+    doc="Execute small queries on an interactive fast path: when every "
+        "leaf's estimated rows and bytes sit below the fastpath.maxRows/"
+        "maxBytes thresholds, plan a single partition (no shuffle "
+        "machinery), skip prefetch-thread insertion, and bypass the task "
+        "semaphore — the per-query fixed costs dominate such queries, not "
+        "the data (docs/latency.md).")
+
+FASTPATH_MAX_ROWS = conf(
+    "spark.rapids.tpu.fastpath.maxRows", default=100_000,
+    doc="Estimated-row ceiling (summed over scan leaves) below which a "
+        "query qualifies for the small-query fast path.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+FASTPATH_MAX_BYTES = conf(
+    "spark.rapids.tpu.fastpath.maxBytes", default=32 << 20,
+    doc="Estimated-byte ceiling (summed over scan leaves) below which a "
+        "query qualifies for the small-query fast path.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+
 _ACTIVE: "Optional[RapidsConf]" = None
 
 

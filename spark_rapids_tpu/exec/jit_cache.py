@@ -24,8 +24,6 @@ import threading
 import time
 from typing import Callable, Dict
 
-import jax
-
 _CACHE: Dict[tuple, Callable] = {}
 _LOCK = threading.Lock()
 _HITS = 0
@@ -65,7 +63,13 @@ def shared_jit(key: tuple, make: Callable[[], Callable]) -> Callable:
             fn = _CACHE.get(key)
             if fn is None:
                 _MISSES += 1
-                fn = _CACHE[key] = _timed_first_call(jax.jit(make()))
+                # jit_persist may serve the program from the on-disk
+                # cross-process cache instead of tracing it; either way the
+                # first call is timed as compile cost (a persisted load is
+                # just a much cheaper "compile").
+                from spark_rapids_tpu.exec import jit_persist
+                fn = _CACHE[key] = _timed_first_call(
+                    jit_persist.bind(key, make))
                 return fn
     _HITS += 1
     return fn

@@ -104,3 +104,21 @@ class TaskSemaphore:
 
     def held(self, task_id: int) -> "TaskSemaphore._Ctx":
         return TaskSemaphore._Ctx(self, task_id)
+
+
+_process_sem: Optional[TaskSemaphore] = None
+_process_lock = threading.Lock()
+
+
+def get_task_semaphore() -> TaskSemaphore:
+    """Process-wide semaphore gating device partition drains
+    (plan/dataframe.py holds it around each output partition; the
+    small-query fast path bypasses it). Permits come from
+    spark.rapids.tpu.sql.concurrentTpuTasks at first use."""
+    global _process_sem
+    with _process_lock:
+        if _process_sem is None:
+            from spark_rapids_tpu.config import conf as C
+            _process_sem = TaskSemaphore(
+                permits=C.get_active()[C.CONCURRENT_TASKS])
+        return _process_sem

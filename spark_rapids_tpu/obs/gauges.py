@@ -58,6 +58,30 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "Nanoseconds spent in first calls of newly-traced programs "
      "(compile-cost attribution for QueryProfile phases)"),
     ("jit_cache_size", "gauge", "Distinct jitted programs currently cached"),
+    ("jit_persist_hit_total", "counter",
+     "Jitted programs reloaded from the on-disk cross-process cache "
+     "(exec/jit_persist.py) instead of being re-traced"),
+    ("jit_persist_miss_total", "counter",
+     "Persistent-cache lookups that found no usable entry"),
+    ("jit_persist_store_total", "counter",
+     "Programs exported and written to the persistent cache"),
+    ("jit_persist_bytes_total", "counter",
+     "Serialized bytes written to the persistent cache"),
+    ("jit_persist_error_total", "counter",
+     "Corrupt/mismatched/unexportable entries handled by falling back to "
+     "a fresh trace (never an error surfaced to the query)"),
+    ("jit_persist_load_ns_total", "counter",
+     "Nanoseconds spent deserializing persisted programs"),
+    ("plan_cache_hit_total", "counter",
+     "Queries whose whole rewrite pipeline was served by the plan memo "
+     "(plan/plan_cache.py)"),
+    ("plan_cache_miss_total", "counter",
+     "Memoizable plans that ran the full rewrite pipeline and were stored"),
+    ("plan_cache_evict_total", "counter",
+     "Plan-memo entries evicted by the LRU cap"),
+    ("plan_cache_uncacheable_total", "counter",
+     "Plans refused by the memo (unfingerprintable node or expression)"),
+    ("plan_cache_size", "gauge", "Memoized physical plans currently held"),
     ("prefetch_depth", "gauge",
      "Batches currently held ready in prefetch queues"),
     ("prefetch_stalls", "counter",
@@ -156,6 +180,10 @@ def snapshot() -> Dict[str, int]:
         out["filecache_cached_bytes"] += fc.cached_bytes
     from spark_rapids_tpu.exec import jit_cache as _jc
     out.update(_jc.cache_stats())
+    from spark_rapids_tpu.exec import jit_persist as _jp
+    out.update(_jp.counters())
+    from spark_rapids_tpu.plan import plan_cache as _pc
+    out.update(_pc.counters())
     from spark_rapids_tpu.exec import pipeline as _pl
     out.update(_pl.STATS.snapshot())
     from spark_rapids_tpu import faults as _faults

@@ -33,6 +33,13 @@ CATALOG: "List[Tuple[str, str]]" = [
     ("batch_op_ns", "Per-operator per-batch device compute time"),
     ("shuffle_fetch_ns", "Shuffle block fetch round-trip time"),
     ("retry_backoff_ns", "Time slept in OOM/fetch retry backoff"),
+    ("plan_phase_ns",
+     "Per-query planning time (rewrite/reuse/fusion/prefetch, or the "
+     "plan-cache lookup on a memo hit)"),
+    ("compile_phase_ns",
+     "Per-query trace+compile time attributed by the jit first-call timer"),
+    ("execute_phase_ns",
+     "Per-query execute-window time (wall minus compile attribution)"),
 ]
 
 _enabled = True

@@ -139,6 +139,14 @@ class QueryProfile:
             self.metrics = root.collect_metrics()
         if first:
             _histo.record("query_wall_ns", self.wall_ns)
+            # per-phase distributions (bench --latency reads these through
+            # snapshot/diff windows, so cold and warm tails separate)
+            plan_ms = sum(v for k, v in self.phases.items()
+                          if k not in ("compile", "execute"))
+            _histo.record("plan_phase_ns", int(plan_ms * 1e6))
+            _histo.record("compile_phase_ns", compile_ns)
+            _histo.record("execute_phase_ns",
+                          max(0, self.wall_ns - compile_ns))
             _events.emit("finish", query_id=self.query_id,
                          wall_ms=_ns_ms(self.wall_ns),
                          compile_ms=self.phases["compile"])

@@ -1803,11 +1803,20 @@ class CpuSortExec(CpuExec, UnaryExec):
             nulls_first = (o.nulls_first if o.nulls_first is not None
                            else o.ascending)
             cur = t if idx is None else t.take(idx)
-            order = pc.sort_indices(
-                cur.column(b.index),
-                sort_keys=[("", "ascending" if o.ascending else "descending")],
-                null_placement="at_start" if nulls_first else "at_end",
-            )
+            direction = "ascending" if o.ascending else "descending"
+            placement = "at_start" if nulls_first else "at_end"
+            try:
+                # pyarrow >= 25: null_placement is specified per sort key
+                # (the global SortOptions kwarg is deprecated there)
+                order = pc.sort_indices(
+                    cur.column(b.index),
+                    sort_keys=[("", direction, placement)])
+            except (TypeError, ValueError):
+                # older pyarrow only understands 2-tuple keys + the kwarg
+                order = pc.sort_indices(
+                    cur.column(b.index),
+                    sort_keys=[("", direction)],
+                    null_placement=placement)
             idx = order if idx is None else idx.take(order)
         yield t.take(idx)
 

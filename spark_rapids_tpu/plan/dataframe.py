@@ -232,9 +232,22 @@ class DataFrame:
                 for p in range(node.num_partitions()):
                     tables.extend(node.execute_host(p))
             else:
+                # each output-partition drain holds the device semaphore
+                # (GpuSemaphore analog); the small-query fast path skips
+                # the round-trip — its whole point is shedding fixed costs
+                from spark_rapids_tpu.mem.semaphore import get_task_semaphore
+
+                sem = (None if getattr(node, "_fastpath", False)
+                       else get_task_semaphore())
                 for p in range(node.num_partitions()):
-                    for b in node.execute(p):
-                        tables.append(batch_to_arrow(b, schema))
+                    if sem is not None:
+                        sem.acquire(p)
+                    try:
+                        for b in node.execute(p):
+                            tables.append(batch_to_arrow(b, schema))
+                    finally:
+                        if sem is not None:
+                            sem.release(p)
             had_error = False
         finally:
             # close out the per-query profile (plan/overrides.py installed

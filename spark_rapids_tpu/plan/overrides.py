@@ -584,6 +584,43 @@ class Overrides:
               [E.col(named(e)) for e in plan.agg_exprs]
         return L.Project(out, reg_plan)
 
+    def _fastpath_eligible(self, plan: L.LogicalPlan) -> bool:
+        """True when every scan leaf is provably below the fastpath
+        row/byte thresholds — sizes read from in-memory tables and parquet
+        footers only (cbo.estimate_rows reads the same metadata). Any leaf
+        we cannot bound disqualifies the query; an estimate that later
+        grows only costs speed (single partition), never correctness."""
+        if not self.conf[C.FASTPATH_ENABLED]:
+            return False
+        import os as _os
+
+        rows = 0
+        nbytes = 0
+        stack = [plan]
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children)
+                continue
+            if isinstance(n, L.InMemoryScan):
+                rows += n.table.num_rows
+                nbytes += n.table.nbytes
+            elif isinstance(n, L.ParquetScan):
+                if len(n.paths) > 16:
+                    return False  # footer reads would swamp the win
+                try:
+                    import pyarrow.parquet as _pq
+
+                    for p in n.paths:
+                        rows += _pq.ParquetFile(p).metadata.num_rows
+                        nbytes += _os.path.getsize(p)
+                except Exception:
+                    return False
+            else:
+                return False
+        return (rows <= self.conf[C.FASTPATH_MAX_ROWS]
+                and nbytes <= self.conf[C.FASTPATH_MAX_BYTES])
+
     def apply(self, plan: L.LogicalPlan) -> TpuExec:
         import time as _time
 
@@ -614,6 +651,34 @@ class Overrides:
 
             prof = QueryProfile(description=plan.describe(), conf=self.conf,
                                 capture_trace=self.conf[C.PROFILE_TRACE])
+        # plan-rewrite memo (plan/plan_cache.py): a repeat arrival of a
+        # rename-equal plan under the same conf reuses the physical tree
+        # built the first time instead of re-running the whole pipeline
+        from spark_rapids_tpu.plan import plan_cache as _pc
+
+        memo_key = None
+        pinned: list = []
+        if self.conf[C.PLAN_CACHE_ENABLED]:
+            t_lk = _time.perf_counter_ns()
+            memo_key = _pc.build_key(plan, self.conf,
+                                     self.shuffle_partitions, pinned)
+            entry = _pc.lookup(memo_key) if memo_key is not None else None
+            if entry is not None:
+                lookup_ns = _time.perf_counter_ns() - t_lk
+                if C.EXPLAIN.get(self.conf) != "NONE":
+                    print("[plan-cache hit]\n" + entry.explain)
+                if prof is not None:
+                    prof.note_phase("plan-cache", lookup_ns)
+                    prof.plan_explain = "[plan-cache hit]\n" + entry.explain
+                    prof.start().attach(entry.ex)
+                return entry.ex
+        # small-query fast path: when every scan leaf is provably tiny the
+        # fixed per-query machinery (shuffle, prefetch threads, semaphore)
+        # costs more than the data — plan one partition and skip it all
+        fastpath = self._fastpath_eligible(plan)
+        orig_parts = self.shuffle_partitions
+        if fastpath:
+            self.shuffle_partitions = 1
         t0 = _time.perf_counter_ns()
         if C.SQL_ENABLED.get(self.conf):
             plan = self._rewrite_distinct(plan)
@@ -624,6 +689,7 @@ class Overrides:
         if self.conf[_cbo.CBO_ENABLED]:
             _cbo.CostBasedOptimizer(self.conf).optimize(meta)
         ex = self._convert(meta)
+        self.shuffle_partitions = orig_parts
         t1 = _time.perf_counter_ns()
         # computation reuse BEFORE fusion: fused stages must see the
         # ReusedExchange/ReusedBroadcast leaves so a deduped subtree is
@@ -640,20 +706,29 @@ class Overrides:
         t3 = _time.perf_counter_ns()
         # async pipeline boundaries go in AFTER fusion: a fused stage is one
         # consumer, and its scan/shuffle inputs are exactly the seams the
-        # prefetch workers overlap (exec/pipeline.py)
-        from spark_rapids_tpu.exec.pipeline import insert_prefetch
+        # prefetch workers overlap (exec/pipeline.py). The fast path skips
+        # them: for a tiny single-partition query the worker threads cost
+        # more than the overlap buys.
+        if not fastpath:
+            from spark_rapids_tpu.exec.pipeline import insert_prefetch
 
-        ex = insert_prefetch(ex, self.conf)
+            ex = insert_prefetch(ex, self.conf)
+        ex._fastpath = fastpath
         t4 = _time.perf_counter_ns()
         mode = C.EXPLAIN.get(self.conf)
         if mode != "NONE":
             print(explain(meta, mode))
+        explain_all = (explain(meta, "ALL")
+                       if memo_key is not None or prof is not None else "")
+        if memo_key is not None:
+            _pc.store(memo_key, ex, explain_all, fastpath, pinned,
+                      self.conf)
         if prof is not None:
             prof.note_phase("plan-rewrite", t1 - t0)
             prof.note_phase("reuse", t2 - t1)
             prof.note_phase("fusion", t3 - t2)
             prof.note_phase("prefetch", t4 - t3)
-            prof.plan_explain = explain(meta, "ALL")
+            prof.plan_explain = explain_all
             prof.start().attach(ex)
         return ex
 

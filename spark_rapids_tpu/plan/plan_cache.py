@@ -1,0 +1,253 @@
+"""Memoized plan rewrite: skip the whole Overrides.apply pipeline for a
+repeat arrival of a semantically-equal logical plan.
+
+The rewrite pipeline (distinct rewrite -> path rules -> CBO -> conversion
+-> exchange reuse -> fusion -> prefetch insertion) is pure with respect to
+(logical plan, session conf, shuffle partitioning): the same inputs always
+build the same physical tree, and physical trees are re-executable by
+design (exchange ``cleanup()`` resets written state, SharedExchangeEntry
+refcounts reset at zero). So the second arrival of an equal query can
+reuse the first one's physical plan outright — the per-request planning
+cost the reference plugin amortizes across queries (SURVEY §2.2).
+
+Keys are *semantic*, built the same way as plan/reuse.py subtree
+fingerprints: expressions are resolved positionally against child schemas
+and scrubbed of attribute names, so a pure intermediate rename hits while
+any literal/parameter change misses. The key additionally pins
+
+- the FINAL output column names (the cached tree's arrow output carries
+  its own names, so output renames must miss),
+- the full session conf (sorted over every registered + explicit key — any
+  conf change is automatically a miss) plus a manual ``bump_epoch()``,
+- the shuffle partitioning and the identity of the default shuffle
+  manager (exchanges bind their manager at construction),
+- for in-memory scans, the identity of the source table, weakref-guarded
+  in the entry so a garbage-collected table can never alias a new one
+  through id reuse (the overrides._device_source_parts pattern).
+
+A node or expression whose key cannot be extracted safely makes the whole
+plan unmemoizable (never cached, never served) — unknown shapes cost a
+missed memo, never a wrong plan. The cache assumes the engine's existing
+one-query-at-a-time execution model (obs/memtrack.py makes the same
+assumption); concurrent re-execution of one physical tree is not safe.
+
+Counters are exported as ``srtpu_plan_cache_*`` gauges (obs/gauges.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.reuse import _expr_key, _exprs_key
+
+_LOCK = threading.RLock()
+_CACHE: "OrderedDict[tuple, _Entry]" = OrderedDict()
+_EPOCH = 0
+_HITS = 0
+_MISSES = 0
+_EVICTIONS = 0
+_UNCACHEABLE = 0
+
+
+class Unfingerprintable(Exception):
+    """Raised while fingerprinting a plan the memo must not cache."""
+
+
+class _Entry:
+    """One memoized physical plan plus identity guards for every object
+    the key pins by ``id()``: a dead or replaced object invalidates the
+    entry (id reuse after gc must never alias)."""
+
+    __slots__ = ("ex", "explain", "fastpath", "_guard_ids", "_guards")
+
+    def __init__(self, ex, explain_text: str, fastpath: bool, pinned):
+        self.ex = ex
+        self.explain = explain_text
+        self.fastpath = fastpath
+        self._guard_ids = [id(o) for o in pinned]
+        self._guards = []
+        for o in pinned:
+            try:
+                self._guards.append(weakref.ref(o))
+            except TypeError:
+                # not weakref-able: hold it strongly — the LRU cap bounds
+                # how long, and a live strong ref cannot recycle its id
+                self._guards.append(lambda o=o: o)
+
+    def valid(self) -> bool:
+        for i, ref in zip(self._guard_ids, self._guards):
+            o = ref()
+            if o is None or id(o) != i:
+                return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# logical-plan fingerprints
+# ---------------------------------------------------------------------------
+
+
+def _order_key(order, schema: T.Schema) -> tuple:
+    return (_expr_key(order.child, schema), order.ascending,
+            order.nulls_first)
+
+
+def _local_key(plan: L.LogicalPlan, pinned: List) -> tuple:
+    if isinstance(plan, L.ParquetScan):
+        pred = (plan.predicate.cache_key()
+                if plan.predicate is not None else None)
+        cols = tuple(plan.columns) if plan.columns is not None else None
+        return ("parquet", tuple(plan.paths), cols, pred)
+    if isinstance(plan, L.InMemoryScan):
+        pinned.append(plan.table)
+        return ("inmem", id(plan.table), plan.batch_rows, plan.partitions)
+    if isinstance(plan, L.Project):
+        return ("project", _exprs_key(plan.exprs, plan.child.schema))
+    if isinstance(plan, L.Filter):
+        return ("filter", _expr_key(plan.condition, plan.child.schema))
+    if isinstance(plan, L.Aggregate):
+        cs = plan.child.schema
+        return ("agg", _exprs_key(plan.group_exprs, cs),
+                _exprs_key(plan.agg_exprs, cs))
+    if isinstance(plan, L.Window):
+        # window expressions carry (partition, order, frame) specs that
+        # resolve piecewise; their raw cache_key keeps names, so a rename
+        # above a window misses — a missed memo, never a wrong plan
+        return ("window", tuple(e.cache_key() for e in plan.window_exprs))
+    if isinstance(plan, L.Sort):
+        cs = plan.child.schema
+        return ("sort", tuple(_order_key(o, cs) for o in plan.orders),
+                plan.is_global, plan.limit)
+    if isinstance(plan, L.Join):
+        joint = T.Schema(list(plan.left.schema) + list(plan.right.schema))
+        cond = (_expr_key(plan.condition, joint)
+                if plan.condition is not None else None)
+        return ("join", plan.join_type,
+                _exprs_key(plan.left_keys, plan.left.schema),
+                _exprs_key(plan.right_keys, plan.right.schema), cond)
+    if isinstance(plan, L.Limit):
+        return ("limit", plan.n, plan.offset)
+    if isinstance(plan, L.Union):
+        return ("union", len(plan.inputs))
+    raise Unfingerprintable(type(plan).__name__)
+
+
+def logical_fingerprint(plan: L.LogicalPlan, pinned: List) -> tuple:
+    """Semantic key of a logical subtree (name-scrubbed, literal-keeping),
+    appending every ``id()``-pinned source object to ``pinned``. Raises
+    Unfingerprintable when any node/expression resists safe keying."""
+    try:
+        local = _local_key(plan, pinned)
+    except Unfingerprintable:
+        raise
+    except Exception as e:
+        raise Unfingerprintable(f"{type(plan).__name__}: {e}") from e
+    kids = tuple(logical_fingerprint(c, pinned) for c in plan.children)
+    return (type(plan).__name__, local, kids)
+
+
+def _conf_key(conf: "C.RapidsConf") -> tuple:
+    items = []
+    for k in sorted(set(C._REGISTRY) | set(conf._values)):
+        try:
+            v = conf.get(k)
+        except KeyError:
+            v = None
+        if not isinstance(v, (str, int, float, bool, type(None))):
+            v = repr(v)
+        items.append((k, v))
+    return tuple(items)
+
+
+# ---------------------------------------------------------------------------
+# the memo
+# ---------------------------------------------------------------------------
+
+
+def build_key(plan: L.LogicalPlan, conf: "C.RapidsConf",
+              shuffle_partitions: int,
+              pinned: List) -> Optional[tuple]:
+    """Full memo key, or None when this plan must not be memoized."""
+    global _UNCACHEABLE
+    from spark_rapids_tpu.shuffle.manager import get_manager
+
+    try:
+        fp = logical_fingerprint(plan, pinned)
+        out_names = tuple(f.name for f in plan.schema)
+    except Exception:
+        # Unfingerprintable, or schema resolution itself failing at key
+        # time (e.g. a ParquetScan path that only resolves after the
+        # path-replacement rewrite): never memoized, never an error here.
+        with _LOCK:
+            _UNCACHEABLE += 1
+        return None
+    mgr = get_manager()
+    pinned.append(mgr)
+    return (fp, out_names, shuffle_partitions, _conf_key(conf),
+            id(mgr), _EPOCH)
+
+
+def lookup(key: tuple):
+    """Cached _Entry for ``key`` (refreshing its LRU position), or None.
+    Counts the hit; misses are counted at store()."""
+    global _HITS
+    with _LOCK:
+        entry = _CACHE.get(key)
+        if entry is None:
+            return None
+        if not entry.valid():
+            del _CACHE[key]
+            return None
+        _CACHE.move_to_end(key)
+        _HITS += 1
+        return entry
+
+
+def store(key: tuple, ex, explain_text: str, fastpath: bool,
+          pinned, conf: "C.RapidsConf") -> None:
+    global _MISSES, _EVICTIONS
+    cap = conf[C.PLAN_CACHE_MAX_ENTRIES]
+    with _LOCK:
+        _MISSES += 1
+        _CACHE[key] = _Entry(ex, explain_text, fastpath, pinned)
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > cap:
+            _CACHE.popitem(last=False)
+            _EVICTIONS += 1
+
+
+def bump_epoch() -> None:
+    """Invalidate every memoized plan (the conf key already covers conf
+    changes; this is the manual/global hammer for everything else, e.g. a
+    shuffle-manager restart mid-session)."""
+    global _EPOCH
+    with _LOCK:
+        _EPOCH += 1
+        _CACHE.clear()
+
+
+def clear() -> None:
+    with _LOCK:
+        _CACHE.clear()
+
+
+def counters() -> Dict[str, int]:
+    with _LOCK:
+        return {"plan_cache_hit_total": _HITS,
+                "plan_cache_miss_total": _MISSES,
+                "plan_cache_evict_total": _EVICTIONS,
+                "plan_cache_uncacheable_total": _UNCACHEABLE,
+                "plan_cache_size": len(_CACHE)}
+
+
+def reset_stats() -> None:
+    global _HITS, _MISSES, _EVICTIONS, _UNCACHEABLE
+    with _LOCK:
+        _HITS = _MISSES = _EVICTIONS = _UNCACHEABLE = 0

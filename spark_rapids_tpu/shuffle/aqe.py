@@ -214,6 +214,10 @@ class AQEShuffleReadExec(UnaryExec):
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         spec = self.specs()[partition]
         ex = self.exchange
+        # specs survive cleanup (same deterministic input -> same sizes) but
+        # the shuffle registration does not: a re-executed plan (plan-memo
+        # hit) must re-materialize the exchange before reading
+        ex._ensure_written()
         if isinstance(spec, CoalescedPartitionSpec):
             table = ex.manager.read_spec(
                 ex._reg, range(spec.start, spec.end))

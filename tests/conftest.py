@@ -52,7 +52,8 @@ def rng():
 # minutes each and run via tests/run_slow_lane.sh (SRTPU_SLOW_LANE=1) —
 # the default lane stays fast. CI/driver should run both.
 SLOW_LANE_MODULES = ("test_distributed", "test_cluster", "test_tpcds",
-                     "test_scaletest", "test_fusion_diff", "test_reuse_diff")
+                     "test_scaletest", "test_fusion_diff", "test_reuse_diff",
+                     "test_warmstart")
 SLOW_LANE = os.environ.get("SRTPU_SLOW_LANE") == "1"
 
 

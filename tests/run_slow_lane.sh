@@ -14,7 +14,7 @@ SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}
     tests/test_tpcds.py tests/test_scaletest.py \
     tests/test_fusion_diff.py tests/test_reuse_diff.py \
     tests/test_pipeline.py tests/test_faults.py \
-    tests/test_reuse.py -q "$@"
+    tests/test_reuse.py tests/test_warmstart.py -q "$@"
 
 # Diagnostics-bundle smoke: the --demo query must produce a complete bundle
 # (profiles, journal, metrics exposition, trace, config) without raising.
@@ -35,3 +35,24 @@ SCALE_SF=1 SCALE_BATCH_ROWS=1048576 \
     SCALE_OUT="${TMPDIR:-/tmp}/srtpu_scale_smoke.md" \
     tests/run_scale_lane.sh
 echo "scale gauntlet smoke OK"
+
+# Latency lane (bench.py --latency): cold/warm percentiles per phase over
+# q1/q6/q3 plus its own regression gates (warm p50 must beat cold p50, the
+# plan memo must actually serve). bench.py refuses BENCH_* shrink overrides
+# for this lane; LAT_* only tunes iteration counts/SF, kept small here so
+# the lane stays in budget. A budget overrun still emits the final metric
+# line; gate failure exits nonzero and fails this script.
+LAT_OUT="${TMPDIR:-/tmp}/srtpu_latency_smoke.json"
+LAT_LOG="${TMPDIR:-/tmp}/srtpu_latency_smoke.out"
+LAT_SF="${LAT_SF:-0.05}" LAT_COLD_ITERS="${LAT_COLD_ITERS:-2}" \
+    LAT_WARM_ITERS="${LAT_WARM_ITERS:-4}" \
+    python bench.py --latency --budget 420 --latency-out "$LAT_OUT" \
+    > "$LAT_LOG"
+tail -n 1 "$LAT_LOG" | python -c '
+import json, sys
+m = json.loads(sys.stdin.read())
+assert m.get("metric") == "latency_warm_wall_p50_ms", m
+assert m.get("gates_passed") is True, m
+print("latency lane OK: warm wall p50 %.1f ms" % m["value"])
+'
+test -s "$LAT_OUT" || { echo "latency lane: missing $LAT_OUT" >&2; exit 1; }

@@ -75,7 +75,10 @@ def test_aqe_coalesced_agg_matches_non_aqe():
     base = _sorted_rows(_agg_df(
         t, RapidsConf({C.AQE_ENABLED.key: False})).collect())
     # huge advisory size -> everything coalesces into one reader partition
-    conf = RapidsConf({C.AQE_TARGET_PARTITION_BYTES.key: 1 << 40})
+    # (fastpath off: these inputs are tiny and the bypass would plan the
+    # single-partition shape instead of the AQE reader under test)
+    conf = RapidsConf({C.AQE_TARGET_PARTITION_BYTES.key: 1 << 40,
+                       C.FASTPATH_ENABLED.key: False})
     df = _agg_df(t, conf)
     node = df.physical_plan()
 
@@ -140,6 +143,7 @@ def test_aqe_skew_join_matches_non_aqe(how):
         C.AQE_SKEW_THRESHOLD_BYTES.key: 4096,
         C.AQE_SKEW_FACTOR.key: 1.5,
         C.JOIN_BROADCAST_ROWS.key: 0,
+        C.FASTPATH_ENABLED.key: False,  # tiny input; keep the skew readers
     })
     df = _join_dfs(left, right, conf, how)
     node = df.physical_plan()

@@ -219,8 +219,11 @@ def test_broadcast_join_selected_for_small_build():
         pth = os.path.join(tmp, f"f{i}.parquet")
         pq.write_table(fact.slice(i * 1250, 1250), pth)
         paths.append(pth)
-    df = read_parquet(paths, conf=RapidsConf({}))
-    dd = from_arrow(dim, RapidsConf({}))
+    # fastpath off: this input is tiny, and the bypass would plan a
+    # single-partition probe instead of the size-based join choice under test
+    no_fp = {"spark.rapids.tpu.fastpath.enabled": False}
+    df = read_parquet(paths, conf=RapidsConf(no_fp))
+    dd = from_arrow(dim, RapidsConf(no_fp))
     plan = df.join(dd, left_on="fk", right_on="dk")
     node = plan.physical_plan()
 

@@ -156,7 +156,12 @@ def test_prefetch_accounts_with_pool():
 
 def _tpch_plan(conf_overrides=None):
     tables = tpch.tables_for(0.002, seed=5)
-    conf = RapidsConf(conf_overrides or {})
+    # structure assertions below are about the full multi-partition plan;
+    # at sf=0.002 the small-query fast path would (correctly) skip the
+    # prefetch machinery under test
+    base = {"spark.rapids.tpu.fastpath.enabled": False}
+    base.update(conf_overrides or {})
+    conf = RapidsConf(base)
     d = tpch.df_tables(tables, conf, shuffle_partitions=2, partitions=2,
                        batch_rows=512)
     return tpch.DF_QUERIES["q3"](d).physical_plan()

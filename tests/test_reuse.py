@@ -42,7 +42,13 @@ FUSION_KEY = "spark.rapids.tpu.sql.fusion.enabled"
 
 
 def _conf(reuse=True, fusion=False, **extra):
-    d = {REUSE_KEY: reuse, FUSION_KEY: fusion}
+    # the interactive fast path (round 11) would legitimately bypass the
+    # machinery this suite asserts on: the plan memo serves repeat plans
+    # without re-running apply_reuse (so per-plan counter deltas vanish)
+    # and the small-query fastpath plans these tiny inputs exchange-free
+    d = {REUSE_KEY: reuse, FUSION_KEY: fusion,
+         "spark.rapids.tpu.plan.cache.enabled": False,
+         "spark.rapids.tpu.fastpath.enabled": False}
     d.update(extra)
     return RapidsConf(d)
 

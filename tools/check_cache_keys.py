@@ -16,6 +16,15 @@ This checker fails (exit 1) when either side of that contract breaks:
 2. ``_KEY_PRIVATE_ATTRS`` in exprs/expr.py no longer contains
    ``"_params"`` — every ``_params`` in the tree would vanish at once.
 
+It also guards the persistent-program cache key site (exec/jit_persist.py):
+anything hashed into an on-disk entry digest must include the jax version,
+the active backend, and the host CPU-feature fingerprint — a digest
+missing any of them could replay a serialized program across an
+environment where it is invalid (the r5/r6 cross-host SIGSEGV class).
+Checked structurally: ``_environment_salt`` must mention ``__version__``,
+``default_backend`` and ``cpu_feature_fingerprint``, and ``_digest`` must
+call ``_environment_salt``.
+
 Pure AST analysis, no imports of the checked code; wired into the default
 test lane via tests/test_faults.py.
 """
@@ -110,6 +119,53 @@ def _check_key_private_attrs(violations: list) -> None:
         "(cache_key contract changed? update tools/check_cache_keys.py)")
 
 
+def _fn_mentions(fn: ast.AST, needles) -> set:
+    """Which of ``needles`` appear in ``fn`` as an attribute access, a bare
+    name, or a call target."""
+    seen = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Attribute) and sub.attr in needles:
+            seen.add(sub.attr)
+        elif isinstance(sub, ast.Name) and sub.id in needles:
+            seen.add(sub.id)
+    return seen
+
+
+def _check_persist_key(violations: list) -> None:
+    """exec/jit_persist.py digest contract: the on-disk entry key covers
+    the full environment (jax version + backend + CPU features)."""
+    path = os.path.join(PKG, "exec", "jit_persist.py")
+    rel = os.path.relpath(path, REPO)
+    if not os.path.exists(path):
+        violations.append(f"{rel}: missing (persistent-program cache "
+                          "removed? update tools/check_cache_keys.py)")
+        return
+    with open(path, "r") as f:
+        tree = ast.parse(f.read(), filename=path)
+    fns = {n.name: n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    salt = fns.get("_environment_salt")
+    if salt is None:
+        violations.append(
+            f"{rel}: _environment_salt() not found — the on-disk program "
+            "digest no longer has a declared environment key site")
+    else:
+        needed = {"__version__", "default_backend",
+                  "cpu_feature_fingerprint"}
+        missing = needed - _fn_mentions(salt, needed)
+        if missing:
+            violations.append(
+                f"{rel}:{salt.lineno}: _environment_salt() no longer "
+                f"covers {sorted(missing)} — a persisted program could "
+                "replay in an environment where it is invalid")
+    dig = fns.get("_digest")
+    if dig is None or "_environment_salt" not in _fn_mentions(
+            dig, {"_environment_salt"}):
+        violations.append(
+            f"{rel}: _digest() must fold _environment_salt() into every "
+            "on-disk entry key")
+
+
 def main() -> int:
     violations: list = []
     for dirpath, dirnames, filenames in os.walk(PKG):
@@ -118,6 +174,7 @@ def main() -> int:
             if fn.endswith(".py"):
                 _check_file(os.path.join(dirpath, fn), violations)
     _check_key_private_attrs(violations)
+    _check_persist_key(violations)
     if violations:
         print("cache-key guard FAILED:", file=sys.stderr)
         for v in violations:
