@@ -45,6 +45,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -788,6 +789,164 @@ def latency_main(budget_s=None, out_path="artifacts/latency.json"):
                          f"{[k for k, v in gates.items() if not v]}")
 
 
+def _clients_guard(environ):
+    """--clients is a correctness gate (concurrent results must be
+    bit-identical to serial); refuse the BENCH_* overrides that would
+    change what the gate compares — the same refuse-to-shrink contract as
+    --faults/--pool-cap/--latency. CL_* knobs (scale, per-client
+    iteration count) stay overridable: serial baseline and concurrent runs
+    always use the same inputs, so they tune load, not the comparison."""
+    banned = [k for k in ("BENCH_SF_H", "BENCH_SF_DS", "BENCH_RUNS",
+                          "BENCH_DEPTH") if k in environ]
+    if banned:
+        raise SystemExit(
+            f"--clients is set: refusing to run with correctness-gate "
+            f"overrides {banned} (the concurrency lane gates concurrent-"
+            f"vs-serial bit-identity and must control its own inputs)")
+
+
+def clients_main(budget_s=None, clients=8, faults_spec=None,
+                 out_path="artifacts/serve_clients.json"):
+    """Concurrency lane: N client threads submit TPC-H q1/q6/q3 through the
+    QueryServer (serve/) while a serial pass provides the expected tables.
+    Gates: every concurrent result bit-identical to serial, every submitted
+    query accounted for (completed / shed / timed out — nothing lost), and
+    the HBM pool balanced afterward. Reports wall p50/p95/p99 across all
+    client-observed latencies, aggregate queries/s, and shed/timeout
+    counts; the final driver-metric line is emitted even when the budget
+    truncates iterations (docs/serving.md)."""
+    from spark_rapids_tpu.bench import tpch
+    from spark_rapids_tpu.config import conf as C
+    from spark_rapids_tpu.mem.pool import get_pool
+    from spark_rapids_tpu.obs import gauges as G
+    from spark_rapids_tpu.plan import from_arrow
+    from spark_rapids_tpu.serve import AdmissionRejected, QueryServer
+
+    _clients_guard(os.environ)
+    sf = float(os.environ.get("CL_SF", 0.05))
+    iters = int(os.environ.get("CL_ITERS", 6))
+    names = ["q1", "q6", "q3"]
+    bud = _Budget(budget_s)
+    conf = C.RapidsConf()
+    if faults_spec:
+        conf = conf.with_overrides(**{C.TEST_FAULTS.key: faults_spec})
+
+    _mark(f"clients lane: sf={sf} clients={clients} iters={iters}"
+          + (f" faults={faults_spec}" if faults_spec else ""))
+    tables = {
+        "lineitem": tpch.gen_lineitem(sf, seed=7),
+        "orders": tpch.gen_orders(sf, seed=8),
+        "customer": tpch.gen_customer(sf, seed=9),
+        "supplier": tpch.gen_supplier(sf, seed=10),
+        "nation": tpch.gen_nation(),
+        "region": tpch.gen_region(),
+    }
+
+    def build(qn):
+        d = {k: from_arrow(v, conf) for k, v in tables.items()}
+        return tpch.DF_QUERIES[qn](d)
+
+    # serial baseline with injection off: the expected bits
+    base = C.RapidsConf()
+    expected = {}
+    for qn in names:
+        d = {k: from_arrow(v, base) for k, v in tables.items()}
+        expected[qn] = tpch.DF_QUERIES[qn](d).to_arrow()
+    _mark(f"serial baseline done ({bud.remaining():.0f}s left)"
+          if bud.enabled else "serial baseline done")
+
+    g0 = G.snapshot()
+    srv = QueryServer(conf)
+    walls = []
+    walls_lock = threading.Lock()
+    stats = {"completed": 0, "shed": 0, "timeout": 0, "mismatch": 0,
+             "error": 0}
+
+    def client(ci):
+        for i in range(iters):
+            if bud.enabled and bud.remaining() < 0.25 * bud.total:
+                return
+            qn = names[(ci + i) % len(names)]
+            t0 = time.perf_counter()
+            try:
+                tk = srv.submit(build(qn), name=f"c{ci}-{qn}#{i}")
+            except AdmissionRejected:
+                with walls_lock:
+                    stats["shed"] += 1
+                time.sleep(0.02)
+                continue
+            try:
+                out = tk.result(timeout_s=300)
+            except TimeoutError:
+                tk.cancel("bench timeout")
+                with walls_lock:
+                    stats["timeout"] += 1
+                continue
+            except Exception:
+                with walls_lock:
+                    stats["error"] += 1
+                continue
+            wall = time.perf_counter() - t0
+            with walls_lock:
+                walls.append(wall)
+                stats["completed"] += 1
+                if not out.equals(expected[qn]):
+                    stats["mismatch"] += 1
+
+    gates = {}
+    t_lane0 = time.perf_counter()
+    try:
+        threads = [threading.Thread(target=client, args=(ci,),
+                                    name=f"bench-client-{ci}")
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        lane_s = time.perf_counter() - t_lane0
+        srv.close()
+        g1 = G.snapshot()
+        counters = {k: g1[k] - g0.get(k, 0) for k in
+                    ("admission_submitted_total", "admission_rejected_total",
+                     "sched_completed_total", "sched_singleflight_hit_total",
+                     "semaphore_timeout_total", "semaphore_cancel_total")}
+        pcts = _pctiles_ms(walls)
+        gates["bit_identical"] = (stats["mismatch"] == 0
+                                  and stats["completed"] > 0)
+        gates["no_unexplained_failures"] = stats["error"] == 0
+        gates["pool_balanced"] = get_pool().used == 0
+        artifact = {
+            "sf": sf, "clients": clients, "iters": iters,
+            "queries": names, "faults": faults_spec,
+            "wall_ms": pcts, "lane_s": round(lane_s, 3),
+            "stats": stats, "counters": counters, "gates": gates,
+        }
+        out_dir = os.path.dirname(out_path)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(json.dumps({"serve_clients": artifact}))
+        print(json.dumps({
+            "metric": "serve_clients_wall_p50_ms",
+            "value": pcts["p50"],
+            "unit": "ms",
+            "p95_ms": pcts["p95"],
+            "p99_ms": pcts["p99"],
+            "queries_per_s": (round(stats["completed"] / lane_s, 3)
+                              if lane_s > 0 else None),
+            "shed_total": stats["shed"],
+            "timeout_total": stats["timeout"],
+            "clients": clients,
+            "gates_passed": all(gates.values()) if gates else False,
+        }))
+    if gates and not all(gates.values()):
+        raise SystemExit(f"clients gates failed: "
+                         f"{[k for k, v in gates.items() if not v]} "
+                         f"(stats={stats})")
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -822,6 +981,18 @@ if __name__ == "__main__":
     ap.add_argument("--latency-out", type=str,
                     default="artifacts/latency.json", metavar="PATH",
                     help="artifact path for --latency results")
+    ap.add_argument("--clients", type=int, default=None, metavar="N",
+                    help="run the concurrency lane instead of the "
+                         "throughput sweep: N client threads submit "
+                         "q1/q6/q3 through the QueryServer; gates "
+                         "concurrent-vs-serial bit-identity and pool "
+                         "balance; reports wall p50/p95/p99, queries/s, "
+                         "and shed/timeout counts (docs/serving.md). "
+                         "Combine with --faults for the seeded chaos "
+                         "variant")
+    ap.add_argument("--clients-out", type=str,
+                    default="artifacts/serve_clients.json", metavar="PATH",
+                    help="artifact path for --clients results")
     _args = ap.parse_args()
     if _args.budget is None and not sys.stdout.isatty():
         # non-interactive bare run (CI/harness): a full unbudgeted sweep can
@@ -830,6 +1001,9 @@ if __name__ == "__main__":
         _args.budget = float(os.environ.get("SRTPU_BENCH_BUDGET_S", "600"))
     if _args.latency:
         latency_main(budget_s=_args.budget, out_path=_args.latency_out)
+    elif _args.clients is not None:
+        clients_main(budget_s=_args.budget, clients=_args.clients,
+                     faults_spec=_args.faults, out_path=_args.clients_out)
     else:
         main(budget_s=_args.budget, faults=_args.faults,
              pool_cap=_args.pool_cap)

@@ -177,7 +177,7 @@ TEST_FAULTS = conf(
         "(e.g. 'mem.alloc:retry@skip=3;shuffle.fetch:drop@p=0.1,seed=42'). "
         "Sites: mem.alloc, mem.spill, io.decode, shuffle.serialize, "
         "shuffle.fetch, shuffle.block, parallel.exchange, executor, "
-        "agg.repartition. Actions: retry, split, "
+        "agg.repartition, serve.admit, serve.cancel. Actions: retry, split, "
         "drop, error, corrupt, slow, stall, kill. Empty = injection off, "
         "zero overhead. Generalizes the reference's OomInjectionConf "
         "(RapidsConf.scala:2753) to every layer; see docs/fault_injection.md.",
@@ -712,6 +712,69 @@ FASTPATH_MAX_BYTES = conf(
     doc="Estimated-byte ceiling (summed over scan leaves) below which a "
         "query qualifies for the small-query fast path.",
     check=lambda v: None if v >= 0 else "must be >= 0")
+
+# ---------------------------------------------------------------------------
+# Round-10 concurrent-serving knobs (spark_rapids_tpu/serve/;
+# docs/serving.md)
+# ---------------------------------------------------------------------------
+
+SERVE_MAX_CONCURRENT = conf(
+    "spark.rapids.tpu.serve.maxConcurrentQueries", default=4,
+    doc="Executor threads in the QueryServer: how many admitted queries "
+        "run simultaneously. Device-side concurrency within and across "
+        "queries is still governed by sql.concurrentTpuTasks via the task "
+        "semaphore — this knob bounds whole-query parallelism, that one "
+        "bounds partitions on the chip (docs/serving.md).",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVE_QUEUE_DEPTH = conf(
+    "spark.rapids.tpu.serve.queue.maxDepth", default=16,
+    doc="Bound on queries waiting to run in the QueryServer. A submission "
+        "past this depth is shed with a typed AdmissionRejected instead of "
+        "queueing unboundedly (serve/admission.py).",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVE_ADMIT_FRACTION = conf(
+    "spark.rapids.tpu.serve.admission.memoryFraction", default=0.9,
+    doc="Fraction of the HBM pool limit admission control may promise out "
+        "as per-query memory-budget reservations. A submission whose "
+        "declared budget does not fit the remaining headroom is shed with "
+        "AdmissionRejected(reason='memory') — overload becomes a typed "
+        "refusal at the front door, never an unattributed OOM mid-query.",
+    check=lambda v: None if 0.0 < v <= 1.0 else "must be in (0, 1]")
+
+SERVE_DEFAULT_BUDGET = conf(
+    "spark.rapids.tpu.serve.defaultMemoryBudgetBytes", default=0,
+    doc="Memory budget applied to submissions that do not declare one. "
+        "While the query runs, the pool rejects allocations that would "
+        "push its live attributed bytes past the budget with a typed "
+        "QueryBudgetExceeded (mem/pool.py). 0 = uncapped.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVE_DEFAULT_DEADLINE_MS = conf(
+    "spark.rapids.tpu.serve.defaultDeadlineMs", default=0.0,
+    doc="Deadline applied to submissions that do not declare one, in "
+        "milliseconds of wall time from submission. Past it, the query "
+        "unwinds with QueryDeadlineExceeded at its next cancellation poll "
+        "point and releases every pool allocation. 0 = no deadline.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVE_GRACE_MS = conf(
+    "spark.rapids.tpu.serve.cancelGraceMs", default=5000.0,
+    doc="Bound on how long QueryServer.close() waits for each executor "
+        "thread to observe cancellation and unwind. Poll points sit at "
+        "partition boundaries, retry attempts, prefetch pulls, and "
+        "semaphore wait slices, so unwind latency is one batch of work.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+SERVE_SINGLEFLIGHT = conf(
+    "spark.rapids.tpu.serve.singleflight.enabled", default=True,
+    doc="Deduplicate identical in-flight queries: a submission whose "
+        "semantic plan fingerprint (plan key + session conf + shuffle "
+        "partitioning) matches a query already queued or running shares "
+        "that execution's result instead of running again "
+        "(serve/server.py; the cross-query complement of the plan memo "
+        "and materialization cache, docs/latency.md).")
 
 
 _ACTIVE: "Optional[RapidsConf]" = None

@@ -114,6 +114,11 @@ class PrefetchIterator:
             from spark_rapids_tpu.obs import memtrack as _mt
             self._mem_tag = _mt.make_tag(mem_site or "other",
                                          op=label.split("#", 1)[0])
+        # query context captured on the CONSUMER thread (thread-locals do
+        # not inherit): the worker polls it so read-ahead stops producing
+        # for a cancelled/deadlined query (serve/context.py)
+        from spark_rapids_tpu.serve import context as _sctx
+        self._ctx = _sctx.current()
         self._thread = threading.Thread(
             target=self._run, name=f"srtpu-prefetch-{label}", daemon=True)
         self._thread.start()
@@ -124,6 +129,8 @@ class PrefetchIterator:
 
         try:
             while not self._stop.is_set():
+                if self._ctx is not None:
+                    self._ctx.check()  # typed error -> _ERROR -> consumer
                 t0 = time.perf_counter_ns()
                 try:
                     item = next(self._source)
@@ -171,6 +178,8 @@ class PrefetchIterator:
     def __next__(self):
         if self._finished:
             raise StopIteration
+        if self._ctx is not None:
+            self._ctx.check()  # consumer-side cancellation poll
         while True:
             if self._direct:
                 try:

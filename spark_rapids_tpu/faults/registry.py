@@ -18,7 +18,10 @@ Schedule grammar (``spark.rapids.tpu.test.faults``)::
 Sites (see docs/fault_injection.md for the catalog): ``mem.alloc``,
 ``mem.spill``, ``io.decode``, ``shuffle.serialize``, ``shuffle.fetch``,
 ``shuffle.block``, ``parallel.exchange``, ``executor``,
-``agg.repartition``.
+``agg.repartition``, ``serve.admit`` (QueryServer.submit — an injected
+failure surfaces as a typed AdmissionRejected), ``serve.cancel``
+(QueryContext.check — fires at exactly the runtime's cancellation poll
+points, exercising the prompt-unwind path).
 
 Actions: ``retry`` (RetryOOM), ``split`` (SplitAndRetryOOM), ``drop``
 (TimeoutError), ``error`` (FaultInjectedError), ``corrupt`` (bit-flip,
@@ -46,7 +49,7 @@ from typing import Dict, List, Optional
 
 _SITES = ("mem.alloc", "mem.spill", "io.decode", "shuffle.serialize",
           "shuffle.fetch", "shuffle.block", "parallel.exchange", "executor",
-          "agg.repartition")
+          "agg.repartition", "serve.admit", "serve.cancel")
 _ACTIONS = ("retry", "split", "drop", "error", "corrupt", "slow", "stall",
             "kill")
 

@@ -16,7 +16,7 @@ via mem.retry, exactly like the reference's GpuRetryOOM path.
 from __future__ import annotations
 
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from spark_rapids_tpu import faults
 
@@ -33,6 +33,21 @@ class SplitAndRetryOOM(RuntimeError):
 
 class CpuRetryOOM(RetryOOM):
     """Host-memory flavor (reference: CpuRetryOOM)."""
+
+
+class QueryBudgetExceeded(RuntimeError):
+    """An allocation would push a query past its admitted memory budget
+    (spark.rapids.tpu.serve.*). Deliberately NOT a RetryOOM: spilling and
+    retrying cannot shrink the query's own live footprint, so the typed
+    error propagates to the submitter instead of spinning the retry loop
+    (faults/blacklist.py classifies unknown errors as RAISE)."""
+
+    def __init__(self, query_id, nbytes: int, live: int, budget: int):
+        super().__init__(
+            f"query {query_id} over its memory budget: allocating {nbytes} "
+            f"with {live} live attributed bytes against a budget of "
+            f"{budget}")
+        self.query_id = query_id
 
 
 class OomInjector:
@@ -89,6 +104,9 @@ class HbmPool:
         self.alloc_count = 0
         self.oom_count = 0
         self.spill_request_count = 0
+        # query_id -> admitted budget in bytes (serve/admission.py promises,
+        # this map enforces; empty when no serving runtime is active)
+        self._query_budgets: Dict[object, int] = {}
 
     # -- wiring ------------------------------------------------------------
     def set_spill_fn(self, fn: Optional[Callable[[int], int]]) -> None:
@@ -96,6 +114,19 @@ class HbmPool:
 
     def set_injector(self, injector: Optional[OomInjector]) -> None:
         self._injector = injector
+
+    def set_query_budget(self, query_id, nbytes: int) -> None:
+        """Cap ``query_id``'s live attributed bytes (0/None clears). Set by
+        plan/dataframe.py when the active QueryContext carries a budget."""
+        with self._lock:
+            if nbytes:
+                self._query_budgets[query_id] = int(nbytes)
+            else:
+                self._query_budgets.pop(query_id, None)
+
+    def clear_query_budget(self, query_id) -> None:
+        with self._lock:
+            self._query_budgets.pop(query_id, None)
 
     # -- accounting --------------------------------------------------------
     @property
@@ -119,6 +150,15 @@ class HbmPool:
         # serialize unrelated allocators
         from spark_rapids_tpu.obs import memtrack as _mt
         faults.check("mem.alloc", nbytes=nbytes)
+        if self._query_budgets:  # serving runtime active: per-query caps
+            qid = tag[0] if isinstance(tag, tuple) else _mt.current_query()
+            budget = self._query_budgets.get(qid)
+            if budget:
+                live = _mt.query_live(qid)
+                if live + nbytes > budget:
+                    from spark_rapids_tpu.serve import metrics as _sm
+                    _sm.bump("admission_budget_exceeded_total")
+                    raise QueryBudgetExceeded(qid, nbytes, live, budget)
         with self._lock:
             self.alloc_count += 1
             if self._injector is not None:

@@ -86,6 +86,11 @@ def with_retry(
             oom_seen = False
             while True:
                 attempts += 1
+                # cancellation poll: a cancelled/deadlined query must not
+                # spin in the OOM retry loop (serve/context.py; no-op when
+                # no query context is active on this thread)
+                from spark_rapids_tpu.serve import context as _sctx
+                _sctx.check_cancel()
                 try:
                     if isinstance(item, SpillableBatch):
                         with item as batch:

@@ -4,10 +4,20 @@ Reference: GpuSemaphore.scala:100-120 — limits tasks concurrently holding the
 GPU (spark.rapids.sql.concurrentGpuTasks), with priority given to the
 longest-waiting task (PrioritySemaphore). Same role here for a TPU chip:
 scan/shuffle host work runs unthrottled; device compute sections acquire.
+
+Serving-runtime rework (docs/serving.md): ``acquire`` takes an optional
+``timeout_ms``, a ``cancel_check`` hook polled while waiting (so a
+cancelled/deadlined query can never block forever in the wait loop), and a
+``priority``. Scheduling is priority-then-FIFO with aging: a waiter older
+than ``starvation_ns`` outranks any priority, so low-priority queries
+cannot starve behind a stream of hot ones. Waiters that give up (timeout
+or cancellation) are removed from ``_waiters`` and surfaced in
+``snapshot()`` / the srtpu_semaphore_{timeout,cancel}_total gauges.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 import weakref
@@ -18,50 +28,105 @@ from typing import Dict, List, Optional
 # also aggregates totals over every live instance.
 _instances: "weakref.WeakSet" = weakref.WeakSet()
 
+_WAIT_SLICE_S = 0.05  # wait-loop wakeup for cancel polling / timeouts
+
 
 def instances() -> "List[TaskSemaphore]":
     return list(_instances)
 
 
 class TaskSemaphore:
-    """Priority semaphore: FIFO by first-wait time (longest waiting first)."""
+    """Priority semaphore: highest priority first, FIFO within a priority,
+    with anti-starvation aging (a long-waiting task outranks priority)."""
 
-    def __init__(self, permits: int = 2):
-        self._permits = permits
+    def __init__(self, permits: int = 2, starvation_ns: int = 5_000_000_000):
+        self._permits = max(1, int(permits))
+        self.starvation_ns = int(starvation_ns)
         self._cv = threading.Condition()
-        self._waiters: Dict[int, float] = {}  # task_id -> first wait time
-        self._holders: Dict[int, int] = {}  # task_id -> acquire count
+        # task_id -> (first wait time ns, priority, arrival seq)
+        self._waiters: Dict[object, tuple] = {}
+        self._holders: Dict[object, int] = {}  # task_id -> acquire count
+        self._seq = itertools.count()
         self.total_wait_ns = 0
         self.max_waiters = 0
         self.acquire_count = 0
+        self.timeout_count = 0
+        self.cancel_count = 0
         _instances.add(self)
 
-    def acquire(self, task_id: int) -> None:
+    def acquire(self, task_id, timeout_ms: Optional[float] = None,
+                cancel_check=None, priority: int = 0) -> bool:
+        """Block until a permit is granted; returns True.
+
+        ``timeout_ms``: give up after this long — the waiter is removed
+        and False returned (counted in ``timeout_count``). ``cancel_check``
+        is invoked each wait slice; if it raises, the waiter is removed
+        (counted in ``cancel_count``) and the exception propagates — the
+        cancellation hook for a deadlined/cancelled query (serve/).
+        """
         from spark_rapids_tpu.utils import task_metrics as TM
         t0 = time.perf_counter_ns()
+        deadline = (None if timeout_ms is None
+                    else t0 + int(timeout_ms * 1e6))
         with self._cv:
             self.acquire_count += 1
             if task_id in self._holders:  # reentrant per task
                 self._holders[task_id] += 1
-                return
-            self._waiters.setdefault(task_id, t0)
+                return True
+            self._waiters.setdefault(
+                task_id, (t0, int(priority), next(self._seq)))
             self.max_waiters = max(self.max_waiters, len(self._waiters))
-            while not self._may_enter(task_id):
-                self._cv.wait()
-            del self._waiters[task_id]
+            try:
+                while not self._may_enter(task_id):
+                    if cancel_check is not None:
+                        try:
+                            cancel_check()
+                        except BaseException:
+                            self.cancel_count += 1
+                            raise
+                    now = time.perf_counter_ns()
+                    if deadline is not None and now >= deadline:
+                        self.timeout_count += 1
+                        return False
+                    wait_s = _WAIT_SLICE_S if cancel_check is not None \
+                        else None
+                    if deadline is not None:
+                        remaining = (deadline - now) / 1e9
+                        wait_s = (remaining if wait_s is None
+                                  else min(wait_s, remaining))
+                    self._cv.wait(wait_s)
+            finally:
+                # grant, timeout, or cancellation: never leave a ghost
+                # waiter behind to win _may_enter and deadlock the queue
+                self._waiters.pop(task_id, None)
+                self._cv.notify_all()
             self._holders[task_id] = 1
             waited = time.perf_counter_ns() - t0
             self.total_wait_ns += waited
         TM.add("semaphore_wait_ns", waited)
+        return True
 
-    def _may_enter(self, task_id: int) -> bool:
+    def _best_waiter(self):
+        """Who should enter next: aged waiters first (anti-starvation),
+        then highest priority, then earliest arrival."""
+        now = time.perf_counter_ns()
+
+        def rank(item):
+            _tid, (t0, prio, seq) = item
+            if now - t0 >= self.starvation_ns:
+                prio = 1 << 30
+            return (-prio, seq)
+
+        return min(self._waiters.items(), key=rank)[0]
+
+    def _may_enter(self, task_id) -> bool:
         if len(self._holders) >= self._permits:
             return False
-        # longest-waiting first (priority by first-wait timestamp)
-        oldest = min(self._waiters, key=self._waiters.get)
-        return oldest == task_id or len(self._holders) + len(self._waiters) <= self._permits
+        best = self._best_waiter()
+        return (best == task_id
+                or len(self._holders) + len(self._waiters) <= self._permits)
 
-    def release(self, task_id: int) -> None:
+    def release(self, task_id) -> None:
         with self._cv:
             if task_id not in self._holders:
                 return
@@ -70,7 +135,14 @@ class TaskSemaphore:
                 del self._holders[task_id]
                 self._cv.notify_all()
 
-    def held_by(self, task_id: int) -> bool:
+    def resize(self, permits: int) -> None:
+        """Adjust the permit count in place (conf epoch change): growth
+        wakes waiters immediately; shrink applies as holders release."""
+        with self._cv:
+            self._permits = max(1, int(permits))
+            self._cv.notify_all()
+
+    def held_by(self, task_id) -> bool:
         with self._cv:
             return task_id in self._holders
 
@@ -82,15 +154,20 @@ class TaskSemaphore:
         with self._cv:
             return {
                 "permits": self._permits,
-                "holders": {tid: n for tid, n in self._holders.items()},
-                "waiters": {tid: round((now - t0) / 1e6, 3)  # ms waited
-                            for tid, t0 in self._waiters.items()},
+                "holders": {str(tid): n for tid, n in self._holders.items()},
+                "waiters": {str(tid): {"waited_ms":
+                                       round((now - t0) / 1e6, 3),
+                                       "priority": prio}
+                            for tid, (t0, prio, _s)
+                            in self._waiters.items()},
                 "acquire_count": self.acquire_count,
                 "max_waiters": self.max_waiters,
+                "timeout_count": self.timeout_count,
+                "cancel_count": self.cancel_count,
             }
 
     class _Ctx:
-        def __init__(self, sem: "TaskSemaphore", task_id: int):
+        def __init__(self, sem: "TaskSemaphore", task_id):
             self.sem = sem
             self.task_id = task_id
 
@@ -102,7 +179,7 @@ class TaskSemaphore:
             self.sem.release(self.task_id)
             return False
 
-    def held(self, task_id: int) -> "TaskSemaphore._Ctx":
+    def held(self, task_id) -> "TaskSemaphore._Ctx":
         return TaskSemaphore._Ctx(self, task_id)
 
 
@@ -113,12 +190,21 @@ _process_lock = threading.Lock()
 def get_task_semaphore() -> TaskSemaphore:
     """Process-wide semaphore gating device partition drains
     (plan/dataframe.py holds it around each output partition; the
-    small-query fast path bypasses it). Permits come from
-    spark.rapids.tpu.sql.concurrentTpuTasks at first use."""
+    small-query fast path bypasses it).
+
+    Permits follow ``spark.rapids.tpu.sql.concurrentTpuTasks`` in the
+    ACTIVE conf: the value is re-read on every call and the semaphore
+    resized when it changed — the conf-epoch contract the plan cache
+    already implements for plans (plan/plan_cache.py keys fold the full
+    conf), extended here so a session that raises concurrentTpuTasks
+    after the first query is not silently pinned to the old permit count.
+    """
     global _process_sem
+    from spark_rapids_tpu.config import conf as C
+    want = int(C.get_active()[C.CONCURRENT_TASKS])
     with _process_lock:
         if _process_sem is None:
-            from spark_rapids_tpu.config import conf as C
-            _process_sem = TaskSemaphore(
-                permits=C.get_active()[C.CONCURRENT_TASKS])
+            _process_sem = TaskSemaphore(permits=want)
+        elif _process_sem._permits != max(1, want):
+            _process_sem.resize(want)
         return _process_sem

@@ -134,6 +134,35 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "OOM post-mortem snapshots written (docs/memory.md)"),
     ("mem_leaked_bytes_total", "counter",
      "Bytes still attributed to a query at its leak audit"),
+    ("semaphore_timeout_total", "counter",
+     "Semaphore waits abandoned at their timeout (deadline budget spent)"),
+    ("semaphore_cancel_total", "counter",
+     "Semaphore waits abandoned by the cancellation hook"),
+    ("admission_submitted_total", "counter",
+     "Queries submitted to the serving runtime (serve/server.py)"),
+    ("admission_rejected_total", "counter",
+     "Submissions shed with a typed AdmissionRejected"),
+    ("admission_budget_exceeded_total", "counter",
+     "Allocations refused for exceeding the query's admitted memory "
+     "budget (mem/pool.py QueryBudgetExceeded)"),
+    ("admission_queue_depth", "gauge",
+     "Queries currently waiting to run in the serving queue"),
+    ("admission_reserved_bytes", "gauge",
+     "HBM bytes promised to admitted queries' memory budgets"),
+    ("sched_completed_total", "counter",
+     "Served queries that completed successfully"),
+    ("sched_failed_total", "counter",
+     "Served queries that failed with a non-lifecycle error"),
+    ("sched_cancelled_total", "counter",
+     "Served queries cancelled before completion"),
+    ("sched_deadline_exceeded_total", "counter",
+     "Served queries that ran past their deadline"),
+    ("sched_singleflight_hit_total", "counter",
+     "Submissions deduped onto an identical in-flight query"),
+    ("sched_active_queries", "gauge",
+     "Served queries currently executing"),
+    ("sched_queue_wait_ns_total", "counter",
+     "Total time served queries spent waiting in the admission queue"),
 ]
 
 
@@ -169,6 +198,8 @@ def snapshot() -> Dict[str, int]:
         out["semaphore_acquire_total"] += sem.acquire_count
         out["semaphore_max_waiters"] = max(out["semaphore_max_waiters"],
                                            sem.max_waiters)
+        out["semaphore_timeout_total"] += sem.timeout_count
+        out["semaphore_cancel_total"] += sem.cancel_count
     for m in managers:
         out["shuffle_bytes_written_total"] += m.bytes_written
         out["shuffle_blocks_written_total"] += m.blocks_written
@@ -198,6 +229,8 @@ def snapshot() -> Dict[str, int]:
     out.update(_mt.counters())
     from spark_rapids_tpu.exec import aggregate as _agg
     out.update(_agg.counters())
+    from spark_rapids_tpu.serve import metrics as _serve_m
+    out.update(_serve_m.counters())
     return out
 
 

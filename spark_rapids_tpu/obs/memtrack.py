@@ -68,10 +68,16 @@ _enabled = True
 _lock = threading.Lock()
 _tls = threading.local()
 
-# Single-query engine (ROADMAP #1 is the multi-query scheduler this layer
-# is the prerequisite for): the current query id is process-global, so
-# worker threads spawned mid-query inherit it without plumbing.
-_current_query: Optional[int] = None
+# Concurrency-correct current-query resolution (serve/): the query id is
+# THREAD-scoped — each executor thread in the QueryServer runs a different
+# query, so a process-global would cross-attribute every allocation. For
+# the single-query case the old behavior is preserved by a fallback: when
+# exactly one query is active process-wide, threads with no thread-local
+# id (worker threads spawned mid-query) inherit it; with N>1 active,
+# off-thread allocators must carry an explicit tag (make_tag on the
+# consumer thread — exec/pipeline.py already does).
+_active_queries: Dict[Optional[int], int] = {}  # qid -> begin() depth
+_fallback_query: Optional[int] = None  # the qid iff exactly one is active
 
 _stats: "Dict[Tag, Dict[str, int]]" = {}
 _site_live: Dict[str, int] = {}
@@ -135,7 +141,7 @@ def enabled() -> bool:
 def reset() -> None:
     """Drop all attribution state (tests). Counters persist — they are
     process totals, like every other srtpu counter."""
-    global _total_live, _total_peak, _current_query
+    global _total_live, _total_peak, _fallback_query
     global _last_sample_ns, _last_journal_ns
     with _lock:
         _stats.clear()
@@ -144,11 +150,13 @@ def reset() -> None:
         _query_live.clear()
         _query_peak.clear()
         _samples.clear()
+        _active_queries.clear()
         _total_live = 0
         _total_peak = 0
-        _current_query = None
+        _fallback_query = None
         _last_sample_ns = 0
         _last_journal_ns = 0
+    _tls.__dict__["query"] = None
     _pm_seen_queries.clear()
 
 
@@ -158,18 +166,37 @@ def reset() -> None:
 
 
 def begin_query(query_id: Optional[int]) -> None:
-    global _current_query
-    _current_query = query_id
+    """Install ``query_id`` as THIS thread's current query and register it
+    in the active set (concurrent executors each call this on their own
+    thread — plan/dataframe.py)."""
+    global _fallback_query
+    _tls.__dict__["query"] = query_id
+    with _lock:
+        _active_queries[query_id] = _active_queries.get(query_id, 0) + 1
+        _fallback_query = (next(iter(_active_queries))
+                           if len(_active_queries) == 1 else None)
 
 
 def end_query(query_id: Optional[int]) -> None:
-    global _current_query
-    if _current_query == query_id:
-        _current_query = None
+    global _fallback_query
+    d = _tls.__dict__
+    if d.get("query") == query_id:
+        d["query"] = None
+    with _lock:
+        n = _active_queries.get(query_id, 0) - 1
+        if n <= 0:
+            _active_queries.pop(query_id, None)
+        else:
+            _active_queries[query_id] = n
+        _fallback_query = (next(iter(_active_queries))
+                           if len(_active_queries) == 1 else None)
 
 
 def current_query() -> Optional[int]:
-    return _current_query
+    """This thread's query id; threads without one (mid-query workers)
+    inherit the sole active query when exactly one is running."""
+    qid = _tls.__dict__.get("query")
+    return qid if qid is not None else _fallback_query
 
 
 def push_op(op: str, site: Optional[str] = None):
@@ -212,12 +239,12 @@ def make_tag(site_name: str = "other", op: Optional[str] = None) -> Tag:
     """Explicit tag for off-thread allocators (prefetch workers) that
     cannot rely on the consumer's thread-local context."""
     d = _tls.__dict__
-    return (_current_query, op or d.get("op") or "?", site_name)
+    return (current_query(), op or d.get("op") or "?", site_name)
 
 
 def _resolve_tag() -> Tag:
     d = _tls.__dict__
-    return (_current_query, d.get("op") or "?", d.get("site") or "other")
+    return (current_query(), d.get("op") or "?", d.get("site") or "other")
 
 
 # ---------------------------------------------------------------------------
@@ -319,7 +346,7 @@ def _maybe_sample() -> None:
                            {"total": total, **sites}, ts_ns=now)
     if journal_due:
         from spark_rapids_tpu.obs import events as _ev
-        _ev.emit("mem-sample", query_id=_current_query,
+        _ev.emit("mem-sample", query_id=current_query(),
                  total_bytes=total, sites=sites)
 
 
@@ -374,6 +401,12 @@ def query_summary(query_id: Optional[int]) -> Dict:
         "sites": _group(rows, "site"),
         "ops": _group(rows, "op"),
     }
+
+
+def query_live(query_id: Optional[int]) -> int:
+    """Live attributed bytes for one query (mem/pool.py budget checks)."""
+    with _lock:
+        return _query_live.get(query_id, 0)
 
 
 def process_summary() -> Dict:
@@ -489,7 +522,7 @@ def dump_postmortem(reason: str, requested_bytes: int = 0,
     snap = {
         "reason": reason,
         "ts": time.time(),
-        "query_id": _current_query,
+        "query_id": current_query(),
         "requested_bytes": requested_bytes,
         "error": error,
         "tracked": {"live_bytes": total_live, "peak_bytes": total_peak,
@@ -512,7 +545,7 @@ def dump_postmortem(reason: str, requested_bytes: int = 0,
         _counters["oom_postmortem_total"] += 1
         _pm_paths.append(path)
     top = ranked[0] if ranked else None
-    _ev.emit("oom-postmortem", query_id=_current_query, reason=reason,
+    _ev.emit("oom-postmortem", query_id=current_query(), reason=reason,
              path=path, requested_bytes=requested_bytes,
              top_consumer=(f"{top['op']}@{top['site']}={top['live']}"
                            if top else None))
@@ -525,7 +558,7 @@ def on_pool_denied(nbytes: int, pool=None, freed: int = 0) -> None:
     pool can throw thousands per run."""
     if not _enabled or not _pm_enabled:
         return
-    q = _current_query
+    q = current_query()  # the DENYING thread's query, not a process global
     with _lock:
         if q in _pm_seen_queries:
             return

@@ -196,6 +196,8 @@ class DataFrame:
         attempt = 0
         while True:
             attempt += 1
+            from spark_rapids_tpu.serve import context as _sctx
+            _sctx.check_cancel()  # no whole-query retry for a dead query
             try:
                 out = self._execute_plan(self.physical_plan())
                 if attempt > 1:
@@ -212,6 +214,25 @@ class DataFrame:
                               error=type(e).__name__)
 
     def _execute_plan(self, node) -> pa.Table:
+        import threading
+
+        from spark_rapids_tpu.serve import context as _sctx
+
+        ctx = _sctx.current()
+        # one physical tree is stateful during execution (shuffle
+        # registrations, fused-stage buffers) and the plan memo hands the
+        # SAME tree to identical concurrent queries — serialize per tree,
+        # polling the cancellation token while waiting for our turn
+        tree_lock = node.__dict__.setdefault("_exec_lock", threading.Lock())
+        while not tree_lock.acquire(timeout=0.05):
+            if ctx is not None:
+                ctx.check()
+        try:
+            return self._execute_plan_locked(node, ctx)
+        finally:
+            tree_lock.release()
+
+    def _execute_plan_locked(self, node, ctx) -> pa.Table:
         from spark_rapids_tpu.columnar.batch import batch_to_arrow
         from spark_rapids_tpu.obs import memtrack as _mt
         from spark_rapids_tpu.obs import profile_for
@@ -223,31 +244,61 @@ class DataFrame:
         prof = profile_for(node)
         qid = prof.query_id if prof is not None else None
         # allocations from here to the end of the finally block attribute
-        # to this query (process-global: the engine runs one query at a
-        # time); the leak audit at the end settles the account
+        # to this query (thread-scoped: concurrent executors each carry
+        # their own id, obs/memtrack.py); the leak audit settles the account
         _mt.begin_query(qid)
+        pool = None
+        if ctx is not None:
+            ctx.query_id = qid
+            if ctx.memory_budget:
+                from spark_rapids_tpu.mem.pool import get_pool
+
+                pool = get_pool()
+                pool.set_query_budget(qid, ctx.memory_budget)
         had_error = True
         try:
             if isinstance(node, CpuExec):
                 for p in range(node.num_partitions()):
+                    if ctx is not None:
+                        ctx.check()
                     tables.extend(node.execute_host(p))
             else:
                 # each output-partition drain holds the device semaphore
                 # (GpuSemaphore analog); the small-query fast path skips
                 # the round-trip — its whole point is shedding fixed costs
                 from spark_rapids_tpu.mem.semaphore import get_task_semaphore
+                from spark_rapids_tpu.serve.context import (
+                    QueryDeadlineExceeded,
+                )
 
                 sem = (None if getattr(node, "_fastpath", False)
                        else get_task_semaphore())
                 for p in range(node.num_partitions()):
                     if sem is not None:
-                        sem.acquire(p)
+                        if ctx is None:
+                            sem.acquire(p)
+                        else:
+                            # (query, partition) id: two queries draining
+                            # partition 0 are different tasks, not one
+                            # reentrant holder; the wait carries the
+                            # query's deadline budget, priority, and
+                            # cancellation hook
+                            ctx.check()
+                            if not sem.acquire((ctx.ctx_id, p),
+                                               timeout_ms=ctx.remaining_ms(),
+                                               cancel_check=ctx.check,
+                                               priority=ctx.priority):
+                                ctx.cancel("deadline")
+                                raise QueryDeadlineExceeded(
+                                    f"{ctx.name} exceeded its deadline "
+                                    f"waiting for the task semaphore")
                     try:
                         for b in node.execute(p):
                             tables.append(batch_to_arrow(b, schema))
                     finally:
                         if sem is not None:
-                            sem.release(p)
+                            sem.release(p if ctx is None
+                                        else (ctx.ctx_id, p))
             had_error = False
         finally:
             # close out the per-query profile (plan/overrides.py installed
@@ -286,6 +337,8 @@ class DataFrame:
                         "retained_bytes": audit["retained_bytes"],
                     }
             finally:
+                if pool is not None:
+                    pool.clear_query_budget(qid)
                 _mt.end_query(qid)
         if not tables:
             return schema.to_arrow().empty_table()

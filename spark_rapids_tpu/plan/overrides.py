@@ -19,6 +19,7 @@ post-shuffle coalesce.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -345,6 +346,10 @@ def _with_children(plan: L.LogicalPlan, kids) -> L.LogicalPlan:
     return plan
 
 
+# one planner at a time: Overrides.apply writes process-wide state
+_APPLY_LOCK = threading.RLock()
+
+
 class Overrides:
     """The rewrite rule (GpuOverrides analog)."""
 
@@ -622,6 +627,14 @@ class Overrides:
                 and nbytes <= self.conf[C.FASTPATH_MAX_BYTES])
 
     def apply(self, plan: L.LogicalPlan) -> TpuExec:
+        # Planning mutates process-wide state (active conf, faults/journal/
+        # memtrack configuration, the plan memo) — one query plans at a
+        # time so concurrent submissions (serve/) can't interleave those
+        # writes. Execution itself runs outside this lock.
+        with _APPLY_LOCK:
+            return self._apply_locked(plan)
+
+    def _apply_locked(self, plan: L.LogicalPlan) -> TpuExec:
         import time as _time
 
         from spark_rapids_tpu.exec import base as _base

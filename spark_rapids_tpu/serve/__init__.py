@@ -1,0 +1,30 @@
+"""Concurrent-query serving runtime (docs/serving.md).
+
+Public surface:
+
+- ``QueryServer`` — submit N concurrent DataFrame queries with priority,
+  deadline, memory budget; bounded-queue admission with typed shedding;
+  single-flight dedup of identical in-flight queries (serve/server.py).
+- ``QueryContext`` / ``current()`` / ``check_cancel()`` — the per-query
+  lifecycle token the deep layers poll (serve/context.py).
+- ``AdmissionRejected`` — typed load-shed (serve/admission.py).
+- ``QueryCancelled`` / ``QueryDeadlineExceeded`` — typed prompt-unwind
+  errors raised at the runtime's cancellation poll points.
+- ``counters()`` — srtpu_admission_* / srtpu_sched_* totals
+  (serve/metrics.py, declared in obs/gauges.CATALOG).
+"""
+
+from spark_rapids_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    AdmissionRejected,
+)
+from spark_rapids_tpu.serve.context import (  # noqa: F401
+    QueryCancelled,
+    QueryContext,
+    QueryDeadlineExceeded,
+    activate,
+    check_cancel,
+    current,
+)
+from spark_rapids_tpu.serve.metrics import counters  # noqa: F401
+from spark_rapids_tpu.serve.server import QueryServer, Ticket  # noqa: F401

@@ -1,0 +1,111 @@
+"""Admission control: bounded queue + HBM budget reservations.
+
+Overload must degrade predictably — a typed ``AdmissionRejected`` at the
+front door, never an unattributed OOM mid-query. Two admission gates:
+
+- **Queue depth**: at most ``serve.queue.maxDepth`` queries may be waiting
+  to run (running queries do not count). Past it, submissions shed.
+- **Memory reservations**: each admitted query reserves its declared
+  memory budget against ``serve.admission.memoryFraction`` of the HBM
+  pool limit (mem/pool.py). A submission whose budget does not fit the
+  remaining reservable headroom sheds. Budgets are *logical* promises the
+  pool later enforces per allocation (pool.set_query_budget) — the
+  reservation guarantees the sum of promises is honorable, the pool
+  guarantees no query exceeds its own.
+
+Reference shape: the GpuSemaphore admits tasks against concurrentGpuTasks
+for exactly this reason (SURVEY §2.2) — this controller is the same idea
+one level up, at query granularity, with shedding instead of queueing
+when the wait would be unbounded.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from spark_rapids_tpu.serve import metrics as _m
+from spark_rapids_tpu.serve.context import QueryContext
+
+
+class AdmissionRejected(RuntimeError):
+    """Typed load-shed: the serving runtime refused a submission. ``reason``
+    is one of "queue-full", "memory", "fault-injected", "shutdown"."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class AdmissionController:
+    """Reservation ledger shared by one QueryServer."""
+
+    def __init__(self, max_queue: int, reservable_bytes: int):
+        self.max_queue = int(max_queue)
+        self.reservable_bytes = int(reservable_bytes)
+        self._lock = threading.Lock()
+        self._queued = 0
+        self._reserved: Dict[int, int] = {}  # ctx_id -> reserved bytes
+
+    # -- gates -------------------------------------------------------------
+    def admit(self, ctx: QueryContext) -> None:
+        """Admit ``ctx`` into the queue or raise AdmissionRejected. On
+        success the context's memory budget is reserved until release()."""
+        with self._lock:
+            if self._queued >= self.max_queue:
+                _m.bump("admission_rejected_total")
+                raise AdmissionRejected(
+                    "queue-full",
+                    f"admission queue full ({self._queued}/{self.max_queue} "
+                    f"queued); shedding {ctx.name}")
+            reserved = sum(self._reserved.values())
+            if ctx.memory_budget and (reserved + ctx.memory_budget
+                                      > self.reservable_bytes):
+                _m.bump("admission_rejected_total")
+                raise AdmissionRejected(
+                    "memory",
+                    f"memory budget {ctx.memory_budget} does not fit: "
+                    f"{reserved} of {self.reservable_bytes} reservable "
+                    f"bytes already promised; shedding {ctx.name}")
+            self._queued += 1
+            if ctx.memory_budget:
+                self._reserved[ctx.ctx_id] = ctx.memory_budget
+            _m.set_level("admission_queue_depth", self._queued)
+            _m.set_level("admission_reserved_bytes",
+                         sum(self._reserved.values()))
+
+    def dequeued(self) -> None:
+        """A queued query started running (queue slot freed; reservation
+        stays until release)."""
+        with self._lock:
+            self._queued = max(0, self._queued - 1)
+            _m.set_level("admission_queue_depth", self._queued)
+
+    def release(self, ctx: QueryContext, still_queued: bool = False) -> None:
+        """Query finished (any outcome): drop its reservation, and its
+        queue slot when it never started."""
+        with self._lock:
+            if still_queued:
+                self._queued = max(0, self._queued - 1)
+            self._reserved.pop(ctx.ctx_id, None)
+            _m.set_level("admission_queue_depth", self._queued)
+            _m.set_level("admission_reserved_bytes",
+                         sum(self._reserved.values()))
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"queued": self._queued,
+                    "max_queue": self.max_queue,
+                    "reserved_bytes": sum(self._reserved.values()),
+                    "reservable_bytes": self.reservable_bytes,
+                    "reservations": dict(self._reserved)}
+
+
+def reservable_bytes(conf=None, pool=None) -> int:
+    """How many pool bytes admission may promise out, from
+    ``serve.admission.memoryFraction`` of the pool limit."""
+    from spark_rapids_tpu.config import conf as C
+    from spark_rapids_tpu.mem.pool import get_pool
+    cfg = conf if conf is not None else C.get_active()
+    p = pool if pool is not None else get_pool(cfg)
+    return int(p.limit * C.SERVE_ADMIT_FRACTION.get(cfg))

@@ -1,0 +1,140 @@
+"""Per-query lifecycle context: identity, deadline, priority, memory
+budget, and a cancellation token.
+
+The reference plugin rides Spark's TaskContext for all of this — a task
+knows its attempt id, can be killed, and interruption points
+(TaskContext.isInterrupted) pepper long loops. Standalone, ``QueryContext``
+is that object for one submitted query, and the thread-scoped ``current()``
+is the TaskContext.get() analog the deep layers read without plumbing:
+
+- ``plan/dataframe.py`` checks it between output partitions and threads it
+  into the semaphore acquire (timeout + cancellation hook),
+- ``exec/pipeline.py`` prefetch workers/consumers poll it so read-ahead
+  stops producing for a dead query,
+- ``mem/retry.py`` polls it between OOM retry attempts so a cancelled
+  query cannot spin in the retry loop,
+- ``mem/pool.py`` enforces the context's memory budget per allocation.
+
+``check()`` is also the ``serve.cancel`` fault-injection site: a chaos rule
+installed there fires at exactly the runtime's cancellation poll points,
+proving the unwind path releases everything (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class QueryCancelled(RuntimeError):
+    """The query's cancellation token was set; execution unwound at the
+    next poll point. Never retried/degraded by faults/blacklist.py (it
+    classifies only OOM and device failures)."""
+
+
+class QueryDeadlineExceeded(QueryCancelled):
+    """The query ran past its deadline; same prompt-unwind contract as an
+    explicit cancel (a deadline is a cancel the clock issues)."""
+
+
+_next_ctx_id = itertools.count(1)
+_tls = threading.local()
+
+
+class QueryContext:
+    """One submitted query's lifecycle handle.
+
+    ``priority``: higher runs first (queue order and semaphore order).
+    ``deadline_ms``: wall budget from construction; past it every poll
+    point raises QueryDeadlineExceeded. ``memory_budget``: cap in bytes on
+    the query's live attributed pool bytes, enforced by mem/pool.py while
+    the query runs (0 = uncapped).
+    """
+
+    def __init__(self, name: Optional[str] = None, priority: int = 0,
+                 deadline_ms: Optional[float] = None,
+                 memory_budget: int = 0):
+        self.ctx_id = next(_next_ctx_id)
+        self.name = name or f"query-{self.ctx_id}"
+        self.priority = int(priority)
+        self.memory_budget = int(memory_budget or 0)
+        self.submitted_at = time.monotonic()
+        self.deadline = (self.submitted_at + float(deadline_ms) / 1e3
+                         if deadline_ms else None)
+        self.query_id: Optional[int] = None  # memtrack/profile id, set at
+        #                                      execution attach
+        self.state = "created"
+        self.cancel_reason: Optional[str] = None
+        self._cancel = threading.Event()
+
+    # -- cancellation ------------------------------------------------------
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Set the token; the running query unwinds at its next poll point
+        (partition boundary, retry attempt, prefetch pull, semaphore wait
+        slice)."""
+        if not self._cancel.is_set():
+            self.cancel_reason = reason
+            self._cancel.set()
+
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def deadline_exceeded(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+    def remaining_ms(self) -> Optional[float]:
+        """Milliseconds until the deadline (None = no deadline; floor 0)."""
+        if self.deadline is None:
+            return None
+        return max(0.0, (self.deadline - time.monotonic()) * 1e3)
+
+    def check(self) -> None:
+        """Cancellation/deadline poll point — raises the typed error.
+        Also the ``serve.cancel`` fault site, so chaos schedules fire at
+        exactly the places a real cancel would be observed."""
+        from spark_rapids_tpu import faults
+        faults.check("serve.cancel", id=self.ctx_id, op=self.name)
+        if self._cancel.is_set():
+            if self.cancel_reason == "deadline":
+                raise QueryDeadlineExceeded(
+                    f"{self.name} exceeded its deadline")
+            raise QueryCancelled(
+                f"{self.name} cancelled: {self.cancel_reason}")
+        if self.deadline_exceeded():
+            self.cancel("deadline")
+            raise QueryDeadlineExceeded(f"{self.name} exceeded its deadline")
+
+
+# ---------------------------------------------------------------------------
+# ambient context (TaskContext.get() analog)
+# ---------------------------------------------------------------------------
+
+
+def current() -> Optional[QueryContext]:
+    """The QueryContext active on this thread (None outside the serving
+    runtime — every hook below degrades to a no-op then)."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextmanager
+def activate(ctx: QueryContext):
+    """Install ``ctx`` as this thread's current context for the duration.
+    Worker threads spawned mid-query (prefetch) capture the context at
+    construction instead — thread-locals do not inherit."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+def check_cancel() -> None:
+    """Poll the current context, if any (the one-line hook deep loops call:
+    one thread-local read when no query context is active)."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.check()

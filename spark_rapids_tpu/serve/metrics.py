@@ -1,0 +1,44 @@
+"""Serving-runtime counters (srtpu_admission_* / srtpu_sched_* gauges).
+
+Every name here is declared in obs/gauges.CATALOG (guarded by
+tools/check_gauge_catalog.py); ``counters()`` feeds gauges.snapshot() the
+same way pipeline.STATS and faults.counters() do. Counters are process
+totals; gauges (queue depth, reserved bytes, active queries) are levels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, int] = {
+    "admission_submitted_total": 0,
+    "admission_rejected_total": 0,
+    "admission_budget_exceeded_total": 0,
+    "admission_queue_depth": 0,
+    "admission_reserved_bytes": 0,
+    "sched_completed_total": 0,
+    "sched_failed_total": 0,
+    "sched_cancelled_total": 0,
+    "sched_deadline_exceeded_total": 0,
+    "sched_singleflight_hit_total": 0,
+    "sched_active_queries": 0,
+    "sched_queue_wait_ns_total": 0,
+}
+
+
+def bump(name: str, delta: int = 1) -> None:
+    with _LOCK:
+        _COUNTERS[name] += delta
+
+
+def set_level(name: str, value: int) -> None:
+    """Set a gauge-kind entry to an absolute level."""
+    with _LOCK:
+        _COUNTERS[name] = value
+
+
+def counters() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_COUNTERS)

@@ -5,9 +5,13 @@
 # srtpu_fault_recovered_total > 0 — the acceptance net for the hardened
 # retry/refetch/degradation paths (docs/fault_injection.md). The executor
 # kill + recompute paths run in the cluster suite (tests/run_slow_lane.sh).
+# tests/test_serve.py adds the concurrent-serving variant: N client threads
+# through the QueryServer under seeded serve.admit/serve.cancel faults,
+# still bit-identical to the fault-free serial run (docs/serving.md).
 #
 # SRTPU_FAULTS_SEED pins the schedule so failures reproduce exactly.
 set -e
 cd "$(dirname "$0")/.."
 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}" \
-    exec python -m pytest tests/test_faults.py tests/test_reuse.py -q "$@"
+    exec python -m pytest tests/test_faults.py tests/test_reuse.py \
+    tests/test_serve.py -q "$@"

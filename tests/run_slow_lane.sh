@@ -14,7 +14,8 @@ SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}
     tests/test_tpcds.py tests/test_scaletest.py \
     tests/test_fusion_diff.py tests/test_reuse_diff.py \
     tests/test_pipeline.py tests/test_faults.py \
-    tests/test_reuse.py tests/test_warmstart.py -q "$@"
+    tests/test_reuse.py tests/test_warmstart.py \
+    tests/test_serve.py -q "$@"
 
 # Diagnostics-bundle smoke: the --demo query must produce a complete bundle
 # (profiles, journal, metrics exposition, trace, config) without raising.
@@ -56,3 +57,23 @@ assert m.get("gates_passed") is True, m
 print("latency lane OK: warm wall p50 %.1f ms" % m["value"])
 '
 test -s "$LAT_OUT" || { echo "latency lane: missing $LAT_OUT" >&2; exit 1; }
+
+# Concurrency lane (bench.py --clients): N client threads through the
+# QueryServer over q1/q6/q3 — per-query wall p50/p95/p99 + queries/s +
+# shed/timeout counts, gated on bit-identity vs the serial run, no
+# unexplained failures, and a balanced pool at exit. bench.py refuses
+# BENCH_* shrink overrides for this lane; CL_* tunes SF/iterations only.
+CL_OUT="${TMPDIR:-/tmp}/srtpu_serve_clients_smoke.json"
+CL_LOG="${TMPDIR:-/tmp}/srtpu_serve_clients_smoke.out"
+CL_SF="${CL_SF:-0.05}" CL_ITERS="${CL_ITERS:-4}" \
+    python bench.py --clients 8 --budget 420 --clients-out "$CL_OUT" \
+    > "$CL_LOG"
+tail -n 1 "$CL_LOG" | python -c '
+import json, sys
+m = json.loads(sys.stdin.read())
+assert m.get("metric") == "serve_clients_wall_p50_ms", m
+assert m.get("gates_passed") is True, m
+print("clients lane OK: wall p50 %.1f ms, %.1f queries/s, %d shed"
+      % (m["value"], m["queries_per_s"], m["shed_total"]))
+'
+test -s "$CL_OUT" || { echo "clients lane: missing $CL_OUT" >&2; exit 1; }

@@ -1,0 +1,473 @@
+"""Concurrent-query serving runtime suite (docs/serving.md).
+
+Fast-lane sections: concurrent-vs-serial bit-identity through the
+QueryServer (mixed same/distinct queries), single-flight dedup, typed
+cancellation/deadline unwind with pool-balance and no poisoning of
+subsequent queries, admission shedding (queue depth, memory reservations,
+injected faults), per-query pool budgets (QueryBudgetExceeded), the
+reworked TaskSemaphore (timeout/cancel-aware acquire, waiter removal,
+priority + anti-starvation ordering), the get_task_semaphore conf re-read
+regression, and concurrency-correct memtrack attribution/audit scoping.
+
+Chaos lane (``SRTPU_CHAOS_LANE=1``, tests/run_chaos_lane.sh): N client
+threads submit mixed queries through the server under a seeded fault
+schedule that includes the new ``serve.admit``/``serve.cancel`` sites;
+shed submissions are retried and every result must be bit-identical to
+the fault-free serial run.
+"""
+
+import os
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.faults import blacklist as bl
+from spark_rapids_tpu.exprs import expr as E
+from spark_rapids_tpu.mem import semaphore as sem_mod
+from spark_rapids_tpu.mem.pool import (
+    HbmPool, QueryBudgetExceeded, RetryOOM, get_pool,
+)
+from spark_rapids_tpu.mem.semaphore import TaskSemaphore, get_task_semaphore
+from spark_rapids_tpu.obs import memtrack as mt
+from spark_rapids_tpu.plan.dataframe import from_arrow
+from spark_rapids_tpu.serve import (
+    AdmissionController, AdmissionRejected, QueryCancelled, QueryContext,
+    QueryDeadlineExceeded, QueryServer,
+)
+
+CHAOS_LANE = os.environ.get("SRTPU_CHAOS_LANE") == "1"
+FAULTS_SEED = int(os.environ.get("SRTPU_FAULTS_SEED", "42"))
+
+chaos = pytest.mark.skipif(
+    not CHAOS_LANE, reason="chaos lane; run tests/run_chaos_lane.sh")
+
+
+@pytest.fixture(autouse=True)
+def _clean_serve():
+    faults.reset()
+    bl.clear()
+    mt.reset()
+    yield
+    faults.reset()
+    bl.clear()
+    mt.reset()
+    C.set_active(None)
+
+
+def _table(n=2000, seed=0):
+    return pa.table({"a": [(i * 7 + seed) % 911 for i in range(n)],
+                     "b": [float((i + seed) % 97) for i in range(n)]})
+
+
+def _queries(conf, n=4):
+    """Distinct small tracker queries over one in-memory table."""
+    t = _table()
+    out = []
+    for k in range(n):
+        out.append(from_arrow(t, conf, partitions=2)
+                   .filter(E.col("a") > E.lit(k * 3))
+                   .group_by("b")
+                   .agg(E.Alias(E.Sum(E.col("a")), "s"))
+                   .sort("b"))
+    return out
+
+
+# -- concurrent differential ------------------------------------------------
+
+
+def test_concurrent_mixed_queries_bit_identical():
+    """N submissions of mixed same/distinct queries through the server
+    return exactly the serial engine's bytes."""
+    conf = C.RapidsConf()
+    dfs = _queries(conf)
+    expected = [d.to_arrow() for d in dfs]
+    srv = QueryServer(conf)
+    try:
+        tickets = [srv.submit(dfs[i % len(dfs)], name=f"mix{i}")
+                   for i in range(12)]
+        for i, tk in enumerate(tickets):
+            assert tk.result(timeout_s=120).equals(expected[i % len(dfs)])
+    finally:
+        srv.close()
+    assert get_pool().used == 0
+
+
+def test_singleflight_dedup_shares_one_execution():
+    """An identical submission while the primary is still in flight gets a
+    follower ticket resolved from the primary's result."""
+    conf = C.RapidsConf()
+    blocker, q, *_ = _queries(conf)
+    expected = q.to_arrow()
+    # the blocker's first cancellation poll sleeps, pinning the single
+    # worker while the two identical submissions land
+    faults.install("serve.cancel:slow@op=blocker,ms=400,count=1")
+    srv = QueryServer(conf, max_concurrent=1)
+    try:
+        b0 = srv.snapshot()["counters"]["sched_singleflight_hit_total"]
+        tk_b = srv.submit(blocker, name="blocker")
+        t1 = srv.submit(q, name="dup")
+        t2 = srv.submit(q, name="dup")
+        assert t1.result(120).equals(expected)
+        assert t2.result(120).equals(expected)
+        tk_b.result(120)
+        hits = (srv.snapshot()["counters"]["sched_singleflight_hit_total"]
+                - b0)
+        assert hits >= 1
+    finally:
+        srv.close()
+
+
+def test_singleflight_disabled_by_conf():
+    conf = C.RapidsConf({C.SERVE_SINGLEFLIGHT.key: False})
+    srv = QueryServer(conf)
+    try:
+        assert srv._singleflight is False
+        [df] = _queries(conf, n=1)
+        tk = srv.submit(df)
+        assert tk.key is None
+        tk.result(timeout_s=120)
+    finally:
+        srv.close()
+
+
+# -- cancellation / deadline ------------------------------------------------
+
+
+def test_cancel_queued_query_is_typed_and_does_not_poison():
+    conf = C.RapidsConf()
+    blocker, q, q2, *_ = _queries(conf)
+    faults.install("serve.cancel:slow@op=blocker,ms=400,count=1")
+    srv = QueryServer(conf, max_concurrent=1)
+    try:
+        srv.submit(blocker, name="blocker")
+        tk = srv.submit(q, name="victim")
+        tk.cancel()
+        with pytest.raises(QueryCancelled):
+            tk.result(timeout_s=120)
+        # a subsequent query on the same server is unaffected
+        assert srv.submit(q2, name="after").result(120).equals(q2.to_arrow())
+        assert srv.snapshot()["counters"]["sched_cancelled_total"] >= 1
+    finally:
+        srv.close()
+    assert get_pool().used == 0
+
+
+def test_deadline_is_typed_bounded_and_releases_pool():
+    conf = C.RapidsConf()
+    _, q, q2, *_ = _queries(conf)
+    srv = QueryServer(conf)
+    try:
+        t0 = time.monotonic()
+        tk = srv.submit(q, deadline_ms=0.01, name="deadline")
+        with pytest.raises(QueryDeadlineExceeded):
+            tk.result(timeout_s=120)
+        assert time.monotonic() - t0 < 30  # bounded grace, not a hang
+        assert get_pool().used == 0
+        # next query unpoisoned
+        assert srv.submit(q2, name="after").result(120).equals(q2.to_arrow())
+    finally:
+        srv.close()
+
+
+def test_close_cancels_pending_typed():
+    conf = C.RapidsConf()
+    blocker, q, *_ = _queries(conf)
+    faults.install("serve.cancel:slow@op=blocker,ms=400,count=1")
+    srv = QueryServer(conf, max_concurrent=1)
+    srv.submit(blocker, name="blocker")
+    tk = srv.submit(q, name="pending")
+    srv.close(cancel_pending=True)
+    with pytest.raises(QueryCancelled):
+        tk.result(timeout_s=30)
+    with pytest.raises(AdmissionRejected) as ei:
+        srv.submit(q)
+    assert ei.value.reason == "shutdown"
+
+
+# -- admission --------------------------------------------------------------
+
+
+def test_queue_full_sheds_typed():
+    conf = C.RapidsConf()
+    dfs = _queries(conf)
+    faults.install("serve.cancel:slow@op=blocker,ms=500,count=1")
+    srv = QueryServer(conf, max_concurrent=1, max_queue=1)
+    try:
+        srv.submit(dfs[0], name="blocker")
+        time.sleep(0.1)  # let the worker dequeue the blocker
+        srv.submit(dfs[1], name="queued")
+        with pytest.raises(AdmissionRejected) as ei:
+            srv.submit(dfs[2], name="overflow")
+        assert ei.value.reason == "queue-full"
+    finally:
+        srv.close()
+
+
+def test_memory_reservation_sheds_typed():
+    adm = AdmissionController(max_queue=10, reservable_bytes=1000)
+    c1 = QueryContext(name="a", memory_budget=600)
+    adm.admit(c1)
+    with pytest.raises(AdmissionRejected) as ei:
+        adm.admit(QueryContext(name="b", memory_budget=600))
+    assert ei.value.reason == "memory"
+    # release frees the reservation
+    adm.release(c1, still_queued=True)
+    adm.admit(QueryContext(name="c", memory_budget=600))
+
+
+def test_admit_fault_site_sheds_typed():
+    conf = C.RapidsConf()
+    [df] = _queries(conf, n=1)
+    faults.install("serve.admit:error@count=1")
+    srv = QueryServer(conf)
+    try:
+        with pytest.raises(AdmissionRejected) as ei:
+            srv.submit(df)
+        assert ei.value.reason == "fault-injected"
+        # the schedule is exhausted: next submission admits and completes
+        assert srv.submit(df).result(120).equals(df.to_arrow())
+    finally:
+        srv.close()
+
+
+def test_query_budget_exceeded_is_typed_not_retryable():
+    """An over-budget allocation raises QueryBudgetExceeded (attributed,
+    NOT a RetryOOM — spilling cannot shrink the query's own footprint)."""
+    pool = HbmPool(1 << 20)
+    pool.set_query_budget(77, 1000)
+    mt.begin_query(77)
+    try:
+        tag = pool.allocate(800)
+        with pytest.raises(QueryBudgetExceeded) as ei:
+            pool.allocate(800)
+        assert not isinstance(ei.value, RetryOOM)
+        assert "77" in str(ei.value)
+        # under budget still fine; other queries are uncapped
+        tag2 = pool.allocate(100)
+        pool.release(800, tag=tag)
+        pool.release(100, tag=tag2)
+    finally:
+        mt.end_query(77)
+        pool.clear_query_budget(77)
+
+
+# -- TaskSemaphore rework ---------------------------------------------------
+
+
+def test_semaphore_timeout_removes_waiter():
+    sem = TaskSemaphore(permits=1)
+    assert sem.acquire("holder")
+    t0 = time.monotonic()
+    assert sem.acquire("late", timeout_ms=80) is False
+    assert time.monotonic() - t0 < 10
+    snap = sem.snapshot()
+    assert snap["timeout_count"] == 1
+    assert snap["waiters"] == {}          # abandoned waiter removed
+    assert "late" not in snap["holders"]
+    sem.release("holder")
+    # a timed-out task can come back and acquire normally
+    assert sem.acquire("late", timeout_ms=80) is True
+    sem.release("late")
+
+
+def test_semaphore_cancel_check_raises_and_removes_waiter():
+    sem = TaskSemaphore(permits=1)
+    assert sem.acquire("holder")
+
+    def boom():
+        raise QueryCancelled("cancelled mid-wait")
+
+    with pytest.raises(QueryCancelled):
+        sem.acquire("victim", cancel_check=boom)
+    snap = sem.snapshot()
+    assert snap["cancel_count"] == 1
+    assert snap["waiters"] == {}
+    sem.release("holder")
+
+
+def test_semaphore_priority_order_with_fifo_tiebreak():
+    sem = TaskSemaphore(permits=1)
+    assert sem.acquire("holder")
+    order = []
+    started = threading.Barrier(3)
+
+    def waiter(tid, prio):
+        started.wait()
+        # stagger so "low" registers first (FIFO would pick it)
+        if prio:
+            time.sleep(0.1)
+        sem.acquire(tid, priority=prio)
+        order.append(tid)
+        time.sleep(0.05)
+        sem.release(tid)
+
+    ts = [threading.Thread(target=waiter, args=("low", 0)),
+          threading.Thread(target=waiter, args=("high", 5))]
+    for t in ts:
+        t.start()
+    started.wait()
+    time.sleep(0.3)  # both registered as waiters
+    assert len(sem.snapshot()["waiters"]) == 2
+    sem.release("holder")
+    for t in ts:
+        t.join()
+    assert order == ["high", "low"]
+
+
+def test_semaphore_starvation_aging_beats_priority():
+    sem = TaskSemaphore(permits=1, starvation_ns=50_000_000)  # 50ms
+    assert sem.acquire("holder")
+    order = []
+
+    def waiter(tid, prio, delay):
+        time.sleep(delay)
+        sem.acquire(tid, priority=prio)
+        order.append(tid)
+        time.sleep(0.02)
+        sem.release(tid)
+
+    ts = [threading.Thread(target=waiter, args=("old-low", 0, 0.0)),
+          threading.Thread(target=waiter, args=("new-high", 9, 0.1))]
+    for t in ts:
+        t.start()
+    time.sleep(0.3)  # old-low has aged past starvation_ns
+    sem.release("holder")
+    for t in ts:
+        t.join()
+    assert order[0] == "old-low"
+
+
+def test_get_task_semaphore_rereads_conf(monkeypatch):
+    """Regression: the process semaphore used to freeze its permit count
+    at first use; it must now follow concurrentTpuTasks on conf change."""
+    monkeypatch.setattr(sem_mod, "_process_sem", None)
+    C.set_active(C.RapidsConf({C.CONCURRENT_TASKS.key: 2}))
+    s1 = get_task_semaphore()
+    assert s1.snapshot()["permits"] == 2
+    C.set_active(C.RapidsConf({C.CONCURRENT_TASKS.key: 5}))
+    s2 = get_task_semaphore()
+    assert s2 is s1                       # resized in place, not replaced
+    assert s2.snapshot()["permits"] == 5
+
+
+# -- concurrency-correct attribution ---------------------------------------
+
+
+def test_memtrack_thread_scoped_attribution_and_audit():
+    """Two queries on two threads attribute to their own ids, and the
+    strict leak audit for the finishing query ignores the other query's
+    still-live allocations."""
+    pool = HbmPool(1 << 20)
+    errs = []
+    a_allocated = threading.Event()
+    b_done = threading.Event()
+
+    def qa():
+        try:
+            mt.begin_query(101)
+            try:
+                tag = pool.allocate(4096)
+                assert tag[0] == 101, tag
+                a_allocated.set()
+                # hold the allocation live across B's whole lifecycle
+                assert b_done.wait(30)
+                pool.release(4096, tag=tag)
+                mt.audit_query(101, strict=True)  # clean after release
+            finally:
+                mt.end_query(101)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+            a_allocated.set()
+
+    def qb():
+        try:
+            assert a_allocated.wait(30)
+            mt.begin_query(202)
+            try:
+                tag = pool.allocate(1024)
+                assert tag[0] == 202, tag
+                pool.release(1024, tag=tag)
+                # strict audit of B must NOT trip over A's live 4096 bytes
+                report = mt.audit_query(202, strict=True)
+                assert report["leaked_bytes"] == 0
+            finally:
+                mt.end_query(202)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+        finally:
+            b_done.set()
+
+    ta, tb = threading.Thread(target=qa), threading.Thread(target=qb)
+    ta.start(); tb.start()
+    ta.join(); tb.join()
+    assert not errs, errs
+    assert pool.used == 0
+
+
+def test_memtrack_single_query_fallback_for_worker_threads():
+    """With exactly one active query, a worker thread with no thread-local
+    id still inherits it (the pre-serving behavior PrefetchIterator's
+    consumer-built tags rely on)."""
+    mt.begin_query(55)
+    got = {}
+
+    def worker():
+        got["qid"] = mt.current_query()
+
+    t = threading.Thread(target=worker)
+    t.start(); t.join()
+    assert got["qid"] == 55
+    mt.end_query(55)
+    assert mt.current_query() is None
+
+
+# -- chaos lane -------------------------------------------------------------
+
+
+@chaos
+def test_chaos_concurrent_serving_bit_identical():
+    """Seeded faults at serve.admit/serve.cancel plus mem.alloc while N
+    threads submit mixed queries: sheds are retried, slow polls ride
+    through, and every result is bit-identical to the fault-free run."""
+    conf = C.RapidsConf()
+    dfs = _queries(conf)
+    expected = [d.to_arrow() for d in dfs]
+    faults.install(
+        f"serve.admit:error@p=0.2,seed={FAULTS_SEED};"
+        f"serve.cancel:slow@p=0.05,seed={FAULTS_SEED + 1},ms=10;"
+        f"mem.alloc:retry@p=0.02,seed={FAULTS_SEED + 2}")
+    srv = QueryServer(conf)
+    errs = []
+
+    def client(ci):
+        try:
+            for i in range(4):
+                k = (ci + i) % len(dfs)
+                for _attempt in range(8):
+                    try:
+                        tk = srv.submit(dfs[k], name=f"c{ci}#{i}")
+                    except AdmissionRejected:
+                        time.sleep(0.01)
+                        continue
+                    assert tk.result(timeout_s=180).equals(expected[k])
+                    break
+                else:
+                    raise AssertionError("shed 8 times in a row")
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        srv.close()
+    assert not errs, errs
+    assert get_pool().used == 0
