@@ -132,13 +132,14 @@ def _cpu_tpch(li, orders, cust, supp, nation, region):
     return {"q1": q1, "q3": q3, "q5": q5, "q6": q6}
 
 
-def _measure_roofline():
+def _measure_roofline(n=1 << 28, reps=3):
     """Delivered device reduce bandwidth through this tunnel: bytes/s of a
-    pipelined 1GB f32 sum."""
+    pipelined f32 sum (1GB at the default ``n``). ``n``/``reps`` shrink
+    under a tight --budget — a cheap measurement is still a valid ceiling
+    estimate, and per-query roofline_util lines must never go missing."""
     import jax
     import jax.numpy as jnp
 
-    n = 1 << 28
     x = jnp.ones(n, jnp.float32)
     x.block_until_ready()
 
@@ -148,7 +149,7 @@ def _measure_roofline():
 
     red(x, 0.0).block_until_ready()
     best = 0.0
-    for r in range(3):
+    for r in range(reps):
         t0 = time.perf_counter()
         outs = [red(x, 1e-9 * (r * 4 + i)) for i in range(4)]
         for o in outs:
@@ -256,6 +257,19 @@ def main(budget_s=None, faults=None, pool_cap=None):
     from spark_rapids_tpu.utils.sync import fence
 
     _faults_guard(faults, os.environ, pool_cap=pool_cap)
+    # An external timeout (timeout -k N) delivers SIGTERM before SIGKILL;
+    # convert it to SystemExit so the finally block below still flushes the
+    # final driver-metric line (rc stays non-zero — the run is degraded,
+    # not silently healthy).
+    import signal
+
+    def _on_term(signum, frame):
+        raise SystemExit(124)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # non-main thread (tests drive main() directly)
     if pool_cap:
         # memory-pressure run: replace the process pool with a capped one so
         # every device allocation contends for the reduced budget — spill,
@@ -448,135 +462,6 @@ def main(budget_s=None, faults=None, pool_cap=None):
               + li.num_rows + orders.num_rows + cust.num_rows)  # q5
     suite_line("tpch", h_fresh, h_reused, cpu_h_s, rows_h, mem=mem_h)
 
-    # ---- TPC-DS sources + plans -----------------------------------------
-    _mark("tpcds gen+plans")
-    t_gen_ds = time.perf_counter()
-    base_ds = ds_tables(SF_DS)
-    t_gen_ds = time.perf_counter() - t_gen_ds
-    copies_ds_n = COPIES_DS
-    if bud.enabled:
-        while copies_ds_n > 1 and (copies_ds_n - 1) * t_gen_ds > 0.2 * bud.remaining():
-            copies_ds_n -= 1
-        _mark(f"budget: COPIES_DS={copies_ds_n} (of {COPIES_DS}), "
-              f"{bud.remaining():.0f}s left")
-    copies_ds = [base_ds] + [
-        {k: _permute(v, 500 + 11 * c + i) for i, (k, v) in
-         enumerate(base_ds.items())}
-        for c in range(1, copies_ds_n)
-    ]
-    ds_plans = [build_plans(tabs, dev_conf, DSQ.QUERIES, TPCDS_QUERIES,
-                            1 << 22)
-                for tabs in copies_ds]
-
-    # TPC-DS correctness vs the CPU engine + CPU engine baseline timing
-    _mark("tpcds correctness + cpu baseline")
-    cpu_ds_s = 0.0
-    for qn in TPCDS_QUERIES:
-        d = {k: from_arrow(v, cpu_conf) for k, v in base_ds.items()}
-        cdf = DSQ.QUERIES[qn](d)
-        t0 = time.perf_counter()
-        cpu_rows = cdf.collect()
-        cpu_ds_s += time.perf_counter() - t0
-        node, bs = run_plan(ds_plans[0][qn])
-        dev_rows = [r for b in bs
-                    for r in batch_to_arrow(b, node.output_schema).to_pylist()]
-        assert _rows_match(dev_rows, cpu_rows), f"tpcds {qn} mismatch"
-
-    # ---- TPC-DS timed runs ----------------------------------------------
-    _mark("tpcds warmup + timed runs")
-    tm0_ds = _mem_window_start()
-    ds_fresh, ds_reused, t_iter_ds = warm_and_time(
-        ds_plans, TPCDS_QUERIES, 0.75)
-    mem_ds = _mem_window_end(tm0_ds)
-    rows_ds = sum(base_ds["store_sales"].num_rows for _ in TPCDS_QUERIES)
-    suite_line("tpcds", ds_fresh, ds_reused, cpu_ds_s, rows_ds, mem=mem_ds)
-    t_iter = t_iter_h + t_iter_ds
-
-    roofline = None
-    if not bud.enabled or bud.remaining() > 20:
-        _mark("roofline")
-        roofline = _measure_roofline()
-    else:
-        _mark("budget: skipping roofline")
-
-    # ---- per-query profile artifacts (docs/observability.md) ------------
-    # Untimed pass on freshly planned copies so per-node metrics reflect
-    # exactly one execution (the timed plans have accumulated RUNS*DEPTH
-    # iterations); traceCapture gives each dump a Perfetto-loadable trace.
-    do_profiles = not bud.enabled or bud.remaining() > 2 * t_iter + 15
-    if not do_profiles:
-        _mark("budget: skipping profile dumps")
-    _mark("profile dumps")
-    from spark_rapids_tpu.obs import profile_for
-
-    prof_conf = RapidsConf({"spark.rapids.tpu.profile.traceCapture": True})
-    prof_dir = os.environ.get("BENCH_PROFILE_DIR", "artifacts")
-    os.makedirs(prof_dir, exist_ok=True)
-    profile_files, trace_files = [], []
-    specs = ([("tpch", qn, base_h, tpch.DF_QUERIES, 1 << 24)
-              for qn in h_names]
-             + [("tpcds", qn, base_ds, DSQ.QUERIES, 1 << 22)
-                for qn in TPCDS_QUERIES]) if do_profiles else []
-    from spark_rapids_tpu.obs import histo as _histo
-    batch_histo = _histo.get("batch_op_ns")
-    from spark_rapids_tpu.obs import memtrack as _mt
-    for suite, qn, tabs, builders, batch_rows in specs:
-        node = build_plans(tabs, prof_conf, builders, [qn], batch_rows)[qn]
-        prof = profile_for(node)
-        b0 = batch_histo.snapshot()
-        # run_plan drives the exec tree directly (no DataFrame), so open
-        # the attribution window the dataframe layer would normally own
-        if prof is not None:
-            _mt.begin_query(prof.query_id)
-        try:
-            fence([run_plan(node)[1]])
-        finally:
-            if prof is not None:
-                _mt.end_query(prof.query_id)
-        if prof is None:
-            continue
-        prof.finish(node)
-        # per-query metric line: wall, plan/compile/execute attribution, and
-        # batch-op tail percentiles over exactly this query's window
-        win = _histo.diff(b0, batch_histo.snapshot())
-        ph = prof.phases
-        print(json.dumps({
-            "query": f"{suite}_{qn}",
-            "wall_ms": round(prof.wall_ns / 1e6, 3),
-            "phases_ms": {
-                "plan": round(sum(ph.get(p, 0.0) for p in
-                                  ("plan-rewrite", "reuse", "fusion",
-                                   "prefetch")), 3),
-                "compile": ph.get("compile", 0.0),
-                "execute": ph.get("execute", 0.0),
-            },
-            "batch_op_ms": batch_histo.percentiles_ms(win),
-            # per-query HBM attribution (obs/memtrack.py via the profile)
-            "peak_hbm_bytes": prof.memory.get("tracked_peak_bytes", 0),
-            "spill_bytes": sum(prof.task_metrics.get(f, 0) for f in
-                               ("spill_to_host_bytes",
-                                "spill_to_disk_bytes")),
-            # oversized-agg evidence (docs/oversized_state.md): passes this
-            # query triggered and the deepest recursion level reached
-            "repartitions": prof.task_metrics.get(
-                "agg_repartition_count", 0),
-            "repartition_depth": prof.task_metrics.get(
-                "max_agg_repartition_depth", 0),
-        }), flush=True)
-        ppath = os.path.join(prof_dir, f"profile_{suite}_{qn}.json")
-        with open(ppath, "w") as f:
-            json.dump({**prof.to_dict(),
-                       "explain_analyze": prof.explain_analyze()},
-                      f, indent=1, default=str)
-        profile_files.append(ppath)
-        trace_files.append(prof.dump_chrome_trace(
-            os.path.join(prof_dir, f"trace_{suite}_{qn}.json")))
-    from spark_rapids_tpu.obs import write_textfile
-    prom_path = write_textfile(os.path.join(prof_dir, "metrics_bench.prom"))
-    from tools.trace_viewer_check import check_file
-    bad_traces = {p: errs for p in trace_files if (errs := check_file(p))}
-    assert not bad_traces, f"invalid chrome traces: {bad_traces}"
-
     def q_bytes(table, cols):
         return sum(table.column(c).nbytes for c in cols)
 
@@ -595,40 +480,242 @@ def main(budget_s=None, faults=None, pool_cap=None):
         + q_bytes(orders, ["o_orderkey", "o_custkey", "o_orderdate"])
         + q_bytes(cust, ["c_custkey", "c_nationkey"])
     )
-    total_fresh = h_fresh[0] + ds_fresh[0]
-    total_med = h_fresh[1] + ds_fresh[1]
-    cpu_total = cpu_h_s + cpu_ds_s
-    util = ((bytes_h / h_fresh[0]) / roofline
-            if roofline is not None else None)
 
-    print(json.dumps({
-        "tpch_s_per_iter": {"fresh_min": round(h_fresh[0], 4),
-                            "fresh_median": round(h_fresh[1], 4),
-                            "reused_min": _r(h_reused[0], 4),
-                            "reused_median": _r(h_reused[1], 4)},
-        "tpcds_s_per_iter": {"fresh_min": round(ds_fresh[0], 4),
-                             "fresh_median": round(ds_fresh[1], 4),
-                             "reused_min": _r(ds_reused[0], 4),
-                             "reused_median": _r(ds_reused[1], 4)},
-        "cpu_s": {"tpch_pandas": round(cpu_h_s, 3),
-                  "tpcds_cpu_engine": round(cpu_ds_s, 3)},
-        "roofline_GBps": _r(roofline / 1e9 if roofline is not None else None, 2),
-        "tpch_bytes_per_iter_GB": round(bytes_h / 1e9, 3),
-        "queries": {"tpch": h_names, "tpcds": TPCDS_QUERIES,
-                    "sf": {"tpch": SF_H, "tpcds": SF_DS}},
-        "pool_cap": int(pool_cap) if pool_cap else None,
-        "profiles": profile_files,
-        "traces": trace_files,
-        "prometheus": prom_path,
-    }))
-    print(json.dumps({
-        "metric": "tpch4_sf2_plus_tpcds5_sf1_rows_per_sec",
-        "value": round((rows_h + rows_ds) / total_fresh, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(cpu_total / total_fresh, 3),
-        "utilization": _r(util, 4),
-        "value_median": round((rows_h + rows_ds) / total_med, 1),
-    }))
+    # Everything below fills this state; the finally block flushes the
+    # final driver-metric lines from whatever completed. A budgeted or
+    # externally-timed-out run degrades to null fields, never to a dead
+    # process with no parseable metric line.
+    ds_fresh = ds_reused = (None, None)
+    cpu_ds_s = 0.0
+    rows_ds = 0
+    t_iter_ds = 0.0
+    ds_ran = False
+    roofline = None
+    profile_files, trace_files = [], []
+    prom_path = None
+    try:
+        # ---- TPC-DS sources + plans ---------------------------------
+        run_ds = not (bud.enabled
+                      and bud.remaining() < max(60.0, 8 * t_iter_h))
+        if not run_ds:
+            _mark(f"budget: skipping tpcds suite "
+                  f"({bud.remaining():.0f}s left)")
+        if run_ds:
+            _mark("tpcds gen+plans")
+            t_gen_ds = time.perf_counter()
+            base_ds = ds_tables(SF_DS)
+            t_gen_ds = time.perf_counter() - t_gen_ds
+            copies_ds_n = COPIES_DS
+            if bud.enabled:
+                while copies_ds_n > 1 and (copies_ds_n - 1) * t_gen_ds > 0.2 * bud.remaining():
+                    copies_ds_n -= 1
+                _mark(f"budget: COPIES_DS={copies_ds_n} (of {COPIES_DS}), "
+                      f"{bud.remaining():.0f}s left")
+            copies_ds = [base_ds] + [
+                {k: _permute(v, 500 + 11 * c + i) for i, (k, v) in
+                 enumerate(base_ds.items())}
+                for c in range(1, copies_ds_n)
+            ]
+            ds_plans = [build_plans(tabs, dev_conf, DSQ.QUERIES,
+                                    TPCDS_QUERIES, 1 << 22)
+                        for tabs in copies_ds]
+            if bud.enabled and bud.remaining() < max(30.0, 6 * t_iter_h):
+                _mark(f"budget: skipping tpcds correctness+timed "
+                      f"({bud.remaining():.0f}s left)")
+                run_ds = False
+        if run_ds:
+            # TPC-DS correctness vs the CPU engine + CPU baseline timing
+            _mark("tpcds correctness + cpu baseline")
+            for qn in TPCDS_QUERIES:
+                d = {k: from_arrow(v, cpu_conf) for k, v in base_ds.items()}
+                cdf = DSQ.QUERIES[qn](d)
+                t0 = time.perf_counter()
+                cpu_rows = cdf.collect()
+                cpu_ds_s += time.perf_counter() - t0
+                node, bs = run_plan(ds_plans[0][qn])
+                dev_rows = [
+                    r for b in bs
+                    for r in batch_to_arrow(b, node.output_schema).to_pylist()]
+                assert _rows_match(dev_rows, cpu_rows), f"tpcds {qn} mismatch"
+
+            # ---- TPC-DS timed runs ----------------------------------
+            _mark("tpcds warmup + timed runs")
+            tm0_ds = _mem_window_start()
+            ds_fresh, ds_reused, t_iter_ds = warm_and_time(
+                ds_plans, TPCDS_QUERIES, 0.75)
+            mem_ds = _mem_window_end(tm0_ds)
+            rows_ds = sum(base_ds["store_sales"].num_rows
+                          for _ in TPCDS_QUERIES)
+            suite_line("tpcds", ds_fresh, ds_reused, cpu_ds_s, rows_ds,
+                       mem=mem_ds)
+            ds_ran = True
+        t_iter = t_iter_h + t_iter_ds
+
+        if not bud.enabled or bud.remaining() > 20:
+            _mark("roofline")
+            roofline = _measure_roofline()
+        else:
+            # tight budget: a 1-rep 64MB sweep costs well under a second
+            # and keeps roofline_util on every per-query line
+            _mark("budget: cheap roofline")
+            roofline = _measure_roofline(n=1 << 24, reps=1)
+
+        # ---- per-query profile artifacts (docs/observability.md) --------
+        # Untimed pass on freshly planned copies so per-node metrics reflect
+        # exactly one execution (the timed plans have accumulated RUNS*DEPTH
+        # iterations); traceCapture gives each dump a Perfetto-loadable
+        # trace.
+        do_profiles = not bud.enabled or bud.remaining() > 2 * t_iter + 15
+        if not do_profiles:
+            _mark("budget: skipping profile dumps")
+        _mark("profile dumps")
+        from spark_rapids_tpu.obs import profile_for
+
+        prof_conf = RapidsConf(
+            {"spark.rapids.tpu.profile.traceCapture": True})
+        prof_dir = os.environ.get("BENCH_PROFILE_DIR", "artifacts")
+        os.makedirs(prof_dir, exist_ok=True)
+        specs = []
+        if do_profiles:
+            specs = [("tpch", qn, base_h, tpch.DF_QUERIES, 1 << 24)
+                     for qn in h_names]
+            if ds_ran:
+                specs += [("tpcds", qn, base_ds, DSQ.QUERIES, 1 << 22)
+                          for qn in TPCDS_QUERIES]
+        from spark_rapids_tpu.obs import histo as _histo
+        batch_histo = _histo.get("batch_op_ns")
+        from spark_rapids_tpu.obs import memtrack as _mt
+        for suite, qn, tabs, builders, batch_rows in specs:
+            if bud.enabled and bud.remaining() < 1.5 * t_iter + 10:
+                _mark(f"budget: stopping profile dumps at {suite}_{qn} "
+                      f"({bud.remaining():.0f}s left)")
+                break
+            # record which tables the query builder touches — their arrow
+            # bytes anchor the bytes-touched estimate below (intermediate
+            # HBM attribution only sees pooled/spillable allocations)
+            accessed = set()
+
+            class _Rec(dict):
+                def __getitem__(self, k, _a=accessed):
+                    _a.add(k)
+                    return dict.__getitem__(self, k)
+
+            d = _Rec({k: from_arrow(v, prof_conf, batch_rows=batch_rows)
+                      for k, v in tabs.items()})
+            node = builders[qn](d).physical_plan()
+            prof = profile_for(node)
+            b0 = batch_histo.snapshot()
+            # run_plan drives the exec tree directly (no DataFrame), so open
+            # the attribution window the dataframe layer would normally own
+            if prof is not None:
+                _mt.begin_query(prof.query_id)
+            try:
+                fence([run_plan(node)[1]])
+            finally:
+                if prof is not None:
+                    _mt.end_query(prof.query_id)
+            if prof is None:
+                continue
+            prof.finish(node)
+            # per-query metric line: wall, plan/compile/execute attribution,
+            # and batch-op tail percentiles over exactly this query's window
+            win = _histo.diff(b0, batch_histo.snapshot())
+            ph = prof.phases
+            # bytes the query touched: arrow bytes of every input table the
+            # builder referenced (each is read at least once), plus tracked
+            # pooled-HBM allocations (written once each) and spill round
+            # trips. Utilization divides by execute-phase time — this
+            # untimed pass pays full compile, which is not bandwidth.
+            input_bytes = sum(tabs[k].nbytes for k in accessed)
+            mem_ops = prof.memory.get("ops", {})
+            alloc_bytes = sum(int(g.get("allocd", 0))
+                              for g in mem_ops.values())
+            spill_rw = sum(prof.task_metrics.get(f, 0) for f in
+                           ("spill_to_host_bytes", "spill_to_disk_bytes",
+                            "read_spill_bytes"))
+            bytes_touched = input_bytes + alloc_bytes + spill_rw
+            ex_s = (ph.get("execute") or prof.wall_ns / 1e6) / 1e3
+            print(json.dumps({
+                "query": f"{suite}_{qn}",
+                "wall_ms": round(prof.wall_ns / 1e6, 3),
+                "phases_ms": {
+                    "plan": round(sum(ph.get(p, 0.0) for p in
+                                      ("plan-rewrite", "reuse", "fusion",
+                                       "prefetch")), 3),
+                    "compile": ph.get("compile", 0.0),
+                    "execute": ph.get("execute", 0.0),
+                },
+                "batch_op_ms": batch_histo.percentiles_ms(win),
+                # per-query HBM attribution (obs/memtrack.py via profile)
+                "peak_hbm_bytes": prof.memory.get("tracked_peak_bytes", 0),
+                "spill_bytes": sum(prof.task_metrics.get(f, 0) for f in
+                                   ("spill_to_host_bytes",
+                                    "spill_to_disk_bytes")),
+                "bytes_touched": int(bytes_touched),
+                "roofline_util": (round(bytes_touched / ex_s / roofline, 6)
+                                  if roofline and ex_s > 0 else None),
+                # oversized-agg evidence (docs/oversized_state.md): passes
+                # this query triggered and the deepest level reached
+                "repartitions": prof.task_metrics.get(
+                    "agg_repartition_count", 0),
+                "repartition_depth": prof.task_metrics.get(
+                    "max_agg_repartition_depth", 0),
+            }), flush=True)
+            ppath = os.path.join(prof_dir, f"profile_{suite}_{qn}.json")
+            with open(ppath, "w") as f:
+                json.dump({**prof.to_dict(),
+                           "explain_analyze": prof.explain_analyze()},
+                          f, indent=1, default=str)
+            profile_files.append(ppath)
+            trace_files.append(prof.dump_chrome_trace(
+                os.path.join(prof_dir, f"trace_{suite}_{qn}.json")))
+        from spark_rapids_tpu.obs import write_textfile
+        prom_path = write_textfile(
+            os.path.join(prof_dir, "metrics_bench.prom"))
+        from tools.trace_viewer_check import check_file
+        bad_traces = {p: errs for p in trace_files
+                      if (errs := check_file(p))}
+        assert not bad_traces, f"invalid chrome traces: {bad_traces}"
+    finally:
+        # flushed even when a suite was skipped for budget or the run died
+        # mid-phase (an exception or the SIGTERM handler above) — partial
+        # fields go out as null instead of the whole line going missing
+        total_fresh = h_fresh[0] + (ds_fresh[0] or 0.0)
+        total_med = h_fresh[1] + (ds_fresh[1] or 0.0)
+        cpu_total = cpu_h_s + cpu_ds_s
+        util = ((bytes_h / h_fresh[0]) / roofline
+                if roofline is not None else None)
+
+        print(json.dumps({
+            "tpch_s_per_iter": {"fresh_min": round(h_fresh[0], 4),
+                                "fresh_median": round(h_fresh[1], 4),
+                                "reused_min": _r(h_reused[0], 4),
+                                "reused_median": _r(h_reused[1], 4)},
+            "tpcds_s_per_iter": {"fresh_min": _r(ds_fresh[0], 4),
+                                 "fresh_median": _r(ds_fresh[1], 4),
+                                 "reused_min": _r(ds_reused[0], 4),
+                                 "reused_median": _r(ds_reused[1], 4)},
+            "cpu_s": {"tpch_pandas": round(cpu_h_s, 3),
+                      "tpcds_cpu_engine": round(cpu_ds_s, 3)},
+            "roofline_GBps": _r(
+                roofline / 1e9 if roofline is not None else None, 2),
+            "tpch_bytes_per_iter_GB": round(bytes_h / 1e9, 3),
+            "queries": {"tpch": h_names,
+                        "tpcds": TPCDS_QUERIES if ds_ran else [],
+                        "sf": {"tpch": SF_H, "tpcds": SF_DS}},
+            "pool_cap": int(pool_cap) if pool_cap else None,
+            "profiles": profile_files,
+            "traces": trace_files,
+            "prometheus": prom_path,
+        }), flush=True)
+        print(json.dumps({
+            "metric": "tpch4_sf2_plus_tpcds5_sf1_rows_per_sec",
+            "value": round((rows_h + rows_ds) / total_fresh, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(cpu_total / total_fresh, 3),
+            "utilization": _r(util, 4),
+            "value_median": round((rows_h + rows_ds) / total_med, 1),
+        }), flush=True)
 
 
 def _latency_guard(environ):

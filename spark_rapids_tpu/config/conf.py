@@ -520,7 +520,46 @@ JOIN_DENSE_MAX_DOMAIN = conf(
 JOIN_UNIQUE_MAX_SLOTS = conf(
     "spark.rapids.tpu.sql.join.uniqueTable.maxSlots", default=16,
     doc="Bucket-scan width cap for the bucketed unique-key join table; "
-        "build sides needing more slots use the general sorted-hash join.")
+        "build sides needing more slots use the general hash-table join.")
+
+JOIN_HASHTBL_ENABLED = conf(
+    "spark.rapids.tpu.sql.join.hashTable.enabled", default=True,
+    doc="Use the open-addressing device hash table (kernels.HashTable) for "
+        "duplicate-key / wide-domain build sides, with bounded chunked "
+        "gather output; disabled falls back to the round-2 sorted-hash "
+        "join with its candidate-explosion guard (docs/kernels.md).")
+
+JOIN_CHUNK_TARGET_ROWS = conf(
+    "spark.rapids.tpu.sql.join.gatherChunkTargetRows", default=1 << 22,
+    doc="Candidate-pair budget per emitted output chunk of the general "
+        "hash-table join. One probe batch whose candidates exceed this is "
+        "emitted as multiple bounded chunks through the spillable "
+        "framework (GpuSubPartitionHashJoin gatherer-chunking analog) "
+        "instead of materializing at once.",
+    check=lambda v: None if v >= 1024 else "must be >= 1024")
+
+AGG_HASHTBL_ENABLED = conf(
+    "spark.rapids.tpu.sql.agg.hashTable.enabled", default=True,
+    internal=True,
+    doc="Cluster 128-bit-hashed group keys through the open-addressing "
+        "table (one int32 slot sort) instead of the 128-bit lexsort. "
+        "Read at trace time; same treat-as-exact grouping bar.")
+
+HASHTBL_PALLAS_MODE = conf(
+    "spark.rapids.tpu.sql.kernel.hashTable.pallasMode", default="auto",
+    internal=True,
+    doc="Hash-table probe kernel dispatch: 'auto' uses the Pallas kernel "
+        "on TPU backends and pure XLA elsewhere; 'on'/'off' force a side. "
+        "Any Pallas lowering failure falls back to XLA permanently.",
+    check=lambda v: None if v in ("auto", "on", "off")
+    else "must be auto|on|off")
+
+STRING_SORT_MAX_WORDS = conf(
+    "spark.rapids.tpu.sql.sort.stringKeyMaxWords", default=16,
+    doc="Widest static string sort key in uint64 words (8 bytes each). "
+        "Sorts widen keys to the observed max row length bucketed to a "
+        "power of two; rows longer than 8*words bytes tie past the cap.",
+    check=lambda v: None if v >= 2 else "must be >= 2")
 
 SCAN_ROW_GROUP_PRUNING = conf(
     "spark.rapids.tpu.sql.parquet.rowGroupPruning.enabled", default=True,

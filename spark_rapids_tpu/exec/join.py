@@ -144,18 +144,36 @@ class HashJoinExec(BinaryExec):
                 for h in handles:
                     h.close()
             dense = self._prepare_dense(build)
-            table = jh = None
+            table = jh = ht = None
             if dense is None:
                 prep = self._prepare_table(build)
-                if isinstance(prep, K.JoinHashes):
-                    jh = prep  # duplicate keys: general path, sort reused
-                elif prep is not None:
+                if prep is not None and not isinstance(prep, K.JoinHashes):
                     table = prep
                 else:
-                    jh = _prepare_build(build, tuple(self._rkeys))
+                    # duplicate keys (JoinHashes view) or build too large:
+                    # the general path. Round 12: open-addressing hash
+                    # table with chunked gather; the sorted-hash join is
+                    # the conf-off / rehash-exhausted fallback.
+                    if self._hashtbl_enabled:
+                        ht = K.build_batch_hash_table(build,
+                                                      tuple(self._rkeys))
+                    if ht is None:
+                        jh = (prep if isinstance(prep, K.JoinHashes)
+                              else _prepare_build(build, tuple(self._rkeys)))
         build_matched = jnp.zeros(build.capacity, jnp.bool_)
 
         for probe in self.left.execute(partition):
+            if ht is not None:
+                with self.timer("joinTimeNs"):
+                    handles, build_matched = self._join_batch_ht(
+                        probe, build, ht, build_matched, partition)
+                for hd in handles:
+                    try:
+                        yield hd.get()
+                    finally:
+                        hd.unpin()
+                        hd.close()
+                continue
             with self.timer("joinTimeNs"):
                 if dense is not None:
                     out, build_matched = self._join_batch_dense(
@@ -359,7 +377,8 @@ class HashJoinExec(BinaryExec):
         }
         bcaps = {i: bucket_capacity(max(int(b), 8), 8) for i, b in zip(bstr, bbytes)}
         pi, bi, nver, pmatch = _verified_pairs(
-            probe, build, jh, lo, cnt, lkeys, rkeys, self._cond_bound, out_cap,
+            probe, build, jh.order, lo, cnt, jnp.int32(0),
+            jnp.int32(cnt.shape[0]), lkeys, rkeys, self._cond_bound, out_cap,
             tuple(sorted(pcaps.items())), tuple(sorted(bcaps.items())))
         self._pcaps, self._bcaps = pcaps, bcaps
 
@@ -391,6 +410,139 @@ class HashJoinExec(BinaryExec):
         out = self._gather_pairs(probe, build, pi, bi, bi_valid, n_out, out_cap)
         return out, new_matched
 
+    # -- general hash-table path with chunked gather -----------------------
+    # Round-12 tentpole: duplicate-key / wide-domain builds probe an
+    # open-addressing device table (kernels.build_batch_hash_table) instead
+    # of re-sorting hashes per build. Oversized probe outputs are emitted in
+    # bounded row-range CHUNKS (GpuSubPartitionHashJoin's JoinGatherer
+    # analog): the candidate prefix sum is cut into ranges of at most
+    # gatherChunkTargetRows candidates, each gathered into its own batch and
+    # wrapped spillable, so a skewed probe batch never materializes its full
+    # output at once — and never trips the candidate-explosion guard.
+
+    @property
+    def _hashtbl_enabled(self) -> bool:
+        from spark_rapids_tpu.config import conf as _C
+        return _C.JOIN_HASHTBL_ENABLED.get(_C.get_active())
+
+    @property
+    def _chunk_target_rows(self) -> int:
+        from spark_rapids_tpu.config import conf as _C
+        return _C.JOIN_CHUNK_TARGET_ROWS.get(_C.get_active())
+
+    def _join_batch_ht(self, probe: ColumnarBatch, build: ColumnarBatch,
+                       ht, build_matched, partition: int):
+        import numpy as np
+        from spark_rapids_tpu.mem.spill import SpillableBatch, get_framework
+
+        tbl, capacity, seed = ht
+        jt = self.join_type
+        lkeys, rkeys = tuple(self._lkeys), tuple(self._rkeys)
+        pstr = tuple(i for i, c in enumerate(probe.columns)
+                     if c.offsets is not None)
+        K._note_hashtbl("hashtbl_probe_total")
+        ph1, ph2, pvalid = _ht_probe_hashes(probe, lkeys)
+        slot, hit = K.probe_hash_table_dispatch(tbl, ph1, ph2, capacity,
+                                                seed, K.HASHTBL_MAX_PROBES)
+        lo, cnt, total_dev, ends, pml_dev = _ht_candidate_stats(
+            tbl, slot, hit & pvalid, probe, pstr)
+        got = jax.device_get((total_dev,) + tuple(pml_dev))
+        total = int(got[0])
+        pml = {i: int(m) for i, m in zip(pstr, got[1:])}
+        self.metrics["numCandidatePairs"].add(total)
+        if total > self.max_candidate_rows:
+            # chunking bounds what materializes at once, but a probe batch
+            # whose TOTAL candidate count blows the budget is still a
+            # semi-cartesian key explosion: degrade loudly (q72 guard)
+            raise RuntimeError(
+                f"join candidate explosion: one probe batch produced "
+                f"{total} candidate pairs (> "
+                f"spark.rapids.tpu.sql.join.maxCandidateRowsPerBatch="
+                f"{self.max_candidate_rows}); check the join keys "
+                f"({self.node_description()})")
+        # longest build row per string column, read once per partition
+        cache = getattr(self, "_dense_bcache", None)
+        if cache is None:
+            cache = self._dense_bcache = {}
+        ckey = ("ht", partition)
+        if ckey not in cache:
+            cache[ckey] = {
+                i: int(jax.device_get(
+                    jnp.max(c.offsets[1:] - c.offsets[:-1])))
+                for i, c in enumerate(build.columns)
+                if c.offsets is not None}
+        bml = cache[ckey]
+
+        # cut the candidate prefix sum into bounded row ranges
+        chunk_target = self._chunk_target_rows
+        cap_rows = probe.capacity
+        if total <= chunk_target:
+            ranges = [(0, cap_rows, total)]
+        else:
+            ends_h = np.asarray(jax.device_get(ends))
+            ranges = []
+            r0, done = 0, 0
+            while r0 < cap_rows and done < total:
+                # largest r1 with candidates(rows[r0:r1]) <= chunk_target;
+                # a single row past the target gets its own chunk
+                r1 = int(np.searchsorted(ends_h, done + chunk_target,
+                                         side="right"))
+                r1 = min(max(r1, r0 + 1), cap_rows)
+                ctot = int(ends_h[r1 - 1]) - done
+                ranges.append((r0, r1, ctot))
+                done += ctot
+                r0 = r1
+            K._note_hashtbl("hashtbl_chunk_total", len(ranges))
+
+        fw = get_framework()
+        handles = []
+        pmatch_acc = jnp.zeros(probe.capacity, jnp.bool_)
+        pairs_out = jt in ("inner", "left", "right", "full")
+        for (r0, r1, ctot) in ranges:
+            out_cap = bucket_capacity(max(ctot, 1), 16)
+            pcaps = {i: bucket_capacity(max(ctot * max(pml[i], 1), 8), 8)
+                     for i in pstr}
+            bcaps = {i: bucket_capacity(max(ctot * max(m, 1), 8), 8)
+                     for i, m in bml.items()}
+            pi, bi, nver, pmatch = _verified_pairs(
+                probe, build, tbl.order, lo, cnt, jnp.int32(r0),
+                jnp.int32(r1), lkeys, rkeys, self._cond_bound, out_cap,
+                tuple(sorted(pcaps.items())), tuple(sorted(bcaps.items())))
+            pmatch_acc = pmatch_acc | pmatch
+            if jt in ("right", "full"):
+                build_matched = build_matched.at[
+                    jnp.where(jnp.arange(out_cap, dtype=jnp.int32) < nver,
+                              bi, build.capacity)
+                ].set(True, mode="drop")
+            if pairs_out:
+                self._pcaps, self._bcaps = pcaps, bcaps
+                out = self._gather_pairs(
+                    probe, build, pi, bi,
+                    jnp.arange(out_cap, dtype=jnp.int32) < nver, nver,
+                    out_cap)
+                handles.append(SpillableBatch(out, fw))
+        if jt in ("left", "full"):
+            # unmatched probe rows ride as their own (final) chunk
+            unmatched = ~pmatch_acc & probe.active_mask()
+            n = int(jnp.sum(unmatched))
+            if n > 0:
+                out_cap = bucket_capacity(n, 16)
+                uidx, un = K.filter_indices(unmatched, probe.active_mask())
+                row_valid = jnp.arange(out_cap, dtype=jnp.int32) < un
+                sidx = (uidx[:out_cap] if uidx.shape[0] >= out_cap
+                        else _pad_idx(uidx, out_cap))
+                cols = list(K.gather_columns(probe.columns, sidx, row_valid))
+                for f in self.right.output_schema:
+                    cols.append(_null_column(f.dtype, out_cap))
+                handles.append(SpillableBatch(
+                    ColumnarBatch(cols, un.astype(jnp.int32)), fw))
+        elif jt in ("left_semi", "left_anti"):
+            want = (pmatch_acc if jt == "left_semi"
+                    else ~pmatch_acc & probe.active_mask())
+            idx, n = K.filter_indices(want, probe.active_mask())
+            handles.append(SpillableBatch(K.gather_batch(probe, idx, n), fw))
+        return handles, build_matched
+
     # -- whole-stage fusion hook (exec/fused.py) ---------------------------
     def fused_probe(self, partition: int):
         """Build this join's build side now and return a stage segment whose
@@ -417,7 +569,7 @@ class HashJoinExec(BinaryExec):
                 kind, tbl = "dense", dense
             else:
                 prep = self._prepare_table(build)
-                if isinstance(prep, tuple):
+                if prep is not None and not isinstance(prep, K.JoinHashes):
                     kind, (tbl, slots) = "unique", prep
                     lg_b = tbl.lg_b
                 else:
@@ -676,6 +828,33 @@ def _probe_stats(probe, build, jh, lkeys, pstr, bstr):
     return lo, cnt, total, pbytes, bbytes
 
 
+@partial(jax.jit, static_argnums=(1,))
+def _ht_probe_hashes(probe, lkeys):
+    """Probe-side 128-bit hash pair + null-key mask for the table probe."""
+    ph1 = K.hash_keys(probe, list(lkeys))
+    ph2 = K.hash_keys(probe, list(lkeys), variant=1)
+    pvalid = probe.active_mask()
+    for i in lkeys:
+        pvalid = pvalid & probe.columns[i].validity
+    return ph1, ph2, pvalid
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _ht_candidate_stats(tbl, slot, ok, probe, pstr):
+    """Candidate ranges + totals for the hash-table probe in one pass.
+
+    Returns (lo, cnt, total, ends, probe_max_lens): ``ends`` is the
+    candidate prefix sum the chunker cuts into row ranges; the probe string
+    max lengths ride along so the host reads everything in one sync."""
+    lo, cnt = K.hashtbl_candidate_ranges(tbl, slot, ok)
+    c64 = cnt.astype(jnp.int64)
+    total = jnp.sum(c64)
+    ends = jnp.cumsum(c64)
+    pml = [jnp.max(probe.columns[i].offsets[1:]
+                   - probe.columns[i].offsets[:-1]) for i in pstr]
+    return lo, cnt, total, ends, pml
+
+
 @partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _unique_probe(probe, build, tbl, build_matched, lkeys, rkeys, slots,
                   lg_b, cond_bound, jt, bcap_items):
@@ -701,16 +880,24 @@ def _unique_probe(probe, build, tbl, build_matched, lkeys, rkeys, slots,
     return bi, hit, new_matched
 
 
-@partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
-def _verified_pairs(probe, build, jh, lo, cnt, lkeys, rkeys, cond_bound,
-                    out_cap, pcap_items, bcap_items):
+@partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12))
+def _verified_pairs(probe, build, order, lo, cnt, r0, r1, lkeys, rkeys,
+                    cond_bound, out_cap, pcap_items, bcap_items):
     """Expand candidates, verify exact key equality (+ residual condition).
+
+    ``order`` maps candidate positions to build rows (JoinHashes.order or
+    HashTable.order — both are the same count+offset duplicate layout).
+    Only probe rows in [r0, r1) contribute: the chunked gather runs this
+    once per row range with the same traced program (r0/r1 ride as traced
+    scalars, so chunk boundaries never force a recompile).
 
     Returns (probe_idx, build_row, n_verified, probe_matched)."""
     pcaps, bcaps = dict(pcap_items), dict(bcap_items)
+    rows = jnp.arange(cnt.shape[0], dtype=jnp.int32)
+    cnt = jnp.where((rows >= r0) & (rows < r1), cnt, 0)
     probe_c, slot, pair_valid = K.expand_candidates(lo, cnt, out_cap)
-    slot_c = jnp.clip(slot, 0, jh.order.shape[0] - 1)
-    build_row = jh.order[slot_c]
+    slot_c = jnp.clip(slot, 0, order.shape[0] - 1)
+    build_row = order[slot_c]
     ver = pair_valid & K.keys_equal(probe, probe_c, list(lkeys),
                                     build, build_row, list(rkeys))
     if cond_bound is not None:

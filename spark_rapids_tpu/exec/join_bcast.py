@@ -95,18 +95,37 @@ class BroadcastHashJoinExec(HashJoinExec):
                     else:
                         build = empty_batch(
                             self.right.output_schema.types(), 16)
-                    jh = jax.jit(K.prepare_join_side, static_argnums=1)(
-                        build, tuple(self._rkeys))
-                self._broadcast = (build, jh)
+                    # round 12: the broadcast build probes the device hash
+                    # table; sorted hashes remain the conf-off / overflow
+                    # fallback
+                    ht = jh = None
+                    if self._hashtbl_enabled:
+                        ht = K.build_batch_hash_table(build,
+                                                      tuple(self._rkeys))
+                    if ht is None:
+                        jh = jax.jit(K.prepare_join_side, static_argnums=1)(
+                            build, tuple(self._rkeys))
+                self._broadcast = (build, jh, ht)
                 if holder is not None:
                     holder.put(self._broadcast)
             return self._broadcast
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._prepare()
-        build, jh = self._build_broadcast()
+        build, jh, ht = self._build_broadcast()
         build_matched = jnp.zeros(build.capacity, jnp.bool_)
         for probe in self.left.execute(partition):
+            if ht is not None:
+                with self.timer("joinTimeNs"):
+                    handles, build_matched = self._join_batch_ht(
+                        probe, build, ht, build_matched, partition)
+                for hd in handles:
+                    try:
+                        yield hd.get()
+                    finally:
+                        hd.unpin()
+                        hd.close()
+                continue
             with self.timer("joinTimeNs"):
                 out, build_matched = self._join_batch(probe, build, jh,
                                                       build_matched)
@@ -117,7 +136,7 @@ class BroadcastHashJoinExec(HashJoinExec):
         # the broadcast build spans ALL build-side partitions — the
         # inherited partition-local materialization would silently drop
         # every match whose build row lives in another partition's slice
-        build, _jh = self._build_broadcast()
+        build, _jh, _ht = self._build_broadcast()
         if not bool(jax.device_get(build.num_rows > 0)):
             return None
         return build

@@ -24,7 +24,8 @@ Key design decisions (TPU-first):
 
 from __future__ import annotations
 
-from functools import partial
+import threading
+from functools import lru_cache, partial
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -347,15 +348,16 @@ def _int_sortable(data: jax.Array) -> jax.Array:
     return _u64_from_words(x) ^ jnp.uint64(_SIGN64)
 
 
-def string_prefix_keys(col: DeviceColumn) -> List[jax.Array]:
-    """Two uint64 keys from the first 16 bytes, big-endian so integer order ==
-    byte-lexicographic order. Exact for strings that differ in the first 16
-    bytes; longer shared prefixes tie (documented round-1 limitation for
-    ORDER BY; grouping/joins use exact hashes + verification instead)."""
+def string_full_keys(col: DeviceColumn, words: int) -> List[jax.Array]:
+    """``words`` uint64 keys from the first ``8 * words`` bytes, big-endian so
+    integer order == byte-lexicographic order, most-significant word first.
+    Shorter rows zero-pad, so a proper prefix sorts before its extensions.
+    ``words`` is static: callers size it from the observed max row length
+    (bucketed to a power of two) so the jit key carries the key width."""
     lens = col.offsets[1:] - col.offsets[:-1]
     nbytes = col.data.shape[0]
     keys = []
-    for word in range(2):
+    for word in range(words):
         acc = jnp.zeros(col.capacity, jnp.uint64)
         for b in range(8):
             k = word * 8 + b
@@ -370,8 +372,18 @@ def string_prefix_keys(col: DeviceColumn) -> List[jax.Array]:
     return keys
 
 
+def string_prefix_keys(col: DeviceColumn) -> List[jax.Array]:
+    """Two uint64 keys from the first 16 bytes (see ``string_full_keys``).
+    Exact for strings that differ in the first 16 bytes; longer shared
+    prefixes tie. Sorts widen past this via SortSpec.str_words
+    (exec/sort.py measures the max row length per batch); grouping/joins
+    use exact hashes + byte verification instead."""
+    return string_full_keys(col, 2)
+
+
 def sortable_keys(
-    col: DeviceColumn, ascending: bool = True, nulls_first: Optional[bool] = None
+    col: DeviceColumn, ascending: bool = True,
+    nulls_first: Optional[bool] = None, str_words: int = 2
 ) -> List[jax.Array]:
     """Per-column lexsort keys, least-significant first within the column.
 
@@ -416,8 +428,12 @@ def sortable_keys(
         d = jnp.where(col.validity & ~is_nan, d, jnp.zeros_like(d))
         return [d, ex]
     if dt in (T.STRING, T.BINARY):
-        pk = string_prefix_keys(col)  # [hi_word, lo_word]; emit lo-first
-        data_keys = [pk[1], pk[0]]
+        # str_words static words of big-endian bytes (most significant
+        # first); emit least-significant first for the lexsort contract.
+        # str_words=2 is the legacy 16-byte prefix; exec/sort.py widens it
+        # to cover the longest row so string ORDER BY is full-width exact.
+        pk = string_full_keys(col, max(int(str_words), 1))
+        data_keys = list(reversed(pk))
         if not ascending:
             data_keys = [~k for k in data_keys]
     elif col.is_wide_decimal:
@@ -500,6 +516,11 @@ class SortSpec(NamedTuple):
     column: int
     ascending: bool = True
     nulls_first: Optional[bool] = None
+    # static string key width in uint64 words (8 bytes each). 2 = the legacy
+    # 16-byte prefix; exec/sort.py buckets the observed max row length to a
+    # power of two so long string keys order full-width. Part of the jit key
+    # (specs are static), so two widths never share a compiled sort.
+    str_words: int = 2
 
 
 def sort_indices(
@@ -515,9 +536,34 @@ def sort_indices(
     # lexsort: LAST key is primary -> emit least-significant spec first
     for spec in reversed(list(specs)):
         keys.extend(sortable_keys(batch.columns[spec.column], spec.ascending,
-                                  spec.nulls_first))
+                                  spec.nulls_first,
+                                  getattr(spec, "str_words", 2)))
     keys.append(jnp.where(active, jnp.uint32(0), jnp.uint32(1)))  # padding last
     return lexsort_chain(keys).astype(jnp.int32)
+
+
+def str_key_words(batch: ColumnarBatch, specs: Sequence[SortSpec],
+                  max_words: int = 16) -> Tuple[SortSpec, ...]:
+    """Widen each plain-string sort spec to cover its column's longest row.
+
+    HOST-side helper (syncs one scalar per plain-string key column): rounds
+    ceil(max_len / 8) up to a power of two so compile count stays bounded,
+    capped at ``max_words`` (rows longer than 8 * max_words bytes tie past
+    that width — the documented residual ORDER BY truncation). Dict-encoded
+    strings already order full-width through their sorted dictionary."""
+    out = []
+    for spec in specs:
+        c = batch.columns[spec.column]
+        w = 2
+        if c.offsets is not None and not c.is_dict and c.data.shape[0] > 0:
+            ml = int(jax.device_get(
+                jnp.max(c.offsets[1:] - c.offsets[:-1])))
+            need = (ml + 7) // 8
+            while w < need:
+                w *= 2
+            w = min(w, max_words)
+        out.append(spec._replace(str_words=w))
+    return tuple(out)
 
 
 # ---------------------------------------------------------------------------
@@ -554,8 +600,7 @@ def _string_hash(col: DeviceColumn, variant: int = 0) -> jax.Array:
     rows = _string_row_ids(col.offsets, nbytes)
     rows_c = jnp.clip(rows, 0, cap - 1)
     rel = jnp.arange(nbytes, dtype=jnp.int32) - col.offsets[rows_c]
-    P = jnp.uint64(_STR_P[variant])
-    powers = _pow_table(P, nbytes)
+    powers = _pow_table(_STR_P[variant], nbytes)
     contrib = (col.data.astype(jnp.uint64) + jnp.uint64(1)) * powers[
         jnp.clip(rel, 0, nbytes - 1)
     ]
@@ -567,13 +612,24 @@ def _string_hash(col: DeviceColumn, variant: int = 0) -> jax.Array:
     return _splitmix64(h ^ (lens * jnp.uint64(_LEN_MIX[variant])))
 
 
-def _pow_table(p: jax.Array, n: int) -> jax.Array:
-    """powers[k] = p^k mod 2^64, by log-depth doubling (n is static)."""
-    vals = jnp.ones(1, jnp.uint64)
-    stride = p
+def _pow_table(p: int, n: int) -> jax.Array:
+    """powers[k] = p^k mod 2^64, by log-depth doubling (n is static).
+
+    Computed host-side: expressed in jnp the doubling chain is a pure
+    constant, and XLA's single-threaded constant folder spends seconds
+    evaluating the multi-million-element multiplies at every compile.
+    Only the numpy table is cached — the jnp handle would be a staged
+    tracer inside a jit trace and must not outlive it."""
+    return jnp.asarray(_pow_table_np(p, n))
+
+
+@lru_cache(maxsize=32)
+def _pow_table_np(p: int, n: int) -> np.ndarray:
+    vals = np.ones(1, np.uint64)
+    stride = p & 0xFFFFFFFFFFFFFFFF
     while vals.shape[0] < n:
-        vals = jnp.concatenate([vals, vals * stride])
-        stride = stride * stride
+        vals = np.concatenate([vals, vals * np.uint64(stride)])
+        stride = (stride * stride) & 0xFFFFFFFFFFFFFFFF
     return vals[:n]
 
 
@@ -666,17 +722,64 @@ def _string_sig_at(c: DeviceColumn, idx: jax.Array):
     return h, lens, pk[0][idx], pk[1][idx]
 
 
+def _string_rows_at(c: DeviceColumn, idx: jax.Array):
+    """(byte buffer, row start, row length) for string rows at ``idx``,
+    dict-aware (dict rows resolve into the dictionary's byte space)."""
+    if c.is_dict:
+        d = c.dictionary
+        codes = jnp.clip(c.data, 0, d.capacity - 1)[idx]
+        return d.data, d.offsets[:-1][codes], (d.offsets[1:]
+                                               - d.offsets[:-1])[codes]
+    return c.data, c.offsets[:-1][idx], (c.offsets[1:] - c.offsets[:-1])[idx]
+
+
+def _bytes_word_at(data: jax.Array, start: jax.Array, lens: jax.Array,
+                   off: jax.Array) -> jax.Array:
+    """uint64 of bytes [off, off+8) of each row (zero past the row length)."""
+    nbytes = data.shape[0]
+    acc = jnp.zeros(start.shape[0], jnp.uint64)
+    for b in range(8):
+        k = off + b
+        pos = jnp.clip(start + k, 0, max(nbytes - 1, 0))
+        byte = jnp.where(
+            (k < lens) & (nbytes > 0),
+            data[pos] if nbytes > 0 else jnp.zeros(start.shape[0], jnp.uint8),
+            jnp.uint8(0)).astype(jnp.uint64)
+        acc = (acc << jnp.uint64(8)) | byte
+    return acc
+
+
 def _string_eq_at(
     ca: DeviceColumn, a_idx: jax.Array, cb: DeviceColumn, b_idx: jax.Array
 ) -> jax.Array:
-    """Exact string equality at row pairs, via hash + 16-byte prefix.
+    """Exact full-width string equality at row pairs.
 
-    Combines the 64-bit polynomial hash with both 16-byte prefixes; a false
-    positive requires simultaneous 64-bit hash collision AND identical
-    prefix/length — treated as exact for engine purposes."""
+    Fast screen first — 64-bit polynomial hash, length, and both 16-byte
+    prefix words must agree — then a byte-payload verification walks the
+    remaining payload in 8-byte windows (``lax.while_loop``: the trip count
+    is the longest surviving candidate, so short keys pay nothing). Equality
+    therefore never depends on hash quality; a collision only costs the
+    discarded verification pass."""
     ha, la, pa0, pa1 = _string_sig_at(ca, a_idx)
     hb, lb, pb0, pb1 = _string_sig_at(cb, b_idx)
-    return (ha == hb) & (la == lb) & (pa0 == pb0) & (pa1 == pb1)
+    eq = (ha == hb) & (la == lb) & (pa0 == pb0) & (pa1 == pb1)
+    da, sa, lla = _string_rows_at(ca, a_idx)
+    db, sb, llb = _string_rows_at(cb, b_idx)
+
+    def cond(st):
+        off, e = st
+        return jnp.any(e & (lla > off))
+
+    def body(st):
+        off, e = st
+        wa = _bytes_word_at(da, sa, lla, off)
+        wb = _bytes_word_at(db, sb, llb, off)
+        return off + 8, e & (wa == wb)
+
+    # lengths already agreed (la == lb folded into eq); bytes past the row
+    # length read as 0 on both sides, so whole-word compares are safe
+    _, eq = jax.lax.while_loop(cond, body, (jnp.int32(16), eq))
+    return eq
 
 
 # ---------------------------------------------------------------------------
@@ -739,12 +842,16 @@ def group_rows(batch: ColumnarBatch, key_cols: Sequence[int],
     if active is None:
         active = batch.active_mask()
     if any(batch.columns[i].offsets is not None for i in key_cols):
-        # plain string keys: cluster on an independent 128-bit hash pair,
-        # then verify neighbors with a cheap exact check (length + 16-byte
-        # prefix, the _string_eq_at bar) so a double hash collision between
-        # distinct keys can only SPLIT a group, never merge one
+        # plain string keys: cluster on an independent 128-bit hash pair —
+        # through the open-addressing table when enabled (one int32 slot
+        # sort), else by lexsort + a cheap neighbor check (length + 16-byte
+        # prefix) that can only SPLIT a double-collided group. Either way
+        # the bar is the documented engine-wide 128-bit treat-as-exact
+        # string-equality contract.
         h1 = hash_keys(batch, key_cols)
         h2 = hash_keys(batch, key_cols, variant=1)
+        if _agg_hashtbl_enabled():
+            return group_rows_table(h1, h2, active)
         keys = [h2, h1, jnp.where(active, jnp.uint32(0), jnp.uint32(1))]
         perm = lexsort_chain(keys).astype(jnp.int32)
         neq = _neighbor_key_neq(batch, key_cols, perm, extra=(h1, h2))
@@ -799,19 +906,25 @@ def _neighbor_key_neq(batch: ColumnarBatch, key_cols: Sequence[int],
     return neq
 
 
+def _agg_hashtbl_enabled() -> bool:
+    from spark_rapids_tpu.config import conf as _C
+    return _C.AGG_HASHTBL_ENABLED.get(_C.get_active())
+
+
 def group_rows_prehashed(h1: jax.Array, h2: jax.Array,
                          active: jax.Array) -> GroupInfo:
     """Cluster rows whose 128-bit (h1, h2) hash pair matches. Used for
     string group keys and for merge passes that carry the pair as columns
-    (hash-once aggregation: bytes are hashed exactly once per query)."""
-    cap = h1.shape[0]
-    keys = [h2, h1, jnp.where(active, jnp.uint32(0), jnp.uint32(1))]
-    perm = lexsort_chain(keys).astype(jnp.int32)
-    g1, g2 = gather_lanes([h1, h2], perm)
-    p1 = jnp.concatenate([g1[:1], g1[:-1]])
-    p2 = jnp.concatenate([g2[:1], g2[:-1]])
-    neq = (g1 != p1) | (g2 != p2)
-    return _group_from_boundaries(perm, neq, active, cap)
+    (hash-once aggregation: bytes are hashed exactly once per query).
+
+    Round 12: routes through the open-addressing table
+    (``group_rows_table`` — one stable int32 slot sort instead of the
+    128-bit lexsort), with the sort-based clustering as both the conf-off
+    path and the in-trace overflow fallback. Same treat-as-exact bar: rows
+    group iff their 128-bit pair matches."""
+    if _agg_hashtbl_enabled():
+        return group_rows_table(h1, h2, active)
+    return _group_rows_prehashed_sort(h1, h2, active)
 
 
 def _group_from_boundaries(perm: jax.Array, neq: jax.Array,
@@ -1377,3 +1490,351 @@ def probe_join_table_unique(probe: ColumnarBatch, tbl: JoinTable,
     first = jnp.argmax(ok, axis=1)
     bi = jnp.where(hit, rows[jnp.arange(cap_p), first], -1)
     return bi.astype(jnp.int32), hit
+
+
+# ---------------------------------------------------------------------------
+# Open-addressing device hash table (round-12; shared by join and aggregate)
+# ---------------------------------------------------------------------------
+#
+# The general duplicate-key layer both the join and the aggregate were
+# missing (reference: cuDF's open-addressing hash tables under
+# GpuHashJoin/GpuAggregateExec; SURVEY §2.4). Design is TPU-first:
+#
+# - linear probing over a power-of-two slot array; each build round is a
+#   data-parallel claim pass (scatter-min of row ids into contested empty
+#   slots) instead of per-thread CAS loops — all rows advance in lockstep,
+#   so the build is a bounded ``lax.while_loop`` of pure gathers/scatters
+#   and jits on every backend (the pure-XLA fallback IS the kernel; a
+#   Pallas build of the same loop body is dispatched when the backend
+#   supports it, see docs/kernels.md);
+# - the table stores the 128-bit hash pair per slot; duplicate rows attach
+#   to their key's slot, and a count+offset layout (rows stably sorted by
+#   slot id) turns each slot into a candidate range — the row-chain analog
+#   of cuDF's multimap, but readable with two searchsorted gathers;
+# - overflow (a probe cluster outrunning the static probe bound) reports a
+#   device flag; the HOST retries with the next seed (seeded rehash), and
+#   the seed is a static jit argument so two seeds never share a program.
+#
+# Static jit keys carry (capacity, seed, max_probes): the table layout
+# parameters can never collide in the jit/persist caches
+# (tools/check_cache_keys.py guards this structurally).
+
+HASHTBL_MAX_PROBES = 16  # default static probe bound per seed
+HASHTBL_MAX_REHASH = 4   # host-side seeded rehash attempts before fallback
+
+_hashtbl_lock = threading.Lock()
+_hashtbl_counters = {
+    "hashtbl_build_total": 0,   # tables built (host-visible builds)
+    "hashtbl_probe_total": 0,   # probe passes over a table
+    "hashtbl_rehash_total": 0,  # seeded rebuilds after overflow
+    "hashtbl_chunk_total": 0,   # bounded output chunks emitted by joins
+}
+
+
+def _note_hashtbl(name: str, n: int = 1) -> None:
+    with _hashtbl_lock:
+        _hashtbl_counters[name] += n
+
+
+def counters() -> dict:
+    """Hash-table kernel counters for the obs gauge catalog."""
+    with _hashtbl_lock:
+        return dict(_hashtbl_counters)
+
+
+def hashtbl_capacity(n_rows: int) -> int:
+    """Static slot count for an n-row build: next power of two >= 2 * rows
+    (load factor <= 0.5 keeps linear-probe clusters short)."""
+    cap = 16
+    while cap < 2 * max(n_rows, 1):
+        cap *= 2
+    return cap
+
+
+def _hashtbl_base(h1: jax.Array, capacity: int, seed: int) -> jax.Array:
+    """Home slot per row: the seed re-mixes the hash so a rehash relocates
+    every cluster, not just the overflowing one."""
+    mix = jnp.uint64((seed * 0x9E3779B97F4A7C15 + 0xC2B2AE3D27D4EB4F)
+                     & 0xFFFFFFFFFFFFFFFF)
+    return (_splitmix64(h1 ^ mix)
+            & jnp.uint64(capacity - 1)).astype(jnp.int32)
+
+
+class HashTable(NamedTuple):
+    """Open-addressing table over the 128-bit hash pair, plus the
+    count+offset duplicate layout (``order``/``sorted_slots``).
+
+    ``slot_h1``/``slot_h2`` hold the occupying key's hash pair (undefined
+    while ``slot_used`` is False). ``row_slot`` maps each build row to its
+    slot (-1: invalid key / unplaced). ``order`` lists build rows stably
+    sorted by slot id — a slot's rows are the contiguous run
+    ``order[searchsorted(sorted_slots, s, left):searchsorted(..., right)]``.
+    """
+
+    slot_h1: jax.Array      # (capacity,) uint64
+    slot_h2: jax.Array      # (capacity,) uint64
+    slot_used: jax.Array    # (capacity,) bool
+    row_slot: jax.Array     # (n,) int32
+    order: jax.Array        # (n,) int32 rows sorted by slot id
+    sorted_slots: jax.Array  # (n,) int32 row_slot[order]; invalid -> capacity
+
+
+def _hashtbl_insert_rounds(h1, h2, valid, capacity: int, seed: int,
+                           max_probes: int):
+    """Shared build loop: returns (slot_h1, slot_h2, slot_used, row_slot).
+
+    Round p: every unplaced row looks at base+p. Empty slots are claimed by
+    scatter-min of row ids; after claims land, every unplaced row re-checks
+    the slot — matching (h1, h2) attaches (winners match their own write,
+    duplicate keys attach to their winner the same round, so equal keys can
+    never split across slots)."""
+    n = h1.shape[0]
+    row_ids = jnp.arange(n, dtype=jnp.int32)
+    base = _hashtbl_base(h1, capacity, seed)
+
+    def cond(st):
+        p, _, _, _, row_slot = st
+        return (p < max_probes) & jnp.any(valid & (row_slot < 0))
+
+    def body(st):
+        p, slot_h1, slot_h2, slot_used, row_slot = st
+        pos = ((base + p) & (capacity - 1)).astype(jnp.int32)
+        unplaced = valid & (row_slot < 0)
+        want = unplaced & ~slot_used[pos]
+        tgt = jnp.where(want, pos, capacity)
+        claim = jnp.full(capacity, n, jnp.int32).at[tgt].min(
+            row_ids, mode="drop")
+        won = want & (claim[pos] == row_ids)
+        wpos = jnp.where(won, pos, capacity)
+        slot_h1 = slot_h1.at[wpos].set(h1, mode="drop")
+        slot_h2 = slot_h2.at[wpos].set(h2, mode="drop")
+        slot_used = slot_used.at[wpos].set(True, mode="drop")
+        match = (unplaced & slot_used[pos]
+                 & (slot_h1[pos] == h1) & (slot_h2[pos] == h2))
+        row_slot = jnp.where(match, pos, row_slot)
+        return p + 1, slot_h1, slot_h2, slot_used, row_slot
+
+    st = (jnp.int32(0),
+          jnp.zeros(capacity, jnp.uint64), jnp.zeros(capacity, jnp.uint64),
+          jnp.zeros(capacity, jnp.bool_), jnp.full(n, -1, jnp.int32))
+    _, slot_h1, slot_h2, slot_used, row_slot = jax.lax.while_loop(
+        cond, body, st)
+    return slot_h1, slot_h2, slot_used, row_slot
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def build_hash_table(h1: jax.Array, h2: jax.Array, valid: jax.Array,
+                     capacity: int, seed: int, max_probes: int):
+    """Build the table + duplicate layout in one traced program.
+
+    Returns (HashTable, overflow). ``overflow`` is the ONLY host read: True
+    means some valid row ran out of probe window under this seed — the
+    caller rebuilds with seed+1 (``build_batch_hash_table``)."""
+    slot_h1, slot_h2, slot_used, row_slot = _hashtbl_insert_rounds(
+        h1, h2, valid, capacity, seed, max_probes)
+    overflow = jnp.any(valid & (row_slot < 0))
+    srt = jnp.where(valid & (row_slot >= 0), row_slot, capacity)
+    n = h1.shape[0]
+    _, order = jax.lax.sort(
+        (srt, jnp.arange(n, dtype=jnp.int32)), num_keys=1, is_stable=True)
+    return HashTable(slot_h1, slot_h2, slot_used, row_slot,
+                     order.astype(jnp.int32), srt[order]), overflow
+
+
+def build_batch_hash_table(batch: ColumnarBatch, key_cols: Tuple[int, ...]):
+    """HOST wrapper: hash the key columns, build with seeded rehash.
+
+    Returns (HashTable, capacity, seed) or None when every seed overflowed
+    (callers fall back to the sorted-hash join). One device->host scalar
+    read per attempt; almost always exactly one."""
+    h1 = hash_keys(batch, list(key_cols))
+    h2 = hash_keys(batch, list(key_cols), variant=1)
+    valid = batch.active_mask()
+    for i in key_cols:
+        valid = valid & batch.columns[i].validity
+    capacity = hashtbl_capacity(batch.capacity)
+    for seed in range(HASHTBL_MAX_REHASH):
+        tbl, overflow = build_hash_table(h1, h2, valid, capacity, seed,
+                                         HASHTBL_MAX_PROBES)
+        if not bool(jax.device_get(overflow)):
+            _note_hashtbl("hashtbl_build_total")
+            return tbl, capacity, seed
+        _note_hashtbl("hashtbl_rehash_total")
+        capacity *= 2  # grow + reseed: clusters can't reform in place
+    return None
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5))
+def probe_hash_table(tbl: HashTable, h1: jax.Array, h2: jax.Array,
+                     capacity: int, seed: int, max_probes: int):
+    """Find each probe key's slot: bounded linear scan of pure gathers.
+
+    Returns (slot, hit); a probe row stops at its match or at the first
+    empty slot (linear probing guarantees the key is absent past one).
+    No scatters, no host sync — safe inside any traced program."""
+    base = _hashtbl_base(h1, capacity, seed)
+    n = h1.shape[0]
+
+    def cond(st):
+        p, _, done = st
+        return (p < max_probes) & jnp.any(~done)
+
+    def body(st):
+        p, slot, done = st
+        pos = ((base + p) & (capacity - 1)).astype(jnp.int32)
+        occ = tbl.slot_used[pos]
+        match = occ & (tbl.slot_h1[pos] == h1) & (tbl.slot_h2[pos] == h2)
+        slot = jnp.where(~done & match, pos, slot)
+        done = done | match | ~occ
+        return p + 1, slot, done
+
+    _, slot, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.full(n, -1, jnp.int32),
+                     jnp.zeros(n, jnp.bool_)))
+    return slot, slot >= 0
+
+
+def _split_u64(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(lo32, hi32) uint32 words of a uint64 array (Pallas TPU kernels have
+    no 64-bit integer lanes; the probe compares word pairs instead)."""
+    return ((a & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+            (a >> jnp.uint64(32)).astype(jnp.uint32))
+
+
+def _pallas_probe_kernel(capacity: int, max_probes: int):
+    """Kernel body factory for the Pallas probe (whole-array blocks)."""
+
+    def kernel(used_ref, t1l_ref, t1h_ref, t2l_ref, t2h_ref, base_ref,
+               p1l_ref, p1h_ref, p2l_ref, p2h_ref, slot_ref):
+        used = used_ref[...]
+        t1l, t1h = t1l_ref[...], t1h_ref[...]
+        t2l, t2h = t2l_ref[...], t2h_ref[...]
+        base = base_ref[...]
+        p1l, p1h = p1l_ref[...], p1h_ref[...]
+        p2l, p2h = p2l_ref[...], p2h_ref[...]
+
+        def body(p, st):
+            slot, done = st
+            pos = ((base + p) & (capacity - 1)).astype(jnp.int32)
+            occ = used[pos]
+            match = (occ & (t1l[pos] == p1l) & (t1h[pos] == p1h)
+                     & (t2l[pos] == p2l) & (t2h[pos] == p2h))
+            slot = jnp.where(~done & match, pos, slot)
+            done = done | match | ~occ
+            return slot, done
+
+        slot0 = jnp.full(base.shape, -1, jnp.int32)
+        done0 = jnp.zeros(base.shape, jnp.bool_)
+        slot, _ = jax.lax.fori_loop(0, max_probes, body, (slot0, done0))
+        slot_ref[...] = slot
+
+    return kernel
+
+
+_pallas_broken = False  # sticky: first lowering failure disables the path
+
+
+def probe_hash_table_pallas(tbl: HashTable, h1: jax.Array, h2: jax.Array,
+                            capacity: int, seed: int, max_probes: int,
+                            interpret: bool = False):
+    """Pallas variant of ``probe_hash_table`` — identical contract.
+
+    The hash pair is pre-split into uint32 word lanes (no 64-bit lanes on
+    TPU Pallas); the bounded linear scan runs as one kernel over the whole
+    probe block. ``interpret=True`` runs the same kernel through the Pallas
+    interpreter (how the CPU test lane covers it)."""
+    from jax.experimental import pallas as pl
+
+    base = _hashtbl_base(h1, capacity, seed)
+    t1l, t1h = _split_u64(tbl.slot_h1)
+    t2l, t2h = _split_u64(tbl.slot_h2)
+    p1l, p1h = _split_u64(h1)
+    p2l, p2h = _split_u64(h2)
+    slot = pl.pallas_call(
+        _pallas_probe_kernel(capacity, max_probes),
+        out_shape=jax.ShapeDtypeStruct(h1.shape, jnp.int32),
+        interpret=interpret,
+    )(tbl.slot_used, t1l, t1h, t2l, t2h, base, p1l, p1h, p2l, p2h)
+    return slot, slot >= 0
+
+
+def probe_hash_table_dispatch(tbl: HashTable, h1: jax.Array, h2: jax.Array,
+                              capacity: int, seed: int, max_probes: int):
+    """Backend dispatch: Pallas kernel where the platform lowers it, the
+    pure-XLA ``probe_hash_table`` everywhere else (JAX_PLATFORMS=cpu lanes,
+    and as the sticky fallback after any Pallas lowering failure)."""
+    global _pallas_broken
+    from spark_rapids_tpu.config import conf as _C
+    mode = _C.HASHTBL_PALLAS_MODE.get(_C.get_active())
+    use = (mode == "on"
+           or (mode == "auto" and jax.default_backend() == "tpu"))
+    if use and not _pallas_broken:
+        try:
+            return probe_hash_table_pallas(tbl, h1, h2, capacity, seed,
+                                           max_probes)
+        except Exception:  # unsupported lowering: never fail the query
+            _pallas_broken = True
+    return probe_hash_table(tbl, h1, h2, capacity, seed, max_probes)
+
+
+def hashtbl_candidate_ranges(tbl: HashTable, slot: jax.Array,
+                             hit: jax.Array):
+    """(lo, cnt) candidate ranges in ``tbl.order`` for probed slots —
+    the count+offset read of the duplicate layout."""
+    lo = jnp.searchsorted(tbl.sorted_slots, slot, side="left").astype(
+        jnp.int32)
+    hi = jnp.searchsorted(tbl.sorted_slots, slot, side="right").astype(
+        jnp.int32)
+    cnt = jnp.where(hit, hi - lo, 0)
+    lo = jnp.minimum(lo, hi)
+    return lo, cnt
+
+
+# -- aggregate grouping on the same table -----------------------------------
+
+
+def _group_rows_prehashed_sort(h1: jax.Array, h2: jax.Array,
+                               active: jax.Array) -> GroupInfo:
+    """The pre-round-12 sort-based clustering (also the in-trace fallback
+    branch when the table build overflows its probe bound)."""
+    cap = h1.shape[0]
+    keys = [h2, h1, jnp.where(active, jnp.uint32(0), jnp.uint32(1))]
+    perm = lexsort_chain(keys).astype(jnp.int32)
+    g1, g2 = gather_lanes([h1, h2], perm)
+    p1 = jnp.concatenate([g1[:1], g1[:-1]])
+    p2 = jnp.concatenate([g2[:1], g2[:-1]])
+    neq = (g1 != p1) | (g2 != p2)
+    return _group_from_boundaries(perm, neq, active, cap)
+
+
+def group_rows_table(h1: jax.Array, h2: jax.Array,
+                     active: jax.Array) -> GroupInfo:
+    """Cluster rows by 128-bit hash pair via the open-addressing table.
+
+    In-trace (usable under shared_jit): builds the table with the default
+    seed, then sorts rows by their SLOT id — one stable int32 sort pass
+    instead of the four u32 passes of the 128-bit lexsort. Equal keys share
+    a slot (the build attaches duplicates in their claim round), so slot
+    order is group order. Overflow takes a ``lax.cond`` to the sort-based
+    clustering — identical GroupInfo shapes, so the traced program covers
+    both and only the taken branch runs."""
+    cap = h1.shape[0]
+    capacity = hashtbl_capacity(cap)
+    slot_h1, slot_h2, slot_used, row_slot = _hashtbl_insert_rounds(
+        h1, h2, active, capacity, 0, HASHTBL_MAX_PROBES)
+    overflow = jnp.any(active & (row_slot < 0))
+
+    def via_table(_):
+        srt = jnp.where(active & (row_slot >= 0), row_slot, capacity)
+        _, perm = jax.lax.sort(
+            (srt, jnp.arange(cap, dtype=jnp.int32)), num_keys=1,
+            is_stable=True)
+        perm = perm.astype(jnp.int32)
+        ss = srt[perm]
+        neq = ss != jnp.concatenate([ss[:1], ss[:-1]])
+        return _group_from_boundaries(perm, neq, active, cap)
+
+    def via_sort(_):
+        return _group_rows_prehashed_sort(h1, h2, active)
+
+    return jax.lax.cond(overflow, via_sort, via_table, operand=None)

@@ -32,6 +32,11 @@ def _sort_run(batch: ColumnarBatch, specs):
     return K.gather_batch(batch, idx, batch.num_rows)
 
 
+def _str_max_words() -> int:
+    from spark_rapids_tpu.config import conf as _C
+    return _C.STRING_SORT_MAX_WORDS.get(_C.get_active())
+
+
 @dataclasses.dataclass(frozen=True)
 class SortOrder:
     child: E.Expression
@@ -81,8 +86,15 @@ class SortExec(UnaryExec):
             )
         specs = tuple(self._specs)
         # module-level jit + hashable static specs: same-shaped sorts share
-        # one compiled kernel across operator instances
-        self._run = lambda batch: _sort_run(batch, specs)
+        # one compiled kernel across operator instances. String keys widen
+        # per batch to the observed max row length (full-width ORDER BY,
+        # round 12) — the widened widths are part of the static specs, so
+        # width buckets share compiles too.
+        if any(schema[s.column].dtype == T.STRING for s in specs):
+            self._run = lambda batch: _sort_run(
+                batch, K.str_key_words(batch, specs, _str_max_words()))
+        else:
+            self._run = lambda batch: _sort_run(batch, specs)
         self._prepared = True
 
     def node_description(self) -> str:
@@ -178,7 +190,8 @@ class OutOfCoreSortIterator:
     def __iter__(self) -> Iterator[ColumnarBatch]:
         runs: List[_SortRun] = []
         for b in self.source:
-            sb = _sort_run(b, self.specs)
+            sb = _sort_run(b, K.str_key_words(b, self.specs,
+                                              _str_max_words()))
             keys = _run_boundary_keys(sb, self.specs[0])
             runs.append(_SortRun(sb, keys, self.framework))
         runs = [r for r in runs if r.n > 0]
@@ -224,7 +237,8 @@ class OutOfCoreSortIterator:
             if not pieces:
                 continue  # cannot happen (boundary includes >= t rows)
             merged = pieces[0] if len(pieces) == 1 else concat_jit(pieces)
-            yield _sort_run(merged, self.specs)
+            yield _sort_run(merged, K.str_key_words(merged, self.specs,
+                                                    _str_max_words()))
 
 
 def _cap(n: int) -> int:

@@ -39,6 +39,14 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "Payload bytes written into spill chunks (post-codec)"),
     ("agg_repartition_total", "counter",
      "Oversized agg-state hash-repartition passes (docs/oversized_state.md)"),
+    ("hashtbl_build_total", "counter",
+     "Open-addressing device hash tables built (docs/kernels.md)"),
+    ("hashtbl_probe_total", "counter",
+     "Probe passes against a device hash table"),
+    ("hashtbl_rehash_total", "counter",
+     "Table builds that overflowed and retried with a new seed/capacity"),
+    ("hashtbl_chunk_total", "counter",
+     "Bounded gather chunks emitted by the chunked join gatherer"),
     ("semaphore_wait_ns_total", "counter",
      "Nanoseconds tasks waited to enter the device"),
     ("semaphore_acquire_total", "counter", "Semaphore acquire calls"),
@@ -229,6 +237,8 @@ def snapshot() -> Dict[str, int]:
     out.update(_mt.counters())
     from spark_rapids_tpu.exec import aggregate as _agg
     out.update(_agg.counters())
+    from spark_rapids_tpu.exec import kernels as _k
+    out.update(_k.counters())
     from spark_rapids_tpu.serve import metrics as _serve_m
     out.update(_serve_m.counters())
     return out

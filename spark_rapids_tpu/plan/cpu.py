@@ -1771,6 +1771,32 @@ class CpuFilterExec(CpuExec, UnaryExec):
             yield t.filter(pa.array(keep))
 
 
+def _sort_indices_compat(col, direction: str, placement: str):
+    """Single-column sort honoring null placement across pyarrow versions.
+
+    pyarrow >= 25 deprecates the global ``null_placement`` SortOptions kwarg
+    in favor of per-sort-key placement; the per-key (3-tuple) form is only
+    unambiguous for table input, so sort through a one-column table there.
+    Older pyarrow only understands 2-tuple keys + the kwarg.
+    """
+    import warnings
+
+    import pyarrow.compute as pc
+
+    try:
+        return pc.sort_indices(
+            pa.table({"k": col}),
+            sort_keys=[("k", direction, placement)])
+    except (TypeError, ValueError):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*null_placement.*",
+                category=FutureWarning)
+            return pc.sort_indices(
+                col, sort_keys=[("", direction)],
+                null_placement=placement)
+
+
 class CpuSortExec(CpuExec, UnaryExec):
     """Global sort on host: collects every child partition (the CPU path has
     no range exchange) and honors Spark null ordering (ASC -> NULLS FIRST)."""
@@ -1805,18 +1831,8 @@ class CpuSortExec(CpuExec, UnaryExec):
             cur = t if idx is None else t.take(idx)
             direction = "ascending" if o.ascending else "descending"
             placement = "at_start" if nulls_first else "at_end"
-            try:
-                # pyarrow >= 25: null_placement is specified per sort key
-                # (the global SortOptions kwarg is deprecated there)
-                order = pc.sort_indices(
-                    cur.column(b.index),
-                    sort_keys=[("", direction, placement)])
-            except (TypeError, ValueError):
-                # older pyarrow only understands 2-tuple keys + the kwarg
-                order = pc.sort_indices(
-                    cur.column(b.index),
-                    sort_keys=[("", direction)],
-                    null_placement=placement)
+            order = _sort_indices_compat(cur.column(b.index), direction,
+                                         placement)
             idx = order if idx is None else idx.take(order)
         yield t.take(idx)
 

@@ -1,0 +1,143 @@
+"""Kernel-level tests for the open-addressing device hash table and the
+full-width string keys (docs/kernels.md). Reference for the duplicate-key
+count+offset layout: cudf's hash join build (GpuHashJoin); for the chunked
+consumers see tests/test_join_paths.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.batch import batch_from_arrow
+from spark_rapids_tpu.exec import kernels as K
+
+
+def _batch(table, min_bucket=16):
+    return batch_from_arrow(table, min_bucket)
+
+
+def test_build_probe_duplicate_keys(rng):
+    """Every probe key's candidate range holds exactly the build rows with
+    that key — duplicates included — per the count+offset layout."""
+    keys = rng.integers(0, 40, 200)
+    bb = _batch(pa.table({"k": pa.array(keys, pa.int64())}))
+    ht = K.build_batch_hash_table(bb, (0,))
+    assert ht is not None
+    tbl, cap, seed = ht
+    pk = np.concatenate([np.arange(0, 50), np.arange(100, 110)])
+    pb = _batch(pa.table({"k": pa.array(pk, pa.int64())}))
+    h1 = K.hash_keys(pb, [0])
+    h2 = K.hash_keys(pb, [0], variant=1)
+    slot, hit = K.probe_hash_table(tbl, h1, h2, cap, seed,
+                                   K.HASHTBL_MAX_PROBES)
+    lo, cnt = K.hashtbl_candidate_ranges(tbl, slot, hit & pb.active_mask())
+    lo_h, cnt_h = jax.device_get((lo, cnt))
+    order = jax.device_get(tbl.order)
+    from collections import Counter
+    exp = Counter(keys.tolist())
+    for i, k in enumerate(pk.tolist()):
+        assert cnt_h[i] == exp.get(k, 0), (k, cnt_h[i])
+        cand = [int(order[j]) for j in range(lo_h[i], lo_h[i] + cnt_h[i])]
+        assert all(keys[r] == k for r in cand), (k, cand)
+
+
+def test_build_overflow_flag():
+    """More valid distinct keys than slots must overflow (pigeonhole) — the
+    flag is what drives the seeded-rehash retry loop."""
+    n = 64
+    h1 = jnp.asarray(np.arange(1, n + 1), jnp.uint64)
+    h2 = jnp.asarray(np.arange(101, 101 + n), jnp.uint64)
+    valid = jnp.ones(n, jnp.bool_)
+    _, overflow = K.build_hash_table(h1, h2, valid, 16, 0,
+                                     K.HASHTBL_MAX_PROBES)
+    assert bool(jax.device_get(overflow))
+
+
+def test_build_batch_rehash_exhaustion_returns_none(monkeypatch):
+    """When every seed/capacity retry overflows, the builder reports None so
+    the join falls back to the sorted-hash path instead of looping."""
+    import spark_rapids_tpu.exec.kernels as KM
+
+    real = KM.build_hash_table
+
+    def always_overflow(h1, h2, valid, capacity, seed, max_probes):
+        tbl, _ = real(h1, h2, valid, capacity, seed, max_probes)
+        return tbl, jnp.asarray(True)
+
+    monkeypatch.setattr(KM, "build_hash_table", always_overflow)
+    before = K.counters()["hashtbl_rehash_total"]
+    bb = _batch(pa.table({"k": pa.array(np.arange(32), pa.int64())}))
+    assert K.build_batch_hash_table(bb, (0,)) is None
+    assert K.counters()["hashtbl_rehash_total"] > before
+
+
+def test_probe_pallas_interpret_matches(rng):
+    keys = rng.integers(0, 25, 100)
+    bb = _batch(pa.table({"k": pa.array(keys, pa.int64())}))
+    tbl, cap, seed = K.build_batch_hash_table(bb, (0,))
+    pb = _batch(pa.table({"k": pa.array(np.arange(0, 40), pa.int64())}))
+    h1 = K.hash_keys(pb, [0])
+    h2 = K.hash_keys(pb, [0], variant=1)
+    s1, m1 = K.probe_hash_table(tbl, h1, h2, cap, seed,
+                                K.HASHTBL_MAX_PROBES)
+    s2, m2 = K.probe_hash_table_pallas(tbl, h1, h2, cap, seed,
+                                       K.HASHTBL_MAX_PROBES, interpret=True)
+    np.testing.assert_array_equal(jax.device_get(s1), jax.device_get(s2))
+    np.testing.assert_array_equal(jax.device_get(m1), jax.device_get(m2))
+
+
+def test_group_rows_table_matches_sort_path(rng):
+    """Table-based grouping and the sort-based fallback agree on the group
+    count and partition rows identically (same key -> same group id)."""
+    vals = rng.integers(0, 17, 130)
+    bb = _batch(pa.table({"k": pa.array(vals, pa.int64())}))
+    h1 = K.hash_keys(bb, [0])
+    h2 = K.hash_keys(bb, [0], variant=1)
+    act = bb.active_mask()
+    g1 = K.group_rows_table(h1, h2, act)
+    g2 = K._group_rows_prehashed_sort(h1, h2, act)
+    n1 = int(jax.device_get(g1.num_groups))
+    assert n1 == int(jax.device_get(g2.num_groups))
+    assert n1 == len(set(vals.tolist()))
+    # same-key rows must share a group id, distinct keys must not
+    perm = jax.device_get(g1.perm)
+    seg = jax.device_get(g1.segment_ids)
+    by_key = {}
+    for j in range(len(vals)):
+        by_key.setdefault(int(vals[int(perm[j])]), set()).add(int(seg[j]))
+    assert all(len(ids) == 1 for ids in by_key.values())
+    assert len({next(iter(ids)) for ids in by_key.values()}) == n1
+
+
+def test_string_full_keys_total_order():
+    strs = ["", "a", "aa" * 20, "ab", "b" * 9, "b" * 8, "zzz"]
+    st = _batch(pa.table({"s": pa.array(strs)}))
+    fk = K.string_full_keys(st.columns[0], 8)
+    fk_h = [jax.device_get(k) for k in fk]
+    tuples = [tuple(int(k[i]) for k in fk_h) for i in range(len(strs))]
+    order = sorted(range(len(strs)), key=lambda i: tuples[i])
+    assert [strs[i] for i in order] == sorted(strs)
+
+
+def test_full_width_string_equality():
+    """Equality must compare the whole payload, not the 16-byte prefix."""
+    s = pa.table({"s": pa.array(["x" * 30 + "a", "x" * 30 + "b",
+                                 "x" * 30 + "a", "short"])})
+    sb = _batch(s)
+    ai = jnp.array([0, 0, 0], jnp.int32)
+    bi = jnp.array([1, 2, 3], jnp.int32)
+    eq = jax.device_get(K.keys_equal(sb, ai, [0], sb, bi, [0]))
+    assert eq.tolist() == [False, True, False]
+
+
+def test_hashtbl_counters_surface_in_gauges(rng):
+    from spark_rapids_tpu.obs import gauges as G
+    before = G.snapshot()
+    bb = _batch(pa.table({"k": pa.array(rng.integers(0, 9, 50), pa.int64())}))
+    assert K.build_batch_hash_table(bb, (0,)) is not None
+    after = G.snapshot()
+    for name in ("hashtbl_build_total", "hashtbl_probe_total",
+                 "hashtbl_rehash_total", "hashtbl_chunk_total"):
+        assert name in after
+    assert after["hashtbl_build_total"] > before.get("hashtbl_build_total", 0)

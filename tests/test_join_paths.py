@@ -1,0 +1,186 @@
+"""Join path-selection boundary tests (docs/kernels.md).
+
+The equi-join picks a build layout in order: dense direct-address table
+(small int key domain) -> bucketed unique-key table -> general open-
+addressing hash table -> sorted-hash fallback. Each test drives a boundary
+knob so a specific path must take the batch, then checks the rows are
+bit-identical to an independent oracle: the engine's own sorted-hash path
+(hash table disabled) and, for inner joins, a pandas merge with SQL null
+semantics (null keys never match, unlike pandas' default NaN==NaN)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import batch_from_arrow, batch_to_arrow
+from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.config.conf import RapidsConf
+from spark_rapids_tpu.exec import BatchSourceExec, HashJoinExec
+from spark_rapids_tpu.exec import kernels as K
+from spark_rapids_tpu.exprs.expr import col
+
+HT_OFF = {"spark.rapids.tpu.sql.join.hashTable.enabled": False}
+
+
+def source(table: pa.Table, batch_rows=None, min_bucket=16):
+    schema = T.Schema.from_arrow(table.schema)
+    if batch_rows is None:
+        batches = [batch_from_arrow(table, min_bucket)]
+    else:
+        batches = [
+            batch_from_arrow(table.slice(i, batch_rows), min_bucket)
+            for i in range(0, max(table.num_rows, 1), batch_rows)
+        ]
+    return BatchSourceExec([batches], schema)
+
+
+def rows(node):
+    out = []
+    for b in node.execute_all():
+        out.extend(batch_to_arrow(b, node.output_schema).to_pylist())
+    return out
+
+
+def _canon(v):
+    if v is None or (isinstance(v, float) and pd.isna(v)):
+        return "\0NULL"
+    if isinstance(v, (int, float, np.integer, np.floating)):
+        return float(v)
+    return v
+
+
+def _norm(rs):
+    return sorted(
+        (tuple(_canon(v) for v in (r.values() if isinstance(r, dict) else r))
+         for r in rs),
+        key=repr,
+    )
+
+
+def _join(join_type, lt, rt, overrides=None, batch_rows=64):
+    C.set_active(RapidsConf(overrides or {}))
+    try:
+        j = HashJoinExec([col("lk")], [col("rk")], join_type,
+                         source(lt, batch_rows), source(rt))
+        return rows(j)
+    finally:
+        C.set_active(None)
+
+
+def _pandas_inner(lt, rt):
+    ldf, rdf = lt.to_pandas(), rt.to_pandas()
+    m = ldf.dropna(subset=["lk"]).merge(rdf.dropna(subset=["rk"]),
+                                        left_on="lk", right_on="rk")
+    return list(m.itertuples(index=False, name=None))
+
+
+@pytest.fixture
+def tabs(rng):
+    n, m = 300, 90
+    lt = pa.table({
+        "lk": pa.array([int(x) if x % 7 else None
+                        for x in rng.integers(0, 30, n)], pa.int64()),
+        "lv": pa.array(rng.normal(size=n), pa.float64()),
+    })
+    rt = pa.table({
+        "rk": pa.array([int(x) if x % 5 else None
+                        for x in rng.integers(0, 30, m)], pa.int64()),
+        "rv": pa.array(rng.normal(size=m), pa.float64()),
+    })
+    return lt, rt
+
+
+JOIN_TYPES = ["inner", "left", "right", "full", "left_semi", "left_anti"]
+
+
+@pytest.mark.parametrize("join_type", JOIN_TYPES)
+def test_duplicate_build_keys_take_hash_table(tabs, join_type):
+    """Duplicate build keys disqualify dense and unique layouts; with the
+    table enabled the batch must go through the general hash-table path
+    (probe counter moves) and match the sorted-hash oracle exactly."""
+    lt, rt = tabs
+    before = K.counters()["hashtbl_probe_total"]
+    got = _join(join_type, lt, rt)
+    assert K.counters()["hashtbl_probe_total"] > before
+    assert _norm(got) == _norm(_join(join_type, lt, rt, HT_OFF))
+
+
+def test_inner_matches_pandas_null_semantics(tabs):
+    lt, rt = tabs
+    assert _norm(_join("inner", lt, rt)) == _norm(_pandas_inner(lt, rt))
+
+
+def test_dense_domain_overflow_falls_through(rng):
+    """Unique build keys but a domain wider than denseKey.maxDomain: the
+    dense table must refuse and the next layouts take over, same rows."""
+    keys = (rng.permutation(50) * (1 << 30)).astype(np.int64)
+    rt = pa.table({"rk": pa.array(keys, pa.int64()),
+                   "rv": pa.array(np.arange(50.0), pa.float64())})
+    lt = pa.table({"lk": pa.array(np.concatenate([keys[:20], [1, 2, 3]]),
+                                  pa.int64()),
+                   "lv": pa.array(np.arange(23.0), pa.float64())})
+    small_domain = {"spark.rapids.tpu.sql.join.denseKey.maxDomain": 64}
+    for jt in ("inner", "left", "full"):
+        got = _join(jt, lt, rt, small_domain)
+        assert _norm(got) == _norm(_join(jt, lt, rt, HT_OFF))
+    assert _norm(_join("inner", lt, rt, small_domain)) == _norm(
+        _pandas_inner(lt, rt))
+
+
+def test_unique_slots_overflow_takes_hash_table(rng):
+    """Unique keys, dense disabled, bucket-scan width forced to 1: the
+    bucketed unique table overflows its slot cap and the general hash
+    table must take the batch (build counter moves)."""
+    keys = rng.permutation(4000)[:500].astype(np.int64)
+    rt = pa.table({"rk": pa.array(keys, pa.int64()),
+                   "rv": pa.array(np.arange(500.0), pa.float64())})
+    lt = pa.table({"lk": pa.array(keys[:100], pa.int64()),
+                   "lv": pa.array(np.arange(100.0), pa.float64())})
+    force_ht = {"spark.rapids.tpu.sql.join.denseKey.maxDomain": 2,
+                "spark.rapids.tpu.sql.join.uniqueTable.maxSlots": 1}
+    before = K.counters()["hashtbl_build_total"]
+    got = _join("inner", lt, rt, force_ht)
+    assert K.counters()["hashtbl_build_total"] > before
+    assert _norm(got) == _norm(_join("inner", lt, rt, HT_OFF))
+    assert len(got) == 100
+
+
+@pytest.mark.parametrize("join_type", JOIN_TYPES)
+def test_all_null_build_keys(join_type, rng):
+    """All-null build keys: no probe row can match; outer sides surface
+    null-padded rows, semi joins go empty, anti joins pass everything."""
+    lt = pa.table({"lk": pa.array([1, 2, None, 3], pa.int64()),
+                   "lv": pa.array([0.0, 1.0, 2.0, 3.0], pa.float64())})
+    rt = pa.table({"rk": pa.array([None] * 5, pa.int64()),
+                   "rv": pa.array(np.arange(5.0), pa.float64())})
+    got = _join(join_type, lt, rt)
+    assert _norm(got) == _norm(_join(join_type, lt, rt, HT_OFF))
+    expected_rows = {"inner": 0, "left": 4, "right": 5, "full": 9,
+                     "left_semi": 0, "left_anti": 4}[join_type]
+    assert len(got) == expected_rows
+
+
+def test_chunked_gather_fires_and_matches(rng):
+    """A probe whose candidate total exceeds gatherChunkTargetRows must be
+    emitted as multiple bounded chunks (chunk counter moves) with rows
+    bit-identical to the unchunked sorted-hash oracle."""
+    n, m = 400, 120
+    lt = pa.table({
+        "lk": pa.array([int(x) if x % 7 else None
+                        for x in rng.integers(0, 12, n)], pa.int64()),
+        "lv": pa.array(rng.normal(size=n), pa.float64()),
+    })
+    rt = pa.table({
+        "rk": pa.array([int(x) if x % 5 else None
+                        for x in rng.integers(0, 12, m)], pa.int64()),
+        "rv": pa.array(rng.normal(size=m), pa.float64()),
+    })
+    chunky = {"spark.rapids.tpu.sql.join.gatherChunkTargetRows": 1024}
+    before = K.counters()["hashtbl_chunk_total"]
+    got = _join("full", lt, rt, chunky, batch_rows=None)
+    chunks = K.counters()["hashtbl_chunk_total"] - before
+    assert chunks >= 2, f"chunking never fired ({chunks})"
+    assert _norm(got) == _norm(_join("full", lt, rt, HT_OFF,
+                                     batch_rows=None))

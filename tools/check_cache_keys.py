@@ -166,6 +166,70 @@ def _check_persist_key(violations: list) -> None:
             "on-disk entry key")
 
 
+def _check_kernel_static_keys(violations: list) -> None:
+    """exec/kernels.py hash-table jit key contract: table-layout parameters
+    (capacity, seed, max_probes) must be STATIC jit args — they shape the
+    compiled program (probe-loop bounds, buffer extents, rehash mixing), so
+    a traced-value key would silently reuse a kernel compiled for a
+    different table layout. Also: SortSpec carries the per-key string width
+    (str_words), so widened sort keys fork compiles per width bucket."""
+    path = os.path.join(PKG, "exec", "kernels.py")
+    rel = os.path.relpath(path, REPO)
+    with open(path, "r") as f:
+        tree = ast.parse(f.read(), filename=path)
+    layout_params = ("capacity", "seed", "max_probes")
+    found = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in (
+                "build_hash_table", "probe_hash_table"):
+            found.add(node.name)
+            args = [a.arg for a in node.args.args]
+            static_pos = set()
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                for kw in dec.keywords:
+                    if kw.arg not in ("static_argnums", "static_argnames"):
+                        continue
+                    try:
+                        v = ast.literal_eval(kw.value)
+                    except ValueError:
+                        continue
+                    for s in (v if isinstance(v, (tuple, list)) else (v,)):
+                        static_pos.add(args.index(s)
+                                       if isinstance(s, str) and s in args
+                                       else s)
+            bad = [p for p in layout_params
+                   if p not in args or args.index(p) not in static_pos]
+            if bad:
+                violations.append(
+                    f"{rel}:{node.lineno}: {node.name}() must take the "
+                    f"table-layout parameters {list(layout_params)} as "
+                    f"static jit args (non-static or missing: {bad}) — a "
+                    "layout change must fork the compiled kernel, not "
+                    "reuse one traced for another capacity/seed")
+    for name in ("build_hash_table", "probe_hash_table"):
+        if name not in found:
+            violations.append(
+                f"{rel}: {name}() not found (hash-table kernels moved? "
+                "update tools/check_cache_keys.py)")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "SortSpec":
+            fields = {s.target.id for s in node.body
+                      if isinstance(s, ast.AnnAssign)
+                      and isinstance(s.target, ast.Name)}
+            if "str_words" not in fields:
+                violations.append(
+                    f"{rel}:{node.lineno}: SortSpec lost its str_words "
+                    "field — widened string sort keys would share one "
+                    "compiled kernel across key widths")
+            break
+    else:
+        violations.append(
+            f"{rel}: SortSpec not found (sort key specs moved? update "
+            "tools/check_cache_keys.py)")
+
+
 def main() -> int:
     violations: list = []
     for dirpath, dirnames, filenames in os.walk(PKG):
@@ -175,6 +239,7 @@ def main() -> int:
                 _check_file(os.path.join(dirpath, fn), violations)
     _check_key_private_attrs(violations)
     _check_persist_key(violations)
+    _check_kernel_static_keys(violations)
     if violations:
         print("cache-key guard FAILED:", file=sys.stderr)
         for v in violations:

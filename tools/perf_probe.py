@@ -403,6 +403,58 @@ def overlap(sf=None, n_files=None, reps=2):
     }))
 
 
+def roofline(sizes=(1 << 24, 1 << 26, 1 << 28), reps=3):
+    """``python tools/perf_probe.py roofline`` — the delivered-bandwidth
+    ceiling bench.py's per-query ``roofline_util`` divides by, swept over
+    buffer sizes so the tunnel's fixed dispatch cost is visible (small
+    buffers under-report the ceiling; the largest size is the anchor).
+
+    Two kernels per size: a pipelined f32 reduce (read-only traffic, the
+    same shape bench.py measures) and an elementwise copy-scale (read +
+    write, counts both directions). Prints one JSON object; the driver
+    ceiling is ``roofline_GBps`` = the reduce bandwidth at the largest
+    size, matching bench.py."""
+    sizes = tuple(int(s) for s in os.environ.get(
+        "ROOFLINE_SIZES", ",".join(map(str, sizes))).split(","))
+
+    @jax.jit
+    def red(v, s):
+        return jnp.sum(v * (1.0 + s))
+
+    @jax.jit
+    def ewise(v, s):
+        return v * (1.0001 + s) + 3.0
+
+    points = []
+    for n in sizes:
+        x = jnp.ones(n, jnp.float32)
+        x.block_until_ready()
+        per = {"elems": n, "buffer_MB": round(4 * n / 1e6, 1)}
+        for name, fn, bytes_per_elem in (("reduce", red, 4),
+                                         ("copy_scale", ewise, 8)):
+            fn(x, 0.0).block_until_ready()
+            best = 0.0
+            for r in range(reps):
+                t0 = time.perf_counter()
+                outs = [fn(x, 1e-9 * (r * 4 + i)) for i in range(4)]
+                for o in outs:
+                    o.block_until_ready()
+                dt = (time.perf_counter() - t0) / 4
+                best = max(best, bytes_per_elem * n / dt)
+            per[f"{name}_GBps"] = round(best / 1e9, 3)
+        points.append(per)
+        print(f"n={n:>10d} reduce={per['reduce_GBps']:8.3f} GB/s "
+              f"copy_scale={per['copy_scale_GBps']:8.3f} GB/s",
+              file=sys.stderr, flush=True)
+    print(json.dumps({
+        "mode": "roofline",
+        "devices": [str(d) for d in jax.devices()],
+        "points": points,
+        "roofline_GBps": points[-1]["reduce_GBps"],
+    }))
+    return points
+
+
 def reuse_report(queries=("q1", "q2", "q59"), sf=0.002):
     """``python tools/perf_probe.py reuse`` — per-query duplicate-subtree
     counts and reuse hits (docs/exchange_reuse.md).
@@ -465,5 +517,7 @@ if __name__ == "__main__":
         overlap()
     elif "reuse" in sys.argv[1:]:
         reuse_report()
+    elif "roofline" in sys.argv[1:]:
+        roofline()
     else:
         main()

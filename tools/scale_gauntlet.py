@@ -42,18 +42,17 @@ DEFAULT_QUERIES = "q65"
 
 
 def _lane_q65(d):
-    """q65 (items selling at <=10% of their store's average revenue) with a
-    TOTAL final ordering.
+    """q65 (items selling at <=10% of their store's average revenue), stock
+    final ordering: sort by (s_store_name, i_item_desc), take 100 rows.
 
-    The stock q65 sorts by (s_store_name, i_item_desc) and takes 100 rows.
-    Device string sort keys are 16-byte prefixes (kernels.string_prefix_keys,
-    a documented ORDER BY limitation) and every generated desc shares the
-    16-byte prefix "desc of item 1.."; rows at the limit boundary therefore
-    tie on the device and get picked by INPUT ORDER — which a repartitioned
-    aggregate legitimately changes. That would test the tie-break, not the
-    memory machinery, so the lane appends the unique (ss_store_sk,
-    ss_item_sk) pair as trailing sort keys: same plan shape, same pressure,
-    well-defined top-100."""
+    Earlier rounds appended the unique (ss_store_sk, ss_item_sk) pair as
+    trailing sort keys: device string sort keys were 16-byte prefixes, every
+    generated desc shares the prefix "desc of item 1..", and prefix-tied
+    rows at the limit boundary were picked by input order — which a
+    repartitioned aggregate legitimately changes. String sort keys now widen
+    to the full observed row length (kernels.str_key_words, round 12), so
+    the device orders i_item_desc byte-for-byte and the stock ORDER BY is
+    deterministic without the workaround."""
     from spark_rapids_tpu.exprs.expr import (
         Average, LessThanOrEqual, Multiply, Sum, col, lit)
 
@@ -76,10 +75,8 @@ def _lane_q65(d):
          .join(d["store"], left_on="ss_store_sk", right_on="s_store_sk")
          .join(d["item"], left_on="ss_item_sk", right_on="i_item_sk"))
     return (j.select("s_store_name", "i_item_desc", "revenue",
-                     "i_current_price", "i_wholesale_cost", "i_brand",
-                     "ss_store_sk", "ss_item_sk")
-            .sort("s_store_name", "i_item_desc", "ss_store_sk",
-                  "ss_item_sk", limit=100))
+                     "i_current_price", "i_wholesale_cost", "i_brand")
+            .sort("s_store_name", "i_item_desc", limit=100))
 
 
 # q67-class lane queries: wide high-cardinality EXACT aggregations over
