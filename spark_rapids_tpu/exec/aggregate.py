@@ -1426,3 +1426,11 @@ def concat_jit(batches: Sequence[ColumnarBatch],
         else:
             byte_caps.append(0)
     return _concat_fn(list(batches), out_cap, tuple(byte_caps))
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL_SCALAR, ts  # noqa: E402
+
+HashAggregateExec.type_support = ts(
+    ALL_SCALAR, note="grouping keys hashed full-width (incl. strings); "
+    "aggregate input/output typing enforced per-function by check_expr")

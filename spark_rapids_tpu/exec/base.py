@@ -125,6 +125,12 @@ class TpuExec:
     # splits) attribute to it. None keeps the ambient site.
     mem_site: Optional[str] = None
 
+    #: declared (operator, type) support matrix (spark_rapids_tpu.support).
+    #: Every exec class the plan rewrite (plan/overrides.py) may place on
+    #: device must declare one; the type-support static pass enforces this
+    #: and plan/docs renders docs/supported_ops.md from it.
+    type_support = None
+
     def __init__(self, *children: "TpuExec"):
         self.children: List[TpuExec] = list(children)
         self.metrics: Dict[str, Metric] = {}
@@ -344,3 +350,12 @@ class BatchSourceExec(LeafExec):
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         yield from self._parts[partition]
+
+
+# type_support declaration (see spark_rapids_tpu.support; grouped decl
+# blocks like this one end each exec module — the static pass resolves
+# module-level assignments as well as in-class attributes).
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+BatchSourceExec.type_support = ts(
+    ALL, note="in-memory batch source; carries whatever the batch holds")

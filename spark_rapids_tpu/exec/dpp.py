@@ -141,3 +141,9 @@ class DynamicPruningFilter:
             return f"dpp[{self.column}] (pending)"
         n = "disabled" if self._overflow else len(self._values)
         return f"dpp[{self.column}] ({n} keys)"
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+ReplayExec.type_support = ts(ALL, note="replays recorded batches")

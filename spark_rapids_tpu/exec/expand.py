@@ -79,3 +79,10 @@ class ExpandExec(UnaryExec):
         for batch in self.child.execute(partition):
             pieces = [run(batch) for run in self._runs]
             yield pieces[0] if len(pieces) == 1 else concat_jit(pieces)
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL_SCALAR, ts  # noqa: E402
+
+ExpandExec.type_support = ts(
+    ALL_SCALAR, note="projection lists typed by check_expr")

@@ -470,3 +470,11 @@ def fuse_exec(root: TpuExec, min_ops: int = 2,
         return node
 
     return rewrite(root)
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+TpuFusedStageExec.type_support = ts(
+    ALL, note="fuses already-placed stages; member typing was enforced "
+    "when each member was placed")

@@ -167,3 +167,11 @@ def _gen_outer(b: ColumnarBatch, gi: int, keep, position: bool,
         elem_t, jnp.zeros(cap, T.numpy_dtype(elem_t)),
         jnp.zeros(cap, jnp.bool_)))
     return ColumnarBatch(cols, n.astype(jnp.int32))
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+GenerateExec.type_support = ts(
+    ALL, note="explode/posexplode over array and map columns; other "
+    "columns replicate")

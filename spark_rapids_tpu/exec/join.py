@@ -921,3 +921,11 @@ def _verified_pairs(probe, build, order, lo, cnt, r0, r1, lkeys, rkeys,
         jnp.where(ver, probe_c, probe.capacity)
     ].set(True, mode="drop")
     return pi, bi, nver, pmatch_scatter[: probe.capacity]
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL_SCALAR, ts  # noqa: E402
+
+HashJoinExec.type_support = ts(
+    ALL_SCALAR, note="equi-join keys hashed full-width (incl. strings); "
+    "payload columns may be any representable type")

@@ -473,3 +473,15 @@ def _bucket_gather(batch: ColumnarBatch, hmod: jax.Array, p: int, cap: int,
     cols = K.gather_columns(batch.columns, idx, row_valid,
                             [bcaps.get(i) for i in range(len(batch.columns))])
     return ColumnarBatch(cols, n.astype(jnp.int32))
+
+
+# type_support declarations (spark_rapids_tpu.support);
+# BroadcastHashJoinExec inherits from HashJoinExec.
+from spark_rapids_tpu.support import ALL_SCALAR, ts  # noqa: E402
+
+BroadcastNestedLoopJoinExec.type_support = ts(
+    ALL_SCALAR, note="join condition typed by check_expr over the pair "
+    "tile; CartesianProductExec inherits")
+SubPartitionHashJoinExec.type_support = ts(
+    ALL_SCALAR, note="same key typing as HashJoinExec; sub-partitions by "
+    "rehashing keys")

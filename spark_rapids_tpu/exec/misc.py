@@ -280,3 +280,15 @@ def _drop_head_jit(b: ColumnarBatch, k: jax.Array) -> ColumnarBatch:
 
 def _drop_head(b: ColumnarBatch, k: int) -> ColumnarBatch:
     return _drop_head_jit(b, jnp.int32(k))
+
+
+# type_support declarations (spark_rapids_tpu.support): pass-through
+# operators accept anything; RangeExec produces longs.
+from spark_rapids_tpu.support import ALL, INTEGRAL, ts  # noqa: E402
+
+CoalesceBatchesExec.type_support = ts(ALL, note="pass-through")
+LocalLimitExec.type_support = ts(ALL, note="pass-through")
+GlobalLimitExec.type_support = ts(ALL, note="pass-through")
+SampleExec.type_support = ts(ALL, note="pass-through with Bernoulli mask")
+UnionExec.type_support = ts(ALL, note="pass-through")
+RangeExec.type_support = ts(INTEGRAL, note="produces a LongType column")

@@ -311,3 +311,9 @@ def insert_prefetch(ex: TpuExec, conf) -> TpuExec:
         return node
 
     return walk(ex, None)
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+PrefetchExec.type_support = ts(ALL, note="pass-through (overlaps pulls)")

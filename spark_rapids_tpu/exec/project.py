@@ -121,3 +121,15 @@ class FilterExec(UnaryExec):
         self._bind()
         for batch in self.child.execute(partition):
             yield self._run(batch)
+
+
+# type_support declarations (spark_rapids_tpu.support): the per-expression
+# gate in plan/overrides.check_expr does the real typing; the operator
+# itself passes any representable column through.
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+ProjectExec.type_support = ts(
+    ALL, note="per-expression typing enforced by check_expr")
+FilterExec.type_support = ts(
+    ALL, note="predicate typed by check_expr; non-predicate columns pass "
+    "through")

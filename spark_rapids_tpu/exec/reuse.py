@@ -399,3 +399,12 @@ class ReusedBroadcastExec(LeafExec):
             except Exception:
                 pass
         yield from self.target.execute(partition)
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+ReusedExchangeExec.type_support = ts(ALL, note="pass-through of a cached "
+                                     "exchange")
+ReusedBroadcastExec.type_support = ts(ALL, note="pass-through of a cached "
+                                      "broadcast")

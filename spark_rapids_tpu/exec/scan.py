@@ -443,3 +443,11 @@ class ParquetScanExec(FileScanBase):
         f = pq.ParquetFile(task.path)
         return f.read_row_groups(task.row_groups, columns=self.columns,
                                  use_threads=False)
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+ParquetScanExec.type_support = ts(
+    ALL, note="columns outside the device repr are read on host and "
+    "carried as host columns")

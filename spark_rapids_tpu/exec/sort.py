@@ -268,3 +268,13 @@ def _slice_rows(batch: ColumnarBatch, start, count, cap: int, byte_caps):
     cols = K.gather_columns(batch.columns, idx, row_valid,
                             [bc or None for bc in byte_caps])
     return ColumnarBatch(cols, count.astype(jnp.int32))
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ORDERABLE, ts  # noqa: E402
+
+SortExec.type_support = ts(
+    ORDERABLE, "string",
+    note="string keys widened to str_words words (conf "
+    "spark.rapids.tpu.sql.sort.stringKeyMaxWords); payload columns may be "
+    "any representable type")

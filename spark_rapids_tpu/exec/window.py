@@ -1120,3 +1120,11 @@ def _finish_agg(f, out_t, s, c, active) -> DeviceColumn:
         return _win_out(out_t, data, nz, active)
     # Sum
     return _win_out(out_t, s, c > 0, active)
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL_SCALAR, ts  # noqa: E402
+
+WindowExec.type_support = ts(
+    ALL_SCALAR, note="partition/order keys follow SortExec typing; window "
+    "functions typed by check_expr")

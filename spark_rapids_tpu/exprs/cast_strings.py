@@ -99,7 +99,10 @@ def _window(sv: StringVal, cap: int) -> tuple:
     return mat, tlen, too_long
 
 
-_DIG0 = jnp.uint8(ord("0"))
+# np, not jnp: a module-level jnp constant materializes at import time and,
+# when the first import happens inside a traced fused body, is captured as a
+# tracer shared across compiles (the PR-5 eval.py bug class; jit-purity pass)
+_DIG0 = np.uint8(ord("0"))
 
 
 def _digits_i64(x: jnp.ndarray) -> tuple:

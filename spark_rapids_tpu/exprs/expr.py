@@ -20,10 +20,21 @@ import dataclasses
 from typing import Any, List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.support import (
+    ALL, ALL_SCALAR, DATETIME, DECIMAL, FRACTIONAL, INTEGRAL, NUMERIC,
+    ORDERABLE, STRINGY, ts,
+)
 
 
 class Expression:
     children: Tuple["Expression", ...] = ()
+
+    #: declared (operator, type) support matrix (spark_rapids_tpu.support).
+    #: None = no device declaration: the plan rewrite will never place the
+    #: expression on device. Declarations for this module live in the
+    #: block at the end of the file (grouped like _DEVICE_EXPRS); the
+    #: type-support static pass (tools/static_check.py) verifies coverage.
+    type_support = None
 
     @property
     def dtype(self) -> T.DataType:
@@ -2079,3 +2090,150 @@ def _rebuild(expr: Expression, new_children: List[Expression]) -> Expression:
     if not new_children:
         return expr
     return cls(*new_children)
+
+
+# ---------------------------------------------------------------------------
+# type_support declarations (TypeChecks.scala analog; spark_rapids_tpu.support)
+# ---------------------------------------------------------------------------
+# Every class the plan rewrite may place on device (plan/overrides.py
+# _DEVICE_EXPRS) declares which type CLASSES it accepts as resolved child
+# dtypes and may produce as its result dtype. check_expr enforces these at
+# plan time; plan/docs.py renders docs/supported_ops.md from them; the
+# type-support pass in tools/static_check.py verifies coverage and that the
+# wide-decimal/nested allowlists agree. Grouped assignments (rather than
+# per-class bodies) keep the matrix reviewable in one place; subclasses
+# inherit, and the static pass resolves that inheritance without imports.
+
+# structural / generic: every representable type passes through
+ColumnRef.type_support = ts(ALL)
+UnresolvedColumn.type_support = ts(ALL)
+Literal.type_support = ts(ALL)
+Alias.type_support = ts(ALL)
+Cast.type_support = ts(ALL_SCALAR, note="see check_expr: float->string, "
+                       "string->decimal and ANSI string casts stay on CPU")
+Coalesce.type_support = ts(ALL)
+If.type_support = ts(ALL_SCALAR)
+CaseWhen.type_support = ts(ALL_SCALAR)
+In.type_support = ts(ALL_SCALAR, out="boolean")
+
+# arithmetic (decimal128 via the two-limb kernels; divide-family decimal
+# support is refined further in check_expr)
+BinaryArithmetic.type_support = ts(NUMERIC, DECIMAL)
+UnaryMinus.type_support = ts(NUMERIC, DECIMAL)
+Abs.type_support = ts(NUMERIC, DECIMAL)
+Positive.type_support = ts(NUMERIC, DECIMAL)
+
+# predicates: equality covers strings; ORDERING comparisons have no device
+# string collation (check_expr tags them), so they exclude string/binary
+BinaryComparison.type_support = ts(ALL_SCALAR, out="boolean")
+LessThan.type_support = ts(ORDERABLE, out="boolean")
+LessThanOrEqual.type_support = ts(ORDERABLE, out="boolean")
+GreaterThan.type_support = ts(ORDERABLE, out="boolean")
+GreaterThanOrEqual.type_support = ts(ORDERABLE, out="boolean")
+And.type_support = ts("boolean")
+Or.type_support = ts("boolean")
+Not.type_support = ts("boolean")
+IsNull.type_support = ts(ALL, out="boolean")
+IsNotNull.type_support = ts(ALL, out="boolean")
+IsNaN.type_support = ts(FRACTIONAL, out="boolean")
+NullIf.type_support = ts(ALL_SCALAR)
+Nvl2.type_support = ts(ALL_SCALAR)
+Nanvl.type_support = ts(FRACTIONAL)
+
+# math on doubles (decimal operands are widened by the eval layer)
+_UnaryMath.type_support = ts(NUMERIC, DECIMAL, out=FRACTIONAL)
+Floor.type_support = ts(NUMERIC)   # decimal floor/ceil/round: check_expr CPU
+Round.type_support = ts(NUMERIC)
+BRound.type_support = ts(NUMERIC)
+Pow.type_support = ts(NUMERIC, DECIMAL, out=FRACTIONAL)
+Atan2.type_support = ts(NUMERIC, DECIMAL, out=FRACTIONAL)
+Hypot.type_support = ts(NUMERIC, DECIMAL, out=FRACTIONAL)
+Signum.type_support = ts(NUMERIC, DECIMAL, out=FRACTIONAL)
+Factorial.type_support = ts(INTEGRAL)
+Greatest.type_support = ts(NUMERIC, DECIMAL)   # Least inherits
+Rint.type_support = ts(NUMERIC, DECIMAL, out=FRACTIONAL)
+
+# bit manipulation
+BitCount.type_support = ts(INTEGRAL, "boolean", out=INTEGRAL)
+BitGet.type_support = ts(INTEGRAL)
+BitwiseAnd.type_support = ts(INTEGRAL)   # Or/Xor inherit
+BitwiseNot.type_support = ts(INTEGRAL)
+ShiftLeft.type_support = ts(INTEGRAL)    # ShiftRight(Unsigned) inherit
+Murmur3Hash.type_support = ts(ALL_SCALAR, out=INTEGRAL)  # XxHash64 inherits
+
+# dates and timestamps
+_DatePart.type_support = ts(DATETIME, out=INTEGRAL)
+Hour.type_support = ts("timestamp", out=INTEGRAL)  # Minute/Second inherit
+WeekOfYear.type_support = ts(DATETIME, out=INTEGRAL)
+LastDay.type_support = ts(DATETIME, out="date")
+AddMonths.type_support = ts(DATETIME, INTEGRAL, out="date")
+MonthsBetween.type_support = ts(DATETIME, out=FRACTIONAL)
+TruncDate.type_support = ts(DATETIME, out="date")
+NextDay.type_support = ts(DATETIME, out="date")
+UnixTimestampOf.type_support = ts(DATETIME, out=INTEGRAL)
+FromUnixTime.type_support = ts(INTEGRAL, out="timestamp")
+DateAdd.type_support = ts(DATETIME, INTEGRAL, out="date")
+DateSub.type_support = ts(DATETIME, INTEGRAL, out="date")
+DateDiff.type_support = ts(DATETIME, out=INTEGRAL)
+FromUTCTimestamp.type_support = ts(DATETIME, out="timestamp")  # To... inherits
+MakeDate.type_support = ts(INTEGRAL, out="date")
+MakeTimestamp.type_support = ts(INTEGRAL, FRACTIONAL, out="timestamp")
+TimestampSeconds.type_support = ts(INTEGRAL, out="timestamp")  # Millis/Micros
+UnixSeconds.type_support = ts(DATETIME, out=INTEGRAL)  # Millis/Micros inherit
+UnixDate.type_support = ts(DATETIME, out=INTEGRAL)
+DateFromUnixDate.type_support = ts(INTEGRAL, out="date")
+
+# strings (extra int children: positions, lengths, repeat counts)
+Length.type_support = ts(STRINGY, out=INTEGRAL)
+OctetLength.type_support = ts(STRINGY, out=INTEGRAL)  # BitLength inherits
+Upper.type_support = ts(STRINGY)
+Lower.type_support = ts(STRINGY)
+StartsWith.type_support = ts(STRINGY, out="boolean")
+EndsWith.type_support = ts(STRINGY, out="boolean")
+Contains.type_support = ts(STRINGY, out="boolean")
+Substring.type_support = ts(STRINGY, INTEGRAL, out=STRINGY)
+StringLeft.type_support = ts(STRINGY, INTEGRAL, out=STRINGY)  # Right inherits
+_StringParams.type_support = ts(STRINGY, INTEGRAL, out=STRINGY)
+# covers Concat/ConcatWs/StringTrim(+Left/Right)/StringReplace/StringLPad/
+# StringRPad/StringRepeat/StringReverse/StringTranslate/InitCap/
+# SubstringIndex via inheritance from _StringParams
+Like.type_support = ts(STRINGY, out="boolean")
+RLike.type_support = ts(STRINGY, out="boolean")
+StringInstr.type_support = ts(STRINGY, out=INTEGRAL)
+StringLocate.type_support = ts(STRINGY, INTEGRAL, out=INTEGRAL)
+Ascii.type_support = ts(STRINGY, out=INTEGRAL)
+Chr.type_support = ts(INTEGRAL, "boolean", out="string")
+Hex.type_support = ts(INTEGRAL, STRINGY, out="string")
+Unhex.type_support = ts(STRINGY, out="binary")
+Base64.type_support = ts(STRINGY, out="string")
+UnBase64.type_support = ts(STRINGY, out="binary")
+Overlay.type_support = ts(STRINGY, out=STRINGY)
+FindInSet.type_support = ts(STRINGY, out=INTEGRAL)
+GetJsonObject.type_support = ts(STRINGY)
+
+# aggregates
+Sum.type_support = ts(NUMERIC, DECIMAL)
+Count.type_support = ts(ALL, out=INTEGRAL)
+Min.type_support = ts(ALL_SCALAR)
+Max.type_support = ts(ALL_SCALAR)
+Average.type_support = ts(NUMERIC, DECIMAL,
+                          out="fractional decimal64 decimal128")
+First.type_support = ts(ALL)
+Last.type_support = ts(ALL)
+AnyValue.type_support = ts(ALL_SCALAR)
+_VarianceBase.type_support = ts(NUMERIC, DECIMAL, out=FRACTIONAL)
+# covers VarianceSamp/Pop, StddevSamp/Pop, Skewness, Kurtosis
+BoolAnd.type_support = ts("boolean")  # BoolOr inherits
+CountIf.type_support = ts("boolean", out=INTEGRAL)
+_CovarianceBase.type_support = ts(NUMERIC, DECIMAL, out=FRACTIONAL)
+# covers CovarSamp/CovarPop/Corr
+MinBy.type_support = ts(ALL_SCALAR, note="order key must be a single-word "
+                        "sortable type; see check_expr")  # MaxBy inherits
+
+# nested types (the _NESTED_OK allowlist in plan/overrides.py)
+GetStructField.type_support = ts(ALL)
+CreateNamedStruct.type_support = ts(ALL, out="struct")
+MapKeys.type_support = ts("map", out="array")
+Size.type_support = ts("array map", out=INTEGRAL)
+ElementAt.type_support = ts(ALL)
+ArrayContains.type_support = ts("array", ALL_SCALAR, out="boolean")

@@ -209,3 +209,11 @@ class WindowExpression(E.Expression):
 
 def over(function: E.Expression, spec: WindowSpec) -> WindowExpression:
     return WindowExpression(function, spec)
+
+
+# type_support declarations (see spark_rapids_tpu.support and the block at
+# the end of exprs/expr.py). Ranking functions take no typed child; Lead/Lag
+# and WindowExpression pass their child's type through.
+WindowFunction.type_support = E.ts(E.ALL_SCALAR)
+Lead.type_support = E.ts(E.ALL_SCALAR)  # Lag inherits
+WindowExpression.type_support = E.ts(E.ALL_SCALAR)

@@ -24,6 +24,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from spark_rapids_tpu import support
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.config import conf as C
 from spark_rapids_tpu.exec import (
@@ -169,6 +170,28 @@ def check_expr(expr: E.Expression, schema: T.Schema) -> List[str]:
             return
         try:
             bound = E.resolve(e, schema)
+            # central (operator, type) gate: placement never exceeds the
+            # class's type_support declaration (spark_rapids_tpu.support;
+            # TypeChecks.scala analog). The special cases below only ever
+            # NARROW further — docs/supported_ops.md is generated from the
+            # same declarations, so the docs are an upper bound on
+            # placement by construction.
+            decl = type(bound).type_support
+            if decl is None:
+                reasons.append(
+                    f"{type(bound).__name__} has no type_support "
+                    "declaration")
+            else:
+                for c in bound.children:
+                    if not decl.ok(c.dtype):
+                        reasons.append(
+                            f"{type(bound).__name__} does not support "
+                            f"{support.classify(c.dtype)} inputs")
+                        break
+                if not decl.ok(bound.dtype, output=True):
+                    reasons.append(
+                        f"{type(bound).__name__} does not support "
+                        f"{support.classify(bound.dtype)} outputs")
             wide_touch = _is_wide(bound.dtype) or any(
                 _is_wide(c.dtype) for c in bound.children)
             if wide_touch:

@@ -187,3 +187,11 @@ class ShuffleExchangeExec(UnaryExec):
         # caches the partition and later ones replay it
         yield from self._shared.read(
             partition, lambda: self._produce(partition))
+
+
+# type_support declarations (spark_rapids_tpu.support)
+from spark_rapids_tpu.support import ALL, ts  # noqa: E402
+
+ShuffleExchangeExec.type_support = ts(
+    ALL, note="hash-partition keys follow HashJoinExec key typing; "
+    "payload columns may be any representable type")

@@ -1,0 +1,142 @@
+"""Machine-checkable (operator, data type) support declarations.
+
+Reference: TypeChecks.scala — every GPU placement in the plugin is a
+statement of which (operator, type) pairs are supported, rendered into
+docs/supported_ops.md and enforced when tagging plans. Here the same
+contract is carried by a ``type_support`` class attribute on every
+``Expression``/``TpuExec`` subclass the plan rewrite may place on device:
+
+- ``plan/overrides.check_expr`` enforces it at plan time (an expression
+  whose resolved input/output dtype falls outside its declaration is
+  tagged back to the CPU engine, never silently placed);
+- ``plan/docs.generate_supported_ops()`` renders docs/supported_ops.md
+  from the same declarations, so the docs cannot drift from the gate;
+- ``tools/static_check.py`` (the type-support pass) statically verifies
+  that every device-placed class declares, that declarations use the
+  vocabulary below, and that the wide-decimal / nested allowlists in
+  plan/overrides.py agree with the declarations.
+
+Declarations use a closed vocabulary of TYPE CLASSES rather than
+concrete dtypes, because support is uniform within a class:
+
+=============  ========================================================
+``boolean``    BooleanType
+``integral``   ByteType, ShortType, IntegerType, LongType
+``fractional`` FloatType, DoubleType
+``decimal64``  DecimalType with precision <= 18 (single-word)
+``decimal128`` DecimalType with precision > 18 (two-limb device repr)
+``date``       DateType
+``timestamp``  TimestampType
+``string``     StringType
+``binary``     BinaryType
+``array``      ArrayType
+``struct``     StructType
+``map``        MapType
+``null``       NullType (always accepted: a typed null literal never
+               forces a fallback by itself)
+=============  ========================================================
+
+The static pass parses ``ts(...)`` call sites, so arguments must be
+string literals or references to the named groups defined here.
+"""
+
+from __future__ import annotations
+
+from typing import FrozenSet, Optional
+
+from spark_rapids_tpu import types as T
+
+#: the closed vocabulary; the lint pass rejects any other word
+TYPE_CLASSES = (
+    "boolean", "integral", "fractional", "decimal64", "decimal128",
+    "date", "timestamp", "string", "binary", "array", "struct", "map",
+    "null",
+)
+
+# named groups (string literals so tools/lint can resolve them statically)
+INTEGRAL = "integral"
+FRACTIONAL = "fractional"
+NUMERIC = "integral fractional"
+DECIMAL = "decimal64 decimal128"
+DECIMAL64 = "decimal64"
+DECIMAL128 = "decimal128"
+DATETIME = "date timestamp"
+STRINGY = "string binary"
+NESTED = "array struct map"
+ORDERABLE = ("boolean integral fractional decimal64 decimal128 "
+             "date timestamp")
+ALL_SCALAR = ("boolean integral fractional decimal64 decimal128 "
+              "date timestamp string binary")
+ALL = ALL_SCALAR + " " + NESTED
+
+
+def classify(dtype: T.DataType) -> str:
+    """Map a concrete DataType to its support-vocabulary class."""
+    if isinstance(dtype, T.BooleanType):
+        return "boolean"
+    if isinstance(dtype, T._IntegralType):
+        return "integral"
+    if isinstance(dtype, (T.FloatType, T.DoubleType)):
+        return "fractional"
+    if isinstance(dtype, T.DecimalType):
+        return ("decimal64" if dtype.precision <= T.DecimalType.MAX_LONG_DIGITS
+                else "decimal128")
+    if isinstance(dtype, T.DateType):
+        return "date"
+    if isinstance(dtype, T.TimestampType):
+        return "timestamp"
+    if isinstance(dtype, T.StringType):
+        return "string"
+    if isinstance(dtype, T.BinaryType):
+        return "binary"
+    if isinstance(dtype, T.ArrayType):
+        return "array"
+    if isinstance(dtype, T.StructType):
+        return "struct"
+    if isinstance(dtype, T.MapType):
+        return "map"
+    if isinstance(dtype, T.NullType):
+        return "null"
+    raise TypeError(f"unclassifiable dtype {dtype!r}")
+
+
+class TypeSupport:
+    """Declared (operator, type) support: which type classes an operator
+    accepts as resolved child dtypes (``inputs``) and may produce as its
+    result dtype (``outputs``)."""
+
+    __slots__ = ("inputs", "outputs", "note")
+
+    def __init__(self, inputs: FrozenSet[str], outputs: FrozenSet[str],
+                 note: str = ""):
+        for w in inputs | outputs:
+            if w not in TYPE_CLASSES:
+                raise ValueError(f"unknown type class {w!r} "
+                                 f"(vocabulary: {TYPE_CLASSES})")
+        self.inputs = inputs
+        self.outputs = outputs
+        self.note = note
+
+    def ok(self, dtype: T.DataType, *, output: bool = False) -> bool:
+        cls = classify(dtype)
+        if cls == "null":
+            return True
+        return cls in (self.outputs if output else self.inputs)
+
+    def __repr__(self):
+        return (f"TypeSupport(in={sorted(self.inputs)}, "
+                f"out={sorted(self.outputs)})")
+
+
+def ts(*classes: str, out: Optional[str] = None,
+       note: str = "") -> TypeSupport:
+    """Build a TypeSupport from space-separated type-class words.
+
+    ``ts(NUMERIC, DECIMAL)`` accepts and produces numeric/decimal;
+    ``ts(STRINGY, out=INTEGRAL)`` accepts strings, produces integers.
+    Every argument must be a string literal or one of the named groups
+    above — the static pass resolves exactly those forms.
+    """
+    inputs = frozenset(w for c in classes for w in c.split())
+    outputs = frozenset(out.split()) if out is not None else inputs
+    return TypeSupport(inputs, outputs, note)

@@ -8,6 +8,13 @@
 #   tests/run_slow_lane.sh                # this lane
 set -e
 cd "$(dirname "$0")/.."
+
+# Unified static analysis first: cheapest signal, one exit code across all
+# passes (type-support matrix, jit-purity, conf-key drift, gauge/cache-key
+# guards, generated-doc drift). Also runs in the default lane via
+# tests/test_lint.py; here it fails the lane before any slow test spins up.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/static_check.py
+
 SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}" \
     python -m pytest \
     tests/test_distributed.py tests/test_cluster.py \

@@ -1,186 +1,49 @@
 #!/usr/bin/env python3
-"""Static guard for the gauge/counter catalog contract.
-
-``obs/gauges.CATALOG`` is the single source of truth for every metric the
-process exposes: ``snapshot()`` zero-fills exactly the catalog names, the
-Prometheus exposition renders from it, and tests assert
-``set(snapshot()) == {name for name, _, _ in CATALOG}``. A counter that a
-subsystem increments but never declares is invisible to scrapers and to
-QueryProfile diffs — it silently vanishes from the process view.
-
-The convention: counter names end in ``_total``. This checker flags any
-``*_total`` string constant that the runtime uses as a metric name —
-
-1. a dict-literal key (the ``counters()`` / ``cache_stats()`` idiom),
-2. a subscript key (``_COUNTERS["fault_injected_total"] += 1``),
-3. the first argument of a call to ``note(...)`` (the task-metrics feed),
-
-— but that ``CATALOG`` does not declare. SQL column aliases like
-``year_total`` live in ``.alias(...)`` / ``col(...)`` call arguments and
-match none of these shapes.
-
-Two sibling catalogs ride the same guard:
-
-- the per-site memory gauges ``obs/memtrack.py`` derives from its
-  ``SITES`` tuple (``mem_site_<site>_peak_bytes``) plus its fixed
-  tracked-bytes gauges must all be declared in ``CATALOG`` — adding a
-  site without declaring its gauge would silently drop it from the
-  Prometheus view;
-- every ``*_ns`` histogram name passed to ``record(...)`` / ``get(...)``
-  must be declared in ``obs/histo.CATALOG`` (``histo.record`` raises at
-  runtime on undeclared names; the static check catches cold paths tests
-  never drive).
-
-Pure AST analysis, no imports of the checked code; wired into the default
-test lane via tests/test_obs.py.
+"""Back-compat shim: the gauge-catalog guard now lives in
+tools/lint/gauge_catalog.py as a pass of the unified driver
+(tools/static_check.py). This keeps the original entry point and helper
+names for existing lane scripts and tests; new checks go in tools/lint/.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "spark_rapids_tpu")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint import gauge_catalog as _pass  # noqa: E402
 
 
 def catalog_names() -> set:
-    """CATALOG metric names, parsed statically from obs/gauges.py."""
-    path = os.path.join(PKG, "obs", "gauges.py")
-    with open(path, "r") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets = [node.target]
-        for t in targets:
-            if isinstance(t, ast.Name) and t.id == "CATALOG":
-                entries = ast.literal_eval(node.value)
-                return {name for name, _, _ in entries}
-    raise SystemExit("obs/gauges.py: CATALOG assignment not found "
-                     "(update tools/check_gauge_catalog.py)")
-
-
-def _module_literal(relpath: str, name: str):
-    """Top-level literal assignment ``name = <literal>`` in a package
-    module, or None when absent."""
-    path = os.path.join(PKG, relpath)
-    with open(path, "r") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets = [node.target]
-        for t in targets:
-            if isinstance(t, ast.Name) and t.id == name:
-                return ast.literal_eval(node.value)
-    return None
+    return _pass.catalog_names(REPO)
 
 
 def histo_names() -> set:
-    """obs/histo.py CATALOG names (2-tuples of name, help)."""
-    entries = _module_literal(os.path.join("obs", "histo.py"), "CATALOG")
-    if entries is None:
-        raise SystemExit("obs/histo.py: CATALOG assignment not found "
-                         "(update tools/check_gauge_catalog.py)")
-    return {name for name, _ in entries}
+    return _pass.histo_names(REPO)
 
 
 def check_memtrack_site_gauges(declared: set, violations: list) -> None:
-    """Every memtrack site must have its derived peak gauge declared, and
-    the fixed tracked-bytes gauges must be declared too."""
-    sites = _module_literal(os.path.join("obs", "memtrack.py"), "SITES")
-    if sites is None:
-        violations.append("obs/memtrack.py: SITES tuple not found "
-                          "(update tools/check_gauge_catalog.py)")
-        return
-    expected = {"mem_site_" + s.replace("-", "_") + "_peak_bytes"
-                for s in sites}
-    expected |= {"mem_tracked_live_bytes", "mem_tracked_peak_bytes"}
-    for name in sorted(expected - declared):
-        violations.append(
-            f"spark_rapids_tpu/obs/memtrack.py: memory gauge '{name}' is "
-            f"emitted by memtrack.counters() but not declared in "
-            f"obs/gauges.CATALOG — it would be invisible to "
-            f"snapshot()/Prometheus")
-
-
-def _is_metric_name(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Constant) and isinstance(node.value, str)
-            and node.value.endswith("_total"))
-
-
-def _is_histo_name(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Constant) and isinstance(node.value, str)
-            and node.value.endswith("_ns"))
+    _pass.check_memtrack_site_gauges(declared, violations, REPO)
 
 
 def _check_file(path: str, declared: set, violations: list,
                 histos: set = frozenset()) -> None:
-    with open(path, "r") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        violations.append(f"{path}: not parseable: {e}")
-        return
-    rel = os.path.relpath(path, REPO)
-
-    def flag(const: ast.Constant, how: str) -> None:
-        if const.value not in declared:
-            violations.append(
-                f"{rel}:{const.lineno}: counter '{const.value}' {how} but is "
-                f"not declared in obs/gauges.CATALOG — it would be invisible "
-                f"to snapshot()/Prometheus/QueryProfile diffs")
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Dict):
-            for k in node.keys:
-                if k is not None and _is_metric_name(k):
-                    flag(k, "is a dict-literal metric key")
-        elif isinstance(node, ast.Subscript):
-            sl = node.slice
-            if _is_metric_name(sl):
-                flag(sl, "is used as a subscript metric key")
-        elif isinstance(node, ast.Call):
-            fname = (node.func.id if isinstance(node.func, ast.Name)
-                     else node.func.attr if isinstance(node.func, ast.Attribute)
-                     else None)
-            if fname == "note" and node.args and _is_metric_name(node.args[0]):
-                flag(node.args[0], "is passed to note(...)")
-            # histogram-catalog guard: record()/get() with a *_ns name
-            # constant must reference a declared obs/histo.CATALOG entry
-            if (fname in ("record", "get") and node.args
-                    and _is_histo_name(node.args[0])
-                    and node.args[0].value not in histos):
-                violations.append(
-                    f"{rel}:{node.args[0].lineno}: histogram "
-                    f"'{node.args[0].value}' is passed to {fname}(...) but "
-                    f"is not declared in obs/histo.CATALOG — record() "
-                    f"raises on undeclared names at runtime")
+    _pass.check_file(path, declared, violations, histos, REPO)
 
 
 def main() -> int:
-    declared = catalog_names()
-    histos = histo_names()
-    violations: list = []
-    check_memtrack_site_gauges(declared, violations)
-    for dirpath, dirnames, filenames in os.walk(PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                _check_file(os.path.join(dirpath, fn), declared, violations,
-                            histos)
+    violations = _pass.run_pass(REPO)
     if violations:
         print("gauge-catalog guard FAILED:", file=sys.stderr)
         for v in violations:
             print(f"  {v}", file=sys.stderr)
         return 1
+    declared = catalog_names()
+    histos = histo_names()
     print(f"gauge-catalog guard OK ({len(declared)} declared metrics, "
           f"{len(histos)} histograms)")
     return 0

@@ -1,0 +1,9 @@
+"""Unified static-analysis framework (tools/static_check.py passes).
+
+Each pass is a pure-AST check over the repo source (no imports of the
+checked code, except the doc-drift pass which runs the documented
+generators). Passes register in ``tools.lint.core.REGISTRY`` and the
+driver runs them all with one exit code and per-pass timings.
+"""
+
+from tools.lint.core import PASSES, Pass, run  # noqa: F401

@@ -1,0 +1,99 @@
+"""conf-keys pass: every spark.rapids.tpu.* key is declared + documented.
+
+The config registry (config/conf.py ``conf(key, ...)`` calls) is the
+single source of truth for configuration: a key read anywhere in the
+package but never declared silently reads a raw default with no
+validation, no docs entry, and no discoverability; a declared non-internal
+key missing from docs/configs.md is invisible to users. Pure AST over the
+package plus a text scan of the committed docs — the doc-drift pass
+additionally re-renders configs.md and diffs it byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Set, Tuple
+
+from tools.lint import core
+from tools.lint.core import register
+
+#: a full conf key, nothing more: rejects prose fragments like
+#: "spark.rapids.tpu.sql.enabled is false" inside doc strings
+_KEY_RE = re.compile(r"^spark\.rapids\.tpu\.[A-Za-z0-9][A-Za-z0-9.]*$")
+
+
+def declared_keys(root: str) -> Tuple[Set[str], Set[str]]:
+    """(all declared keys, internal keys) from config/conf.py conf(...)
+    calls."""
+    path = os.path.join(core.pkg_dir(root), "config", "conf.py")
+    declared: Set[str] = set()
+    internal: Set[str] = set()
+    for node in ast.walk(core.parse(path)):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "conf" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        key = node.args[0].value
+        declared.add(key)
+        for kw in node.keywords:
+            if kw.arg == "internal" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value:
+                internal.add(key)
+    return declared, internal
+
+
+def documented_keys(root: str) -> Set[str]:
+    path = os.path.join(root, "docs", "configs.md")
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r") as f:
+        text = f.read()
+    return set(re.findall(r"spark\.rapids\.tpu\.[A-Za-z0-9.]+", text))
+
+
+def used_keys(root: str) -> List[Tuple[str, int, str]]:
+    """(relpath, lineno, key) for every full-key string constant in the
+    package outside config/conf.py."""
+    out = []
+    conf_path = os.path.join(core.pkg_dir(root), "config", "conf.py")
+    for path in core.iter_py_files(root):
+        if os.path.samefile(path, conf_path):
+            continue
+        rel = os.path.relpath(path, root)
+        for node in ast.walk(core.parse(path)):
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str) and _KEY_RE.match(node.value):
+                out.append((rel, node.lineno, node.value))
+    return out
+
+
+@register("conf-keys",
+          "spark.rapids.tpu.* keys are declared in config/conf.py and "
+          "documented")
+def run_pass(root: str) -> List[str]:
+    violations: List[str] = []
+    declared, internal = declared_keys(root)
+    if not declared:
+        violations.append("config/conf.py: no conf(...) declarations found "
+                          "(registry moved? update tools/lint)")
+        return violations
+    documented = documented_keys(root)
+    for rel, lineno, key in used_keys(root):
+        if key not in declared:
+            violations.append(
+                f"{rel}:{lineno}: conf key '{key}' is read but not "
+                f"declared in config/conf.py — it has no type, default, "
+                f"validation, or docs entry")
+    for key in sorted(declared - internal - documented):
+        violations.append(
+            f"docs/configs.md: declared key '{key}' is not documented — "
+            f"regenerate with spark_rapids_tpu.plan.docs.write_docs('docs')")
+    for key in sorted(documented - declared):
+        violations.append(
+            f"docs/configs.md: documents '{key}' which is no longer "
+            f"declared in config/conf.py — regenerate the docs")
+    return violations

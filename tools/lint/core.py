@@ -1,0 +1,104 @@
+"""Pass registry and runner for the static-analysis driver.
+
+A pass is a named function ``fn(root) -> list[str]`` returning violation
+messages (empty = clean) for the repo rooted at ``root``. Registration
+order is execution order; the driver (tools/static_check.py) prints
+per-pass timings and exits nonzero when any pass reports violations or
+raises.
+
+Shared AST helpers live here so passes stay import-free with respect to
+the checked code: everything is parsed, never executed (the doc-drift
+pass is the single declared exception — it runs the doc generators).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional
+
+
+class Pass(NamedTuple):
+    name: str
+    description: str
+    fn: Callable[[str], List[str]]
+
+
+#: registration order is execution order
+PASSES: List[Pass] = []
+
+
+def register(name: str, description: str):
+    def deco(fn):
+        PASSES.append(Pass(name, description, fn))
+        return fn
+
+    return deco
+
+
+class Result(NamedTuple):
+    name: str
+    violations: List[str]
+    seconds: float
+
+
+def run(root: str, only: Optional[List[str]] = None) -> List[Result]:
+    """Run (a subset of) the registered passes against ``root``."""
+    results = []
+    for p in PASSES:
+        if only and p.name not in only:
+            continue
+        t0 = time.perf_counter()
+        try:
+            violations = p.fn(root)
+        except Exception as e:  # a crashing pass is a failing pass
+            violations = [f"pass crashed: {type(e).__name__}: {e}"]
+        results.append(Result(p.name, violations, time.perf_counter() - t0))
+    return results
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def pkg_dir(root: str) -> str:
+    return os.path.join(root, "spark_rapids_tpu")
+
+
+def iter_py_files(root: str, subdir: str = "spark_rapids_tpu"):
+    """Yield every .py path under ``root/subdir``, sorted, skipping
+    __pycache__."""
+    base = os.path.join(root, subdir)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+_TREES: Dict[str, ast.Module] = {}
+
+
+def parse(path: str) -> ast.Module:
+    """Parse-and-cache: several passes walk the same files."""
+    mtime = os.path.getmtime(path)
+    key = f"{path}:{mtime}"
+    tree = _TREES.get(key)
+    if tree is None:
+        with open(path, "r") as f:
+            tree = ast.parse(f.read(), filename=path)
+        _TREES[key] = tree
+    return tree
+
+
+def module_literal(path: str, name: str):
+    """Top-level literal assignment ``name = <literal>``, or None."""
+    for node in ast.walk(parse(path)):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                return ast.literal_eval(node.value)
+    return None

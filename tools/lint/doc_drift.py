@@ -1,0 +1,48 @@
+"""doc-drift pass: committed generated docs match a fresh render.
+
+docs/configs.md and docs/supported_ops.md are generated artifacts
+(spark_rapids_tpu/plan/docs.py) committed to the repo so they are
+reviewable and browsable; this pass re-renders both and fails on any
+byte difference, so a change to the config registry or to a
+``type_support`` declaration cannot land without its doc update.
+
+This is the one pass that imports the checked package (the generators
+ARE the contract being checked); it forces ``JAX_PLATFORMS=cpu`` before
+the first jax import so it runs identically on accelerator-less CI.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+from tools.lint.core import register
+
+
+@register("doc-drift",
+          "docs/configs.md + docs/supported_ops.md match a fresh render")
+def run_pass(root: str) -> List[str]:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from spark_rapids_tpu.config import conf as C
+    from spark_rapids_tpu.plan import docs as D
+
+    violations: List[str] = []
+    for name, fresh in (("configs.md", C.generate_docs()),
+                        ("supported_ops.md", D.generate_supported_ops())):
+        path = os.path.join(root, "docs", name)
+        if not os.path.exists(path):
+            violations.append(f"docs/{name}: missing — generate with "
+                              "spark_rapids_tpu.plan.docs.write_docs('docs')")
+            continue
+        with open(path, "r") as f:
+            committed = f.read()
+        if committed != fresh:
+            violations.append(
+                f"docs/{name}: drifted from a fresh render — the registry "
+                f"or a type_support declaration changed without the doc; "
+                f"regenerate with "
+                f"spark_rapids_tpu.plan.docs.write_docs('docs')")
+    return violations

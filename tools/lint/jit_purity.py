@@ -1,0 +1,297 @@
+"""jit-purity pass: functions traced under jit must be pure (PR-5 class).
+
+The bug shape this exists for: a module imported for the first time
+*inside* a traced fused body executes its module level under trace, so a
+module-level ``jnp.*(...)`` constant materializes as a tracer and is then
+shared across unrelated compiles — silently wrong results, not a crash.
+PR 5 shipped exactly this (exprs/eval.py lazily importing a module with
+jnp constants from a jitted body).
+
+Three checks, all pure AST:
+
+1. **module-level jnp constants** — any top-level assignment in a package
+   module whose value *calls* ``jnp.*`` / ``jax.numpy.*`` materializes a
+   device array at import time; if the first import happens under trace
+   it becomes a leaked tracer. Use ``np.*`` for constant tables (jax
+   accepts numpy operands) or build the array inside the traced function.
+   References (``X = jnp.int64``) and jit wrappers (``jax.jit(...)``) are
+   fine; lambdas/defs in the value are not executed at import and are
+   skipped. Suppress a deliberate site with ``# jit-purity: ok`` on the
+   assignment line.
+2. **nondeterminism under trace** — calls to wall clocks, ``random``,
+   ``np.random``, ``uuid``, ... in any function statically reachable from
+   a jit root bake one arbitrary value into the compiled program.
+3. **imports under trace** — an ``import`` statement executing inside a
+   traced function is the PR-5 *trigger*: if the imported package module
+   materializes jnp at import time, the constant is traced. Lazy imports
+   under trace are endemic (circular-import workarounds), so this check
+   flags only the dangerous composite: an import, under trace, of a
+   package module that check 1 found impure. Check 1 alone keeps HEAD
+   safe; check 3 pinpoints the trigger site when both halves appear.
+
+Jit roots: functions decorated with ``jax.jit`` (incl. ``partial``),
+and every function referenced in the arguments of a ``shared_jit(...)``
+or ``jax.jit(...)`` call (the ``make`` thunks — including names inside
+lambdas, which covers the ``shared_jit(key, lambda: _make(...))`` idiom).
+Factories count: nested ``def``s inside reachable functions are the
+closures that actually get traced, so they are reachable too.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+from tools.lint import core
+from tools.lint.core import register
+
+#: dotted-call prefixes that bake a value into a traced program
+_NONDET = (
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "random.", "np.random.", "numpy.random.", "datetime.now",
+    "datetime.utcnow", "os.urandom", "uuid.", "secrets.",
+)
+
+_SUPPRESS = "# jit-purity: ok"
+
+
+def _dotted(func: ast.AST) -> str:
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _jnp_call_in_value(value: ast.AST) -> int:
+    """Line of a jnp.* call materializing at import, or 0. Does not
+    descend into lambdas/defs (not executed at import) and skips jit
+    wrappers (they trace lazily, at first call)."""
+
+    def scan(node: ast.AST) -> int:
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return 0
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name.endswith(".jit") or name in ("jit", "shared_jit",
+                                                 "partial"):
+                return 0
+            if name.startswith(("jnp.", "jax.numpy.")):
+                return node.lineno
+        for child in ast.iter_child_nodes(node):
+            ln = scan(child)
+            if ln:
+                return ln
+        return 0
+
+    return scan(value)
+
+
+class _Module:
+    __slots__ = ("rel", "tree", "src_lines", "functions", "imports_from",
+                 "module_aliases", "roots")
+
+    def __init__(self, rel):
+        self.rel = rel
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        #: local name -> (module rel, function name) for from-imports
+        self.imports_from: Dict[str, Tuple[str, str]] = {}
+        #: local alias -> module rel, for "from pkg import mod [as alias]"
+        self.module_aliases: Dict[str, str] = {}
+        self.roots: Set[str] = set()
+
+
+def _mod_rel(dotted: str) -> str:
+    """spark_rapids_tpu.exec.kernels -> exec/kernels (package-relative)."""
+    parts = dotted.split(".")
+    if parts and parts[0] == "spark_rapids_tpu":
+        parts = parts[1:]
+    return "/".join(parts)
+
+
+def _load_module(root: str, path: str) -> _Module:
+    pkg = core.pkg_dir(root)
+    rel = os.path.relpath(path, pkg)[:-3]  # strip .py
+    m = _Module(rel)
+    tree = core.parse(path)
+    m.tree = tree
+    with open(path, "r") as f:
+        m.src_lines = f.read().splitlines()
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # last definition wins on name collisions (mirrors rebinding)
+            m.functions[node.name] = node
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.startswith("spark_rapids_tpu"):
+            src = _mod_rel(node.module)
+            for alias in node.names:
+                local = alias.asname or alias.name
+                # could be a function OR a submodule import
+                m.imports_from[local] = (src, alias.name)
+                m.module_aliases[local] = src + "/" + alias.name
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith("spark_rapids_tpu"):
+                    local = alias.asname or alias.name.split(".")[-1]
+                    m.module_aliases[local] = _mod_rel(alias.name)
+
+    # jit roots
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                name = _dotted(d)
+                if name.endswith("jit") or (
+                        isinstance(dec, ast.Call) and any(
+                            _dotted(a).endswith("jit")
+                            for a in dec.args)):
+                    m.roots.add(node.name)
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name == "shared_jit" or name.endswith(".shared_jit") \
+                    or name == "jax.jit" or name == "jit":
+                for a in node.args[1:] if "shared_jit" in name \
+                        else node.args[:1]:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Name) \
+                                and sub.id in m.functions:
+                            m.roots.add(sub.id)
+                        elif isinstance(sub, ast.Attribute) \
+                                and isinstance(sub.value, ast.Name) \
+                                and sub.value.id == "self":
+                            m.roots.add(sub.attr)  # method thunk
+    return m
+
+
+def _reachable(modules: Dict[str, _Module]) -> Set[Tuple[str, str]]:
+    """(module rel, function name) pairs reachable from any jit root."""
+    work = [(m.rel, fn) for m in modules.values() for fn in m.roots
+            if fn in m.functions]
+    seen: Set[Tuple[str, str]] = set(work)
+    while work:
+        mod_rel, fname = work.pop()
+        m = modules.get(mod_rel)
+        if m is None or fname not in m.functions:
+            continue
+        fn = m.functions[fname]
+
+        def visit(target: Tuple[str, str]):
+            if target not in seen:
+                seen.add(target)
+                work.append(target)
+
+        # nested defs are the closures that get traced
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                visit((mod_rel, node.name))
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id in m.functions:
+                    visit((mod_rel, f.id))
+                elif f.id in m.imports_from:
+                    src, orig = m.imports_from[f.id]
+                    if src in modules and orig in modules[src].functions:
+                        visit((src, orig))
+            elif isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Name):
+                alias = f.value.id
+                target_mod = m.module_aliases.get(alias)
+                if target_mod in modules \
+                        and f.attr in modules[target_mod].functions:
+                    visit((target_mod, f.attr))
+    return seen
+
+
+@register("jit-purity",
+          "no module-level jnp constants; no nondeterminism or imports "
+          "under trace")
+def run_pass(root: str) -> List[str]:
+    violations: List[str] = []
+    modules: Dict[str, _Module] = {}
+    for path in core.iter_py_files(root):
+        m = _load_module(root, path)
+        modules[m.rel] = m
+
+    # check 1: module-level jnp constants, all package modules
+    impure: Set[str] = set()
+    for m in modules.values():
+        for node in m.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None:
+                continue
+            ln = _jnp_call_in_value(value)
+            if not ln:
+                continue
+            line = (m.src_lines[node.lineno - 1]
+                    if node.lineno <= len(m.src_lines) else "")
+            if _SUPPRESS in line:
+                continue
+            impure.add(m.rel)
+            violations.append(
+                f"spark_rapids_tpu/{m.rel}.py:{ln}: module-level jnp "
+                f"constant materializes a device array at import time; if "
+                f"the first import runs under trace it is captured as a "
+                f"tracer shared across compiles (the PR-5 eval.py bug). "
+                f"Use np.* for constant tables or build the array inside "
+                f"the traced function ({_SUPPRESS!r} to suppress)")
+
+    # checks 2+3: nondeterminism / imports in jit-reachable functions
+    for mod_rel, fname in sorted(_reachable(modules)):
+        m = modules[mod_rel]
+        fn = m.functions[fname]
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if any(name == p or (p.endswith(".") and
+                                     name.startswith(p))
+                       for p in _NONDET):
+                    line = (m.src_lines[node.lineno - 1]
+                            if node.lineno <= len(m.src_lines) else "")
+                    if _SUPPRESS in line:
+                        continue
+                    violations.append(
+                        f"spark_rapids_tpu/{m.rel}.py:{node.lineno}: "
+                        f"{fname}() is reachable from a jit root and calls "
+                        f"{name}() — the value is baked into the compiled "
+                        f"program at trace time (one arbitrary sample "
+                        f"forever); thread it in as an argument instead")
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                # dangerous only when the imported package module is
+                # impure (check 1) — its constants would trace
+                targets = []
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    base = _mod_rel(node.module)
+                    targets.append(base)
+                    targets += ["/".join(filter(None, (base, a.name)))
+                                for a in node.names]
+                elif isinstance(node, ast.Import):
+                    targets += [_mod_rel(a.name) for a in node.names]
+                hit = [t for t in targets if t in impure]
+                if not hit:
+                    continue
+                line = (m.src_lines[node.lineno - 1]
+                        if node.lineno <= len(m.src_lines) else "")
+                if _SUPPRESS in line:
+                    continue
+                violations.append(
+                    f"spark_rapids_tpu/{m.rel}.py:{node.lineno}: "
+                    f"{fname}() is reachable from a jit root and imports "
+                    f"{hit[0]} under trace, and that module materializes "
+                    f"jnp constants at import — the first import under "
+                    f"trace captures them as tracers (the exact PR-5 "
+                    f"shape); hoist the import or purify the module")
+    return violations

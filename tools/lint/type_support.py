@@ -1,0 +1,365 @@
+"""type-support pass: device placement is a provable statement.
+
+Reference: TypeChecks.scala — every placement declares its (operator,
+data type) support, the docs are generated from the declarations, and the
+plan tagger enforces them. Here the declaration is a ``type_support``
+class attribute (spark_rapids_tpu/support.py) on every ``Expression`` /
+``TpuExec`` subclass the plan rewrite may place on device; this pass
+statically proves the pieces agree:
+
+1. every class in ``plan/overrides._DEVICE_EXPRS`` resolves a declaration
+   (directly or by inheritance) — an undeclared class would now always
+   fall back, which is either dead allowlist weight or a placement hole;
+2. every declaration uses only the closed vocabulary
+   (``support.TYPE_CLASSES``), with ``ts(...)`` arguments that are string
+   literals or the named groups — anything else is invisible to static
+   tooling and to the docs generator;
+3. the wide-decimal allowlist (``_WIDE_OK``) only lists classes whose
+   declaration includes ``decimal128`` inputs, and the nested allowlist
+   (``_NESTED_OK``) only lists classes declaring a nested class — a
+   mismatch means the allowlist and the central gate contradict and the
+   entry is dead;
+4. every exec class ``Overrides`` constructs (device placement sites in
+   plan/overrides.py) resolves a declaration;
+5. the central gate is still wired: ``check_expr`` must reference
+   ``type_support``;
+6. a class whose ``dtype`` property returns a recognizable ``T.<SINGLETON>``
+   must include that type class in its declared outputs — the static form
+   of "an op constructs a dtype outside its declaration".
+
+Pure AST; the declarations are resolved without importing the package.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lint import core
+from tools.lint.core import register
+
+#: files holding Expression/TpuExec subclasses + declarations
+_EXPR_FILES = (os.path.join("exprs", "expr.py"),
+               os.path.join("exprs", "window.py"))
+
+#: T singletons whose support class is statically known (check 6)
+_SINGLETON_CLASS = {
+    "BOOLEAN": "boolean", "BYTE": "integral", "SHORT": "integral",
+    "INT": "integral", "LONG": "integral", "FLOAT": "fractional",
+    "DOUBLE": "fractional", "DATE": "date", "TIMESTAMP": "timestamp",
+    "STRING": "string", "BINARY": "binary",
+}
+
+
+def _support_constants(root: str, violations: List[str]) -> Tuple[
+        Set[str], Dict[str, str]]:
+    """(vocabulary, {group name: space-separated words}) parsed statically
+    from spark_rapids_tpu/support.py."""
+    path = os.path.join(core.pkg_dir(root), "support.py")
+    tree = core.parse(path)
+    vocab: Set[str] = set()
+    groups: Dict[str, str] = {}
+
+    def resolve(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return groups.get(node.id)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left, right = resolve(node.left), resolve(node.right)
+            if left is not None and right is not None:
+                return left + right
+        return None
+
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id == "TYPE_CLASSES":
+                vocab = set(ast.literal_eval(node.value))
+            else:
+                v = resolve(node.value)
+                if v is not None:
+                    groups[t.id] = v
+    if not vocab:
+        violations.append(
+            "spark_rapids_tpu/support.py: TYPE_CLASSES not found — the "
+            "type-support vocabulary is gone (update tools/lint)")
+    return vocab, groups
+
+
+class _Decl:
+    __slots__ = ("inputs", "outputs", "where")
+
+    def __init__(self, inputs, outputs, where):
+        self.inputs, self.outputs, self.where = inputs, outputs, where
+
+
+def _resolve_ts_call(call: ast.Call, groups: Dict[str, str],
+                     where: str, violations: List[str]) -> Optional[_Decl]:
+    """Resolve a ``ts(...)`` call site to (inputs, outputs) word sets."""
+
+    def words(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        name = (node.id if isinstance(node, ast.Name)
+                else node.attr if isinstance(node, ast.Attribute) else None)
+        if name is not None and name in groups:
+            return groups[name]
+        return None
+
+    inputs: Set[str] = set()
+    for a in call.args:
+        w = words(a)
+        if w is None:
+            violations.append(
+                f"{where}: ts(...) argument is not a string literal or a "
+                "named group from spark_rapids_tpu/support.py — the "
+                "declaration is invisible to static tooling")
+            return None
+        inputs |= set(w.split())
+    outputs = set(inputs)
+    for kw in call.keywords:
+        if kw.arg == "out":
+            w = words(kw.value)
+            if w is None:
+                violations.append(
+                    f"{where}: ts(out=...) is not a string literal or a "
+                    "named group — the declaration is invisible to static "
+                    "tooling")
+                return None
+            outputs = set(w.split())
+    return _Decl(inputs, outputs, where)
+
+
+def _is_ts_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return ((isinstance(f, ast.Name) and f.id == "ts")
+            or (isinstance(f, ast.Attribute) and f.attr == "ts"))
+
+
+def _collect_classes(root: str, groups: Dict[str, str],
+                     violations: List[str]) -> Tuple[
+        Dict[str, List[str]], Dict[str, _Decl], Set[str]]:
+    """(class -> base names, class -> declaration, exec class names) across
+    the expression and exec modules."""
+    bases: Dict[str, List[str]] = {}
+    decls: Dict[str, _Decl] = {}
+    exec_classes: Set[str] = set()
+
+    files = [os.path.join(core.pkg_dir(root), rel) for rel in _EXPR_FILES]
+    exec_dir = os.path.join(core.pkg_dir(root), "exec")
+    exec_files = [os.path.join(exec_dir, f)
+                  for f in sorted(os.listdir(exec_dir))
+                  if f.endswith(".py")]
+    exec_files.append(os.path.join(core.pkg_dir(root), "shuffle",
+                                   "exchange_exec.py"))
+    for path in files + exec_files:
+        rel = os.path.relpath(path, root)
+        is_exec = path in exec_files
+        tree = core.parse(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                base_names = []
+                for b in node.bases:
+                    if isinstance(b, ast.Name):
+                        base_names.append(b.id)
+                    elif isinstance(b, ast.Attribute):
+                        base_names.append(b.attr)
+                bases[node.name] = base_names
+                if is_exec:
+                    exec_classes.add(node.name)
+                for stmt in node.body:
+                    if (isinstance(stmt, ast.Assign)
+                            and any(isinstance(t, ast.Name)
+                                    and t.id == "type_support"
+                                    for t in stmt.targets)
+                            and _is_ts_call(stmt.value)):
+                        decls[node.name] = _resolve_ts_call(
+                            stmt.value, groups,
+                            f"{rel}:{stmt.lineno} ({node.name})", violations)
+            elif isinstance(node, ast.Assign):
+                # module-level ClassName.type_support = ts(...)
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and t.attr == "type_support"
+                            and isinstance(t.value, ast.Name)
+                            and _is_ts_call(node.value)):
+                        decls[t.value.id] = _resolve_ts_call(
+                            node.value, groups,
+                            f"{rel}:{node.lineno} ({t.value.id})",
+                            violations)
+    return bases, decls, exec_classes
+
+
+def _resolve_decl(name: str, bases: Dict[str, List[str]],
+                  decls: Dict[str, _Decl],
+                  _seen: Optional[Set[str]] = None) -> Optional[_Decl]:
+    """A class declares if itself or any statically-resolvable ancestor
+    declares (mirrors attribute inheritance at runtime)."""
+    if _seen is None:
+        _seen = set()
+    if name in _seen:
+        return None
+    _seen.add(name)
+    if name in decls:
+        return decls[name]
+    for b in bases.get(name, ()):
+        d = _resolve_decl(b, bases, decls, _seen)
+        if d is not None:
+            return d
+    return None
+
+
+def _allowlist_names(tree: ast.Module, var: str) -> List[str]:
+    """Names in a ``VAR = (E.Foo, Bar, ...)`` tuple assignment."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == var and isinstance(
+                        node.value, (ast.Tuple, ast.List)):
+                    out = []
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Attribute):
+                            out.append(el.attr)
+                        elif isinstance(el, ast.Name):
+                            out.append(el.id)
+                    return out
+    return []
+
+
+@register("type-support",
+          "device placements declare their (op,type) matrix; allowlists "
+          "and gate agree")
+def run_pass(root: str) -> List[str]:
+    violations: List[str] = []
+    vocab, groups = _support_constants(root, violations)
+    if not vocab:
+        return violations
+    bases, decls, exec_classes = _collect_classes(root, groups, violations)
+
+    def _is_exec(name: str, _seen=None) -> bool:
+        """True when the class's static base chain reaches TpuExec —
+        spec/helper classes in exec/ modules (SortOrder, SortSpec, ...)
+        are not physical operators and need no declaration."""
+        if _seen is None:
+            _seen = set()
+        if name in _seen:
+            return False
+        _seen.add(name)
+        if name == "TpuExec":
+            return True
+        return any(_is_exec(b, _seen) for b in bases.get(name, ()))
+
+    exec_classes = {n for n in exec_classes if _is_exec(n)}
+
+    # check 2: vocabulary
+    for name, d in sorted(decls.items()):
+        if d is None:
+            continue
+        bad = sorted((d.inputs | d.outputs) - vocab)
+        if bad:
+            violations.append(
+                f"{d.where}: unknown type class(es) {bad} — the vocabulary "
+                f"is closed (spark_rapids_tpu/support.py TYPE_CLASSES)")
+
+    ov_path = os.path.join(core.pkg_dir(root), "plan", "overrides.py")
+    ov_rel = os.path.relpath(ov_path, root)
+    ov_tree = core.parse(ov_path)
+
+    # check 1: _DEVICE_EXPRS coverage
+    device_exprs = _allowlist_names(ov_tree, "_DEVICE_EXPRS")
+    if not device_exprs:
+        violations.append(f"{ov_rel}: _DEVICE_EXPRS not found (placement "
+                          "allowlist moved? update tools/lint)")
+    for name in device_exprs:
+        if _resolve_decl(name, bases, decls) is None:
+            violations.append(
+                f"{ov_rel}: {name} is in _DEVICE_EXPRS but resolves no "
+                f"type_support declaration — check_expr now rejects every "
+                f"placement of it (dead allowlist entry or placement hole); "
+                f"declare it in the block at the end of exprs/expr.py")
+
+    # check 3: allowlist/declaration coherence
+    for name in _allowlist_names(ov_tree, "_WIDE_OK"):
+        d = _resolve_decl(name, bases, decls)
+        if d is not None and "decimal128" not in d.inputs:
+            violations.append(
+                f"{ov_rel}: {name} is in _WIDE_OK but its type_support "
+                f"declaration has no decimal128 inputs — the central gate "
+                f"rejects what the allowlist permits (dead entry)")
+    for name in _allowlist_names(ov_tree, "_NESTED_OK"):
+        d = _resolve_decl(name, bases, decls)
+        if d is not None and not ((d.inputs | d.outputs)
+                                  & {"array", "struct", "map"}):
+            violations.append(
+                f"{ov_rel}: {name} is in _NESTED_OK but its type_support "
+                f"declaration has no nested (array/struct/map) inputs or "
+                f"outputs — the central gate rejects what the allowlist "
+                f"permits")
+
+    # check 4: exec classes Overrides constructs must declare
+    for node in ast.walk(ov_tree):
+        if isinstance(node, ast.Call):
+            fname = (node.func.id if isinstance(node.func, ast.Name)
+                     else node.func.attr
+                     if isinstance(node.func, ast.Attribute) else None)
+            if fname in exec_classes and _resolve_decl(
+                    fname, bases, decls) is None:
+                violations.append(
+                    f"{ov_rel}:{node.lineno}: Overrides places {fname} on "
+                    f"device but it resolves no type_support declaration — "
+                    f"declare one (see docs/static_analysis.md)")
+
+    # check 5: the central gate is wired
+    for node in ast.walk(ov_tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "check_expr":
+            mentions = any(isinstance(s, ast.Attribute)
+                           and s.attr == "type_support"
+                           for s in ast.walk(node))
+            if not mentions:
+                violations.append(
+                    f"{ov_rel}:{node.lineno}: check_expr() no longer "
+                    "references type_support — the central (op,type) gate "
+                    "has been unwired; declarations are no longer enforced "
+                    "at plan time")
+            break
+    else:
+        violations.append(f"{ov_rel}: check_expr() not found (plan-time "
+                          "expression gate moved? update tools/lint)")
+
+    # check 6: dtype property returning a known singleton must be declared
+    # as an output
+    for path in [os.path.join(core.pkg_dir(root), rel)
+                 for rel in _EXPR_FILES]:
+        rel = os.path.relpath(path, root)
+        for node in ast.walk(core.parse(path)):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            d = _resolve_decl(node.name, bases, decls)
+            if d is None:
+                continue
+            for stmt in node.body:
+                if not (isinstance(stmt, ast.FunctionDef)
+                        and stmt.name == "dtype"):
+                    continue
+                for sub in ast.walk(stmt):
+                    if (isinstance(sub, ast.Return)
+                            and isinstance(sub.value, ast.Attribute)
+                            and isinstance(sub.value.value, ast.Name)
+                            and sub.value.value.id == "T"):
+                        cls = _SINGLETON_CLASS.get(sub.value.attr)
+                        if cls is not None and cls not in d.outputs:
+                            violations.append(
+                                f"{rel}:{sub.lineno}: {node.name}.dtype "
+                                f"returns T.{sub.value.attr} but its "
+                                f"type_support outputs "
+                                f"{sorted(d.outputs)} do not include "
+                                f"'{cls}' — the op constructs a dtype "
+                                f"outside its declaration")
+    return violations
