@@ -950,13 +950,20 @@ def clients_main(budget_s=None, clients=8, faults_spec=None,
              "error": 0}
 
     def client(ci):
+        # tenants/priorities cycle over clients so the per-tenant SLO block
+        # below has multiple keys; the generous deadline populates the
+        # deadline-slack family without ever firing
+        tenant = f"tenant-{ci % 3}"
+        prio = ci % 2
         for i in range(iters):
             if bud.enabled and bud.remaining() < 0.25 * bud.total:
                 return
             qn = names[(ci + i) % len(names)]
             t0 = time.perf_counter()
             try:
-                tk = srv.submit(build(qn), name=f"c{ci}-{qn}#{i}")
+                tk = srv.submit(build(qn), name=f"c{ci}-{qn}#{i}",
+                                tenant=tenant, priority=prio,
+                                deadline_ms=600_000)
             except AdmissionRejected:
                 with walls_lock:
                     stats["shed"] += 1
@@ -1003,11 +1010,18 @@ def clients_main(budget_s=None, clients=8, faults_spec=None,
                                   and stats["completed"] > 0)
         gates["no_unexplained_failures"] = stats["error"] == 0
         gates["pool_balanced"] = get_pool().used == 0
+        # per-tenant SLO percentile block (serve/metrics.py): queue-wait /
+        # semaphore-wait / deadline-slack p50/p95/p99 + outcome counts,
+        # keyed "tenant/priority"
+        from spark_rapids_tpu.serve import metrics as _slo
+        tenant_slos = {f"{t}/p{p}": v
+                       for (t, p), v in sorted(_slo.tenant_slos().items())}
         artifact = {
             "sf": sf, "clients": clients, "iters": iters,
             "queries": names, "faults": faults_spec,
             "wall_ms": pcts, "lane_s": round(lane_s, 3),
             "stats": stats, "counters": counters, "gates": gates,
+            "tenant_slos": tenant_slos,
         }
         out_dir = os.path.dirname(out_path)
         if out_dir:
@@ -1015,6 +1029,7 @@ def clients_main(budget_s=None, clients=8, faults_spec=None,
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=1)
         print(json.dumps({"serve_clients": artifact}))
+        print(json.dumps({"serve_tenant_slos": tenant_slos}))
         print(json.dumps({
             "metric": "serve_clients_wall_p50_ms",
             "value": pcts["p50"],
@@ -1026,6 +1041,7 @@ def clients_main(budget_s=None, clients=8, faults_spec=None,
             "shed_total": stats["shed"],
             "timeout_total": stats["timeout"],
             "clients": clients,
+            "tenants": len(tenant_slos),
             "gates_passed": all(gates.values()) if gates else False,
         }))
     if gates and not all(gates.values()):

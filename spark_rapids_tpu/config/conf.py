@@ -299,9 +299,19 @@ METRICS_JOURNAL_CAPACITY = conf(
 METRICS_HISTOGRAM_ENABLED = conf(
     "spark.rapids.tpu.metrics.histogram.enabled", default=True,
     doc="Record log2-bucketed latency histograms (query wall, per-batch "
-        "opTime, shuffle fetch, retry backoff) exposed as Prometheus "
-        "_bucket/_sum/_count families with p50/p95/p99 in profiles "
-        "(obs/histo.py).")
+        "opTime, shuffle fetch/write, retry backoff, serving SLO waits) "
+        "exposed as Prometheus _bucket/_sum/_count families with "
+        "p50/p95/p99 in profiles (obs/histo.py).")
+
+METRICS_SPANS_ENABLED = conf(
+    "spark.rapids.tpu.metrics.spans.enabled", default=True,
+    doc="Record distributed-tracing spans (obs/span.py): named regions "
+        "carrying trace_id/span_id/parent_id through the serving runtime, "
+        "the cluster ctrl pipe, shuffle fetches/writes, and mesh dispatch, "
+        "so one query's cross-process timeline reassembles into a single "
+        "merged trace. Span events ride the existing trace-capture window "
+        "(profile.traceCapture) and the journal; with capture off the "
+        "per-span cost is one journal append (docs/observability.md).")
 
 MEM_TRACK_ENABLED = conf(
     "spark.rapids.tpu.memory.track.enabled", default=True,
@@ -814,6 +824,22 @@ SERVE_SINGLEFLIGHT = conf(
         "that execution's result instead of running again "
         "(serve/server.py; the cross-query complement of the plan memo "
         "and materialization cache, docs/latency.md).")
+
+SERVE_SLO_ENABLED = conf(
+    "spark.rapids.tpu.serve.slo.enabled", default=True,
+    doc="Per-tenant SLO metrics (serve/metrics.py): queue-wait, semaphore-"
+        "wait, and deadline-slack histograms plus admission-outcome "
+        "counters keyed by (tenant, priority), surfaced in Prometheus "
+        "exposition, explain_analyze, and the bench.py --clients "
+        "per-tenant percentile block (docs/observability.md).")
+
+SERVE_SLO_MAX_TENANTS = conf(
+    "spark.rapids.tpu.serve.slo.maxTenants", default=64,
+    doc="Cardinality bound on the per-tenant SLO registry. Submissions "
+        "from tenants past the cap are folded into the 'overflow' tenant "
+        "so an unbounded tenant-id stream cannot grow label cardinality "
+        "without bound (serve/metrics.py).",
+    check=lambda v: None if v >= 1 else "must be >= 1")
 
 
 _ACTIVE: "Optional[RapidsConf]" = None

@@ -104,6 +104,9 @@ class TaskSemaphore:
             waited = time.perf_counter_ns() - t0
             self.total_wait_ns += waited
         TM.add("semaphore_wait_ns", waited)
+        # per-tenant SLO attribution: no-op outside a serving context
+        from spark_rapids_tpu.serve import metrics as _slo
+        _slo.observe_semaphore_wait(waited)
         return True
 
     def _best_waiter(self):

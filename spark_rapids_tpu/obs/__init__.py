@@ -18,6 +18,8 @@ package is the standalone unification of the repo's fragments:
                     incl. multi-worker merge with per-process tracks
 - ``expose``        Prometheus text exposition of process gauges + histograms
 - ``gauges``        the gauge catalog the above read
+- ``span``          distributed tracing: Span/TraceContext propagated across
+                    the serving runtime, cluster ctrl pipe, and mesh dispatch
 
 See docs/observability.md for the metric catalog and workflows.
 """
@@ -44,4 +46,10 @@ from spark_rapids_tpu.obs.expose import (  # noqa: F401
 from spark_rapids_tpu.obs import events as journal  # noqa: F401
 from spark_rapids_tpu.obs import health  # noqa: F401
 from spark_rapids_tpu.obs import histo  # noqa: F401
+from spark_rapids_tpu.obs import span as tracespan  # noqa: F401
+from spark_rapids_tpu.obs.span import (  # noqa: F401
+    Span,
+    TraceContext,
+    assemble_traces,
+)
 from spark_rapids_tpu.obs.health import REGISTRY as health_registry  # noqa: F401

@@ -40,6 +40,17 @@ CATALOG: "List[Tuple[str, str]]" = [
      "Per-query trace+compile time attributed by the jit first-call timer"),
     ("execute_phase_ns",
      "Per-query execute-window time (wall minus compile attribution)"),
+    ("shuffle_write_ns",
+     "Map-output write time (partition + serialize + spill, the PR-3 "
+     "writeThreads path)"),
+    ("serve_queue_wait_ns",
+     "Serving queue wait: admission to executor pickup (per-tenant "
+     "labeled family rides on this)"),
+    ("serve_semaphore_wait_ns",
+     "Serving task-semaphore wait before execution slots free up"),
+    ("serve_deadline_slack_ns",
+     "Deadline slack at completion (deadline minus finish; 0 when the "
+     "deadline was already blown)"),
 ]
 
 _enabled = True
@@ -149,6 +160,53 @@ def percentiles(name: str) -> Dict[str, float]:
     return get(name).percentiles_ms()
 
 
+# -- labeled families --------------------------------------------------------
+#
+# A labeled family is a declared base histogram plus per-label-set child
+# histograms created on first record (the per-tenant SLO surface:
+# serve_queue_wait_ns{tenant=...,priority=...}). Children share the base
+# name — only declared names grow families — and every labeled record
+# also lands in the base aggregate so unlabeled dashboards keep working.
+# Cardinality is the caller's problem (serve/metrics.py caps tenants).
+
+_family_lock = threading.Lock()
+_FAMILIES: "Dict[str, Dict[Tuple[Tuple[str, str], ...], Histogram]]" = {}
+
+
+def _label_key(labels: Dict[str, str]) -> "Tuple[Tuple[str, str], ...]":
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def record_labeled(name: str, value_ns: int, **labels) -> None:
+    """Record into the base histogram AND its per-label child."""
+    if not _enabled:
+        return
+    base = get(name)  # raises on undeclared names, same as record()
+    base.record(value_ns)
+    if not labels:
+        return
+    key = _label_key(labels)
+    with _family_lock:
+        fam = _FAMILIES.setdefault(name, {})
+        child = fam.get(key)
+        if child is None:
+            child = fam[key] = Histogram(name, base.help)
+    child.record(value_ns)
+
+
+def family(name: str) -> "Dict[Tuple[Tuple[str, str], ...], Histogram]":
+    """Live child histograms of a declared family (label-key -> Histogram)."""
+    get(name)
+    with _family_lock:
+        return dict(_FAMILIES.get(name, {}))
+
+
+def family_snapshot(name: str) -> "Dict[Tuple[Tuple[str, str], ...], Dict]":
+    return {key: h.snapshot() for key, h in family(name).items()}
+
+
 def reset_all() -> None:
     for h in HISTOGRAMS.values():
         h.reset()
+    with _family_lock:
+        _FAMILIES.clear()

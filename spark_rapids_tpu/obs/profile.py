@@ -69,6 +69,8 @@ class QueryProfile:
         self.task_metrics: Dict[str, int] = {}
         self.memory: Dict = {}
         self.events: List[Dict] = []
+        self.tenant: Optional[str] = None  # serving attribution, set at
+        self.priority = 0                  # finish() from the QueryContext
         self._t0 = 0
         self._gauges0: Dict[str, int] = {}
         self._tasks0: Dict[str, int] = {}
@@ -147,6 +149,24 @@ class QueryProfile:
             _histo.record("compile_phase_ns", compile_ns)
             _histo.record("execute_phase_ns",
                           max(0, self.wall_ns - compile_ns))
+            # phase spans: when this query runs under a trace (serving or
+            # cluster), plan/compile attribution joins the distributed
+            # timeline. Starts are synthetic-sequential inside the wall
+            # window — attribution, not wall truth.
+            from spark_rapids_tpu.obs import span as _span
+            if _span.current() is not None:
+                plan_ns = int(plan_ms * 1e6)
+                _span.record_span("query:plan", self._t0, plan_ns,
+                                  attrs={"profile": self.query_id})
+                _span.record_span("query:compile", self._t0 + plan_ns,
+                                  compile_ns,
+                                  attrs={"profile": self.query_id})
+            # serving attribution for the explain_analyze tenant-slo line
+            from spark_rapids_tpu.serve import context as _qc
+            qc = _qc.current()
+            if qc is not None:
+                self.tenant = qc.tenant or "default"
+                self.priority = qc.priority
             _events.emit("finish", query_id=self.query_id,
                          wall_ms=_ns_ms(self.wall_ns),
                          compile_ms=self.phases["compile"])
@@ -198,6 +218,23 @@ class QueryProfile:
                 if audit.get("retained_bytes"):
                     mem_cells.append(f"retained={audit['retained_bytes']}B")
             lines.append(f"memory: {' '.join(mem_cells)}")
+        if self.tenant is not None:
+            # per-tenant SLO tails for the tenant this query ran under
+            from spark_rapids_tpu.serve import metrics as _sm
+            slo = _sm.tenant_slos().get((self.tenant, self.priority))
+            if slo:
+                cells = []
+                for field in ("queue_wait_ms", "semaphore_wait_ms",
+                              "deadline_slack_ms"):
+                    pc = slo.get(field)
+                    if pc:
+                        cells.append(
+                            f"{field.removesuffix('_ms')}="
+                            f"{pc['p50']}/{pc['p95']}/{pc['p99']}ms")
+                for outcome, n in sorted(slo.get("outcomes", {}).items()):
+                    cells.append(f"{outcome}={n}")
+                lines.append(f"tenant-slo[{self.tenant}/p{self.priority}] "
+                             f"(p50/p95/p99): {' '.join(cells)}")
         mem_ops = self.memory.get("ops", {})
         for node in self.nodes:
             pad = "  " * node["depth"]

@@ -1,0 +1,284 @@
+"""Distributed query tracing: spans + a serializable TraceContext.
+
+The reference plugin attributes time with NVTX ranges and a
+driver-coordinated profiler; both stop at the process boundary. This
+module is the standalone analog for the serving + mesh/cluster path: a
+``Span`` names one timed region, carries ``trace_id``/``span_id``/
+``parent_id``, and records into the *existing* observability machinery —
+``utils/tracing.record_event`` (so spans land in per-process Chrome
+traces and survive the multi-worker merge in obs/trace_export.py) and
+the bounded lifecycle journal (obs/events.py) — rather than inventing a
+third event stream.
+
+Cross-process propagation uses ``TraceContext``: a two-field value
+(``trace_id``, ``span_id`` of the would-be parent) whose ``to_wire()``
+tuple rides the cluster ctrl pipe (shuffle/cluster.py), is installed on
+executor threads via ``activate()``, and parents every span a worker
+records — cluster map/reduce tasks, shuffle block fetches, mesh
+dispatches. ``assemble()`` reverses the trip: given per-process event
+lists (e.g. from ``TcpShuffleCluster.collect_traces``) it regroups span
+events by trace_id so one query's submit→admit→queue-wait→plan→compile→
+shuffle-fetch→execute timeline reads as a single tree even though its
+spans were recorded in three processes.
+
+Span *names* are a declared catalog (``CATALOG`` below), mirroring
+obs/gauges.CATALOG: opening a span with an undeclared name raises, and
+tools/lint/span_catalog.py flags undeclared string constants statically
+so the default lane catches them without running the code. Dynamic
+detail (shuffle id, node type, tenant) goes in ``attrs``, never in the
+name.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+# name -> help; the closed set of span names. Parsed statically by
+# tools/lint/span_catalog.py (keep this a literal list of 2-tuples).
+# Dynamic identifiers (shuffle id, query name, node type) belong in
+# attrs so traces aggregate by phase, not by instance.
+CATALOG: "List[Tuple[str, str]]" = [
+    ("query:submit", "QueryServer.submit window (validate + admit + enqueue)"),
+    ("query:admit", "Admission-control decision inside submit"),
+    ("query:queue-wait", "Admitted-to-scheduled wait on the priority queue"),
+    ("query:plan", "Planning phase attributed by QueryProfile"),
+    ("query:compile", "Trace+compile phase attributed by the jit timer"),
+    ("query:execute", "Execute window on the serving executor thread"),
+    ("cluster:map", "Map task executed by a cluster executor process"),
+    ("cluster:reduce", "Reduce task executed by a cluster executor process"),
+    ("shuffle:fetch", "One shuffle block fetch round-trip (client side)"),
+    ("shuffle:write", "Map-output partition/serialize/spill on the write path"),
+    ("mesh:dispatch", "One SPMD dispatch by the mesh executor"),
+]
+
+_NAMES = frozenset(name for name, _ in CATALOG)
+
+_enabled = True
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+class TraceContext:
+    """Serializable (trace_id, parent span_id) pair — the propagation unit.
+
+    ``to_wire()``/``from_wire()`` round-trip through the cluster ctrl
+    pipe as a plain tuple so the pickled payload stays version-tolerant.
+    """
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_wire(self) -> Tuple[str, str]:
+        return (self.trace_id, self.span_id)
+
+    @classmethod
+    def from_wire(cls, wire) -> "Optional[TraceContext]":
+        if wire is None:
+            return None
+        trace_id, span_id = wire
+        return cls(trace_id, span_id)
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.trace_id}, {self.span_id})"
+
+
+def new_trace() -> TraceContext:
+    """Fresh root context: trace_id plus a synthetic root span id."""
+    return TraceContext(_new_id(), _new_id())
+
+
+_TLS = threading.local()
+
+
+def current() -> Optional[TraceContext]:
+    """The TraceContext installed on this thread, or None."""
+    return getattr(_TLS, "ctx", None)
+
+
+@contextmanager
+def activate(ctx: Optional[TraceContext]):
+    """Install ``ctx`` as this thread's current trace context."""
+    prev = getattr(_TLS, "ctx", None)
+    _TLS.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _TLS.ctx = prev
+
+
+class Span:
+    """One timed, named region of a trace.
+
+    ``finish()`` records the span as a Chrome-trace event (name = span
+    name, args carry the ids + attrs) and a journal ``span`` event, then
+    becomes inert. Parentage comes from the explicit ``ctx`` or the
+    thread's current context; with neither, the span starts a new trace.
+    """
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "attrs",
+                 "start_ns", "_finished")
+
+    def __init__(self, name: str, ctx: Optional[TraceContext] = None,
+                 attrs: Optional[Dict] = None):
+        if name not in _NAMES:
+            raise KeyError(f"span name {name!r} is not declared in "
+                           "obs/span.CATALOG")
+        ctx = ctx if ctx is not None else current()
+        if ctx is None:
+            ctx = new_trace()
+            self.parent_id = None
+        else:
+            self.parent_id = ctx.span_id
+        self.trace_id = ctx.trace_id
+        self.span_id = _new_id()
+        self.name = name
+        self.attrs = dict(attrs) if attrs else {}
+        self.start_ns = time.perf_counter_ns()
+        self._finished = False
+
+    def context(self) -> TraceContext:
+        """Child context: propagate this to parent sub-spans on me."""
+        return TraceContext(self.trace_id, self.span_id)
+
+    def finish(self, end_ns: Optional[int] = None) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        end = end_ns if end_ns is not None else time.perf_counter_ns()
+        _record(self.name, self.start_ns, max(0, end - self.start_ns),
+                self.trace_id, self.span_id, self.parent_id, self.attrs)
+
+
+def _record(name: str, start_ns: int, dur_ns: int, trace_id: str,
+            span_id: str, parent_id: Optional[str], attrs: Dict) -> None:
+    from spark_rapids_tpu.obs import events as journal
+    from spark_rapids_tpu.utils import tracing
+
+    args = {"trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent_id}
+    if attrs:
+        args.update(attrs)
+    tracing.record_event(name, start_ns, dur_ns, args=args)
+    journal.emit("span", name=name, trace_id=trace_id, span_id=span_id,
+                 parent_id=parent_id, dur_ms=round(dur_ns / 1e6, 3))
+
+
+def record_span(name: str, start_ns: int, dur_ns: int,
+                ctx: Optional[TraceContext] = None,
+                attrs: Optional[Dict] = None) -> Optional[str]:
+    """Record an already-timed region as a completed span.
+
+    For sites that measured a window themselves (shuffle fetch retry
+    loop, profile phase attribution) and only need the span stamped.
+    Returns the new span_id, or None when tracing is disabled / no
+    context is active and ``ctx`` was not given.
+    """
+    if not _enabled:
+        return None
+    if name not in _NAMES:
+        raise KeyError(f"span name {name!r} is not declared in "
+                       "obs/span.CATALOG")
+    ctx = ctx if ctx is not None else current()
+    if ctx is None:
+        return None
+    span_id = _new_id()
+    _record(name, start_ns, max(0, int(dur_ns)), ctx.trace_id, span_id,
+            ctx.span_id, dict(attrs) if attrs else {})
+    return span_id
+
+
+@contextmanager
+def span(name: str, ctx: Optional[TraceContext] = None,
+         attrs: Optional[Dict] = None):
+    """Open a span, install its child context on this thread, finish it
+    on exit. The workhorse API:
+
+        with span("query:execute", attrs={"tenant": t}) as sp:
+            ...                      # sub-spans parent on sp.context()
+    """
+    if not _enabled:
+        yield None
+        return
+    s = Span(name, ctx=ctx, attrs=attrs)
+    prev = getattr(_TLS, "ctx", None)
+    _TLS.ctx = s.context()
+    try:
+        yield s
+    finally:
+        _TLS.ctx = prev
+        s.finish()
+
+
+@contextmanager
+def task_span(name: str, ctx: Optional[TraceContext] = None,
+              attrs: Optional[Dict] = None):
+    """Like ``span()`` but a no-op when no trace context is active or
+    supplied — for worker-side sites (cluster tasks, shuffle, mesh) that
+    should only record when a trace was actually propagated to them,
+    instead of fabricating orphan single-span traces."""
+    ctx = ctx if ctx is not None else current()
+    if not _enabled or ctx is None:
+        yield None
+        return
+    with span(name, ctx=ctx, attrs=attrs) as s:
+        yield s
+
+
+# -- trace reassembly --------------------------------------------------------
+
+def span_events(events: List[Dict]) -> List[Dict]:
+    """Filter a raw tracing.trace_events() list down to span events."""
+    out = []
+    for e in events:
+        args = e.get("args") or {}
+        if "trace_id" in args and "span_id" in args:
+            out.append(e)
+    return out
+
+
+def assemble_traces(per_process: Dict[str, List[Dict]]) -> Dict[str, List[Dict]]:
+    """Regroup per-process event lists into per-trace span timelines.
+
+    ``per_process`` maps a process label (e.g. "driver", "worker-0") to
+    its raw trace-event list — the same shape
+    ``TcpShuffleCluster.collect_traces`` / ``tracing.trace_events``
+    produce. Returns ``{trace_id: [span dicts sorted by start_ns]}``
+    where each span dict carries name/span_id/parent_id/process/
+    start_ns/dur_ns/attrs. A query's distributed timeline is one entry.
+    """
+    traces: Dict[str, List[Dict]] = {}
+    for process, events in per_process.items():
+        for e in span_events(events):
+            args = dict(e.get("args") or {})
+            trace_id = args.pop("trace_id")
+            rec = {
+                "name": e.get("name"),
+                "span_id": args.pop("span_id"),
+                "parent_id": args.pop("parent_id", None),
+                "process": process,
+                "start_ns": e["start_ns"] if "start_ns" in e else 0,
+                "dur_ns": e["dur_ns"] if "dur_ns" in e else 0,
+                "attrs": args,
+            }
+            traces.setdefault(trace_id, []).append(rec)
+    for spans in traces.values():
+        spans.sort(key=lambda s: s["start_ns"])
+    return traces

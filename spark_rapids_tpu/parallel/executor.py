@@ -304,10 +304,17 @@ class MeshExecutor:
         outs = jax.jit(fn)(tuple(flat_sharded), flat_repl)
         outs = [np.asarray(o) for o in jax.device_get(outs)]
         from spark_rapids_tpu.utils import tracing as _tracing
+        _dur = _time.perf_counter_ns() - _t0
         _tracing.record_event(
-            f"mesh:dispatch:{type(root).__name__}", _t0,
-            _time.perf_counter_ns() - _t0,
+            f"mesh:dispatch:{type(root).__name__}", _t0, _dur,
             args={"worker": self.worker_label, "devices": self.n_dev})
+        from spark_rapids_tpu.obs import span as _span
+        # joins the submitting query's trace when one is active (the
+        # serving executor thread activates QueryContext.trace)
+        _span.record_span("mesh:dispatch", _t0, _dur,
+                          attrs={"node": type(root).__name__,
+                                 "worker": self.worker_label,
+                                 "devices": self.n_dev})
 
         # unpack: per-column global arrays, per-device row counts, overflows
         tmpl = low.template

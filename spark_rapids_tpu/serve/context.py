@@ -51,15 +51,20 @@ class QueryContext:
     ``deadline_ms``: wall budget from construction; past it every poll
     point raises QueryDeadlineExceeded. ``memory_budget``: cap in bytes on
     the query's live attributed pool bytes, enforced by mem/pool.py while
-    the query runs (0 = uncapped).
+    the query runs (0 = uncapped). ``tenant``: SLO attribution key for
+    serve/metrics.py (None folds into the "default" tenant). ``trace``:
+    the query's obs/span.TraceContext, stamped at submit so every span
+    the executor thread (and downstream workers) records joins one trace.
     """
 
     def __init__(self, name: Optional[str] = None, priority: int = 0,
                  deadline_ms: Optional[float] = None,
-                 memory_budget: int = 0):
+                 memory_budget: int = 0, tenant: Optional[str] = None):
         self.ctx_id = next(_next_ctx_id)
         self.name = name or f"query-{self.ctx_id}"
         self.priority = int(priority)
+        self.tenant = tenant
+        self.trace = None  # Optional[obs.span.TraceContext]
         self.memory_budget = int(memory_budget or 0)
         self.submitted_at = time.monotonic()
         self.deadline = (self.submitted_at + float(deadline_ms) / 1e3

@@ -131,6 +131,12 @@ class QueryServer:
         self._default_budget = int(C.SERVE_DEFAULT_BUDGET.get(self.conf))
         self._default_deadline = float(
             C.SERVE_DEFAULT_DEADLINE_MS.get(self.conf))
+        # process-wide observability knobs: last server constructed wins,
+        # which matches how gauges/journal toggles behave already
+        _m.configure_slo(C.SERVE_SLO_ENABLED.get(self.conf),
+                         C.SERVE_SLO_MAX_TENANTS.get(self.conf))
+        from spark_rapids_tpu.obs import span as _span
+        _span.set_enabled(C.METRICS_SPANS_ENABLED.get(self.conf))
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pq: List[Tuple[int, int, Ticket]] = []  # (-prio, seq, ticket)
@@ -156,17 +162,24 @@ class QueryServer:
     def submit(self, df, priority: int = 0,
                deadline_ms: Optional[float] = None,
                memory_budget: Optional[int] = None,
-               name: Optional[str] = None) -> Ticket:
+               name: Optional[str] = None,
+               tenant: Optional[str] = None) -> Ticket:
         """Admit one query; returns its Ticket or raises AdmissionRejected.
-        Defaults for deadline/budget come from the serve.* conf knobs."""
+        Defaults for deadline/budget come from the serve.* conf knobs.
+        ``tenant`` keys the per-tenant SLO histograms/outcome counters
+        (None folds into the "default" tenant)."""
         from spark_rapids_tpu import faults
         from spark_rapids_tpu.obs import events as _ev
+        from spark_rapids_tpu.obs import span as _span
 
+        submit_t0 = time.perf_counter_ns()
+        trace = _span.new_trace()
         _m.bump("admission_submitted_total")
         try:
             faults.check("serve.admit", op=name or "query")
         except Exception as e:  # injected: shed typed, never corrupt
             _m.bump("admission_rejected_total")
+            _m.note_outcome(tenant, priority, "rejected:fault-injected")
             raise AdmissionRejected(
                 "fault-injected", f"injected admission fault: {e}") from e
         if deadline_ms is None and self._default_deadline > 0:
@@ -175,31 +188,48 @@ class QueryServer:
             memory_budget = self._default_budget
         ctx = QueryContext(name=name, priority=priority,
                            deadline_ms=deadline_ms,
-                           memory_budget=memory_budget)
+                           memory_budget=memory_budget, tenant=tenant)
+        ctx.trace = trace
         with self._lock:
             if self._stopping:
                 _m.bump("admission_rejected_total")
+                _m.note_outcome(tenant, priority, "rejected:shutdown")
                 raise AdmissionRejected("shutdown", "server is shutting down")
             key = self._plan_fingerprint(df) if self._singleflight else None
             if key is not None:
                 primary = self._inflight.get(key)
                 if primary is not None and not primary.done():
                     _m.bump("sched_singleflight_hit_total")
+                    _m.note_outcome(tenant, priority, "deduped")
                     _ev.emit("serve-singleflight", query_id=ctx.ctx_id,
                              primary=primary.ctx.ctx_id)
                     ctx.state = "deduped"
                     return _FollowerTicket(primary, ctx)
             # admission gates raise AdmissionRejected (counted inside)
-            self.admission.admit(ctx)
+            admit_t0 = time.perf_counter_ns()
+            try:
+                self.admission.admit(ctx)
+            except AdmissionRejected as e:
+                _m.note_outcome(tenant, priority, f"rejected:{e.reason}")
+                raise
+            _span.record_span("query:admit", admit_t0,
+                              time.perf_counter_ns() - admit_t0, ctx=trace,
+                              attrs={"query": ctx.name})
             ticket = Ticket(df, ctx, key)
             if key is not None:
                 self._inflight[key] = ticket
             ctx.state = "queued"
             heapq.heappush(self._pq, (-ctx.priority, next(_seq), ticket))
             self._cv.notify()
+        _m.note_outcome(tenant, priority, "admitted")
+        _span.record_span("query:submit", submit_t0,
+                          time.perf_counter_ns() - submit_t0, ctx=trace,
+                          attrs={"query": ctx.name,
+                                 "tenant": tenant or _m.DEFAULT_TENANT,
+                                 "priority": priority})
         _ev.emit("serve-admit", query_id=ctx.ctx_id, name=ctx.name,
                  priority=ctx.priority, budget=ctx.memory_budget,
-                 deadline_ms=deadline_ms)
+                 deadline_ms=deadline_ms, tenant=tenant)
         return ticket
 
     # -- executors ---------------------------------------------------------
@@ -218,29 +248,46 @@ class QueryServer:
 
     def _execute(self, ticket: Ticket) -> None:
         from spark_rapids_tpu.obs import events as _ev
+        from spark_rapids_tpu.obs import span as _span
         ctx = ticket.ctx
-        _m.bump("sched_queue_wait_ns_total",
-                time.perf_counter_ns() - ticket.enqueued_ns)
+        wait_ns = time.perf_counter_ns() - ticket.enqueued_ns
+        _m.bump("sched_queue_wait_ns_total", wait_ns)
+        _m.observe_queue_wait(ctx.tenant, ctx.priority, wait_ns)
+        _span.record_span("query:queue-wait", ticket.enqueued_ns, wait_ns,
+                          ctx=ctx.trace, attrs={"query": ctx.name})
         _m.bump("sched_active_queries")
         ctx.state = "running"
         try:
             ctx.check()  # cancelled/deadlined while queued: never start
-            with _ctx.activate(ctx):
-                out = ticket.df.to_arrow()
+            with _ctx.activate(ctx), _span.activate(ctx.trace):
+                with _span.span("query:execute",
+                                attrs={"query": ctx.name,
+                                       "tenant": ctx.tenant
+                                       or _m.DEFAULT_TENANT}):
+                    out = ticket.df.to_arrow()
             ctx.state = "completed"
             _m.bump("sched_completed_total")
+            _m.note_outcome(ctx.tenant, ctx.priority, "completed")
+            slack_ms = ctx.remaining_ms()
+            if slack_ms is not None:
+                _m.observe_deadline_slack(ctx.tenant, ctx.priority,
+                                          int(slack_ms * 1e6))
             ticket._fulfill(out)
         except QueryDeadlineExceeded as e:
             ctx.state = "deadline"
             _m.bump("sched_deadline_exceeded_total")
+            _m.note_outcome(ctx.tenant, ctx.priority, "deadline")
+            _m.observe_deadline_slack(ctx.tenant, ctx.priority, 0)
             ticket._fail(e)
         except QueryCancelled as e:
             ctx.state = "cancelled"
             _m.bump("sched_cancelled_total")
+            _m.note_outcome(ctx.tenant, ctx.priority, "cancelled")
             ticket._fail(e)
         except BaseException as e:  # noqa: BLE001 — must reach the caller
             ctx.state = "failed"
             _m.bump("sched_failed_total")
+            _m.note_outcome(ctx.tenant, ctx.priority, "failed")
             ticket._fail(e)
         finally:
             _m.bump("sched_active_queries", -1)

@@ -151,6 +151,7 @@ def _worker_main(worker_id: str, ctrl) -> None:
     from spark_rapids_tpu.shuffle.serializer import merge_to_batch
     from spark_rapids_tpu.shuffle.transport import (ShuffleServer, TcpServer,
                                                     connect_tcp)
+    from spark_rapids_tpu.obs import span as _span
     from spark_rapids_tpu.utils import tracing as _tracing
 
     # every trace event this process records carries its executor identity,
@@ -219,7 +220,11 @@ def _worker_main(worker_id: str, ctrl) -> None:
                 break
             try:
                 if kind == "map":
-                    _, task_id, payload, shuffle_id, parts = msg
+                    _, task_id, payload, shuffle_id, parts = msg[:5]
+                    # trace wire rides as a trailing field: older peers
+                    # without it simply run untraced
+                    tctx = _span.TraceContext.from_wire(
+                        msg[5] if len(msg) > 5 else None)
                     _, exchange = _find_agg_exchange(plan_for(payload))
                     faults.check("executor", id=wid_num, task="map")
                     child = exchange.children[0]
@@ -229,12 +234,16 @@ def _worker_main(worker_id: str, ctrl) -> None:
                             exchange.partitioner.num_partitions)
                     reg = regs[shuffle_id]
                     _t0 = _time.perf_counter_ns()
-                    for p in parts:
-                        batches = list(child.execute(p))
-                        local_idx = manager.num_map_outputs(reg)
-                        manager.write_map_output(reg, exchange.partitioner,
-                                                 batches)
-                        maps[(shuffle_id, p)] = (reg, local_idx)
+                    with _span.activate(tctx), _span.task_span(
+                            "cluster:map",
+                            attrs={"task": task_id, "shuffle": shuffle_id,
+                                   "partitions": list(parts)}):
+                        for p in parts:
+                            batches = list(child.execute(p))
+                            local_idx = manager.num_map_outputs(reg)
+                            manager.write_map_output(
+                                reg, exchange.partitioner, batches)
+                            maps[(shuffle_id, p)] = (reg, local_idx)
                     _tracing.record_event(
                         f"task:map:{shuffle_id}", _t0,
                         _time.perf_counter_ns() - _t0,
@@ -242,42 +251,55 @@ def _worker_main(worker_id: str, ctrl) -> None:
                     ctrl.send(("map_done", task_id, worker_id, parts))
                 elif kind == "reduce":
                     (_, task_id, payload, shuffle_id, reduce_id,
-                     sources) = msg
+                     sources) = msg[:6]
+                    tctx = _span.TraceContext.from_wire(
+                        msg[6] if len(msg) > 6 else None)
                     final_agg, exchange = _find_agg_exchange(
                         plan_for(payload))
                     faults.check("executor", id=wid_num, task="reduce")
                     schema = exchange.children[0].output_schema
                     _t0 = _time.perf_counter_ns()
-                    blocks: List[bytes] = []
-                    for host, port, mids in sources:
-                        if not mids:
-                            continue
-                        cli = client_for(host, port)
-                        blocks.extend(_fetch_checked(
-                            cli,
-                            [BlockId(shuffle_id, m, reduce_id)
-                             for m in mids],
-                            manager.integrity, host, port, mids))
-                    batch = merge_to_batch(blocks, schema, min_bucket=16)
+                    with _span.activate(tctx), _span.task_span(
+                            "cluster:reduce",
+                            attrs={"task": task_id, "shuffle": shuffle_id,
+                                   "reduce": reduce_id}):
+                        blocks: List[bytes] = []
+                        for host, port, mids in sources:
+                            if not mids:
+                                continue
+                            cli = client_for(host, port)
+                            blocks.extend(_fetch_checked(
+                                cli,
+                                [BlockId(shuffle_id, m, reduce_id)
+                                 for m in mids],
+                                manager.integrity, host, port, mids))
+                        batch = merge_to_batch(blocks, schema,
+                                               min_bucket=16)
+                        if batch is None:
+                            tbl = None
+                        else:
+                            from spark_rapids_tpu.exec.base import (
+                                BatchSourceExec)
+                            from spark_rapids_tpu.columnar.batch import (
+                                batch_to_arrow)
+
+                            src = BatchSourceExec([[batch]], schema)
+                            saved = final_agg.children[0]
+                            final_agg.children[0] = src
+                            try:
+                                out = list(final_agg.execute(0))
+                            finally:
+                                # the plan is cached across tasks: a raising
+                                # execute must not leave the spliced source
+                                # in place or later tasks silently aggregate
+                                # this task's stale batch
+                                final_agg.children[0] = saved
+                            tbl = (pa.concat_tables(
+                                [batch_to_arrow(b, final_agg.output_schema)
+                                 for b in out]) if out else None)
                     if batch is None:
                         ctrl.send(("reduce_done", task_id, reduce_id, None))
                         continue
-                    from spark_rapids_tpu.exec.base import BatchSourceExec
-                    from spark_rapids_tpu.columnar.batch import batch_to_arrow
-
-                    src = BatchSourceExec([[batch]], schema)
-                    saved = final_agg.children[0]
-                    final_agg.children[0] = src
-                    try:
-                        out = list(final_agg.execute(0))
-                    finally:
-                        # the plan is cached across tasks: a raising execute
-                        # must not leave the spliced source in place or later
-                        # tasks silently aggregate this task's stale batch
-                        final_agg.children[0] = saved
-                    tbl = (pa.concat_tables(
-                        [batch_to_arrow(b, final_agg.output_schema)
-                         for b in out]) if out else None)
                     sink = pa.BufferOutputStream()
                     if tbl is not None:
                         with pa.ipc.new_stream(sink, tbl.schema) as w:
@@ -429,7 +451,10 @@ class TcpShuffleCluster:
         from an executor serving corrupt blocks (soft: ignored when it
         would leave no candidates)."""
         from spark_rapids_tpu.config import conf as _C
+        from spark_rapids_tpu.obs import span as _span
 
+        tctx = _span.current()
+        wire = tctx.to_wire() if tctx is not None else None
         retries = _C.CLUSTER_TASK_RETRIES.get(_C.get_active())
         todo = set(parts_todo)
         attempts = 0
@@ -450,7 +475,8 @@ class TcpShuffleCluster:
             for wid, parts in assignment.items():
                 tid = self._task_id()
                 try:
-                    self._pipes[wid].send(("map", tid, payload, sid, parts))
+                    self._pipes[wid].send(
+                        ("map", tid, payload, sid, parts, wire))
                 except (BrokenPipeError, OSError):
                     self._on_dead(wid)
                     continue  # parts stay in todo for the next round
@@ -482,10 +508,26 @@ class TcpShuffleCluster:
         Executor death at ANY point is recovered: dead workers' map blocks
         are recomputed on survivors (lineage) and their reduce tasks are
         rescheduled, up to spark.rapids.tpu.cluster.task.maxRetries."""
+        from spark_rapids_tpu.obs import span as _span
+
+        # one trace per distributed query: join the caller's (serving)
+        # trace when a context is active on this thread, else open a new
+        # root. Every map/reduce send below carries the wire form, so the
+        # spans workers record reassemble under this single trace_id.
+        tctx = _span.current()
+        if tctx is None and _span.enabled():
+            tctx = _span.new_trace()
+        with _span.activate(tctx):
+            return self._run_query_traced(df)
+
+    def _run_query_traced(self, df) -> pa.Table:
         from spark_rapids_tpu.columnar.batch import batch_from_arrow
         from spark_rapids_tpu.config import conf as _C
         from spark_rapids_tpu.exec.base import BatchSourceExec
+        from spark_rapids_tpu.obs import span as _span
 
+        tctx = _span.current()
+        wire = tctx.to_wire() if tctx is not None else None
         conf_items = dict(df.conf._values) if df.conf is not None else {}
         payload = pickle.dumps((df.plan, conf_items, df.shuffle_partitions))
         with self._lock:
@@ -529,7 +571,7 @@ class TcpShuffleCluster:
                 tid = self._task_id()
                 try:
                     self._pipes[wid].send(
-                        ("reduce", tid, payload, sid, r, sources))
+                        ("reduce", tid, payload, sid, r, sources, wire))
                 except (BrokenPipeError, OSError):
                     self._on_dead(wid)
                     continue

@@ -90,6 +90,8 @@ class ShuffleManager:
                          batches: List[ColumnarBatch]) -> None:
         """One map task: partition every batch on device, serialize slices in
         the writer pool, write one data file (or cache blocks in memory)."""
+        import time as _time
+        _t0 = _time.perf_counter_ns()
         per_part: Dict[int, List[pa.Table]] = {}
         for b in batches:
             for pid, tbl in partitioner.split(b, reg.schema):
@@ -125,6 +127,13 @@ class ShuffleManager:
         self.blocks_written += len(blocks)
         with reg.lock:
             reg.map_outputs.append(out)
+        from spark_rapids_tpu.obs import histo as _histo
+        from spark_rapids_tpu.obs import span as _span
+        dur_ns = _time.perf_counter_ns() - _t0
+        _histo.record("shuffle_write_ns", dur_ns)
+        _span.record_span("shuffle:write", _t0, dur_ns,
+                          attrs={"shuffle": reg.shuffle_id,
+                                 "blocks": len(blocks)})
 
     # -- stats (AQE) -------------------------------------------------------
     def num_map_outputs(self, reg: ShuffleRegistration) -> int:

@@ -308,8 +308,14 @@ class ShuffleClient:
             try:
                 result = self._fetch_once(blocks, max(budget, 0.001))
                 from spark_rapids_tpu.obs import histo as _histo
-                _histo.record("shuffle_fetch_ns",
-                              time.perf_counter_ns() - t0)
+                from spark_rapids_tpu.obs import span as _span
+                dur_ns = time.perf_counter_ns() - t0
+                _histo.record("shuffle_fetch_ns", dur_ns)
+                # stamped on the propagated trace (cluster:reduce parent);
+                # no-op when no trace context reached this thread
+                _span.record_span("shuffle:fetch", t0, dur_ns,
+                                  attrs={"blocks": len(blocks),
+                                         "attempt": attempt})
                 if attempt > 1:
                     faults.note_recovered("shuffle.fetch")
                 return result

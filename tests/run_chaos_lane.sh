@@ -12,6 +12,16 @@
 # SRTPU_FAULTS_SEED pins the schedule so failures reproduce exactly.
 set -e
 cd "$(dirname "$0")/.."
+rc=0
 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}" \
-    exec python -m pytest tests/test_faults.py tests/test_reuse.py \
-    tests/test_serve.py -q "$@"
+    python -m pytest tests/test_faults.py tests/test_reuse.py \
+    tests/test_serve.py -q "$@" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    # keep the evidence: dump the journal/metrics/trace state the failing
+    # run left behind as a diagnostics bundle (tools/obs_report.py)
+    OBS_FAIL_OUT="${TMPDIR:-/tmp}/srtpu_chaos_failure_report"
+    echo "chaos lane failed (rc=$rc): dumping diagnostics bundle to" \
+         "$OBS_FAIL_OUT" >&2
+    python tools/obs_report.py --out "$OBS_FAIL_OUT" >&2 || true
+fi
+exit $rc

@@ -9,11 +9,30 @@
 set -e
 cd "$(dirname "$0")/.."
 
+# On gate failure, dump a tools/obs_report.py diagnostics bundle instead of
+# discarding whatever journal/metrics/trace state the failing step built up.
+on_exit() {
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        OBS_FAIL_OUT="${TMPDIR:-/tmp}/srtpu_slow_lane_failure_report"
+        echo "slow lane failed (rc=$rc): dumping diagnostics bundle to" \
+             "$OBS_FAIL_OUT" >&2
+        python tools/obs_report.py --out "$OBS_FAIL_OUT" >&2 || true
+    fi
+}
+trap on_exit EXIT
+
 # Unified static analysis first: cheapest signal, one exit code across all
-# passes (type-support matrix, jit-purity, conf-key drift, gauge/cache-key
-# guards, generated-doc drift). Also runs in the default lane via
-# tests/test_lint.py; here it fails the lane before any slow test spins up.
+# passes (type-support matrix, jit-purity, conf-key drift, gauge/cache-key/
+# span-catalog guards, generated-doc drift). Also runs in the default lane
+# via tests/test_lint.py; here it fails the lane before any slow test spins
+# up.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/static_check.py
+
+# Perf-trajectory sentinel: every checked-in BENCH_r*/MULTICHIP_r* round is
+# gated against the best prior round for the same metric (schema drift and
+# degraded rc!=0 / parsed-null rounds tolerated; tools/bench_diff.py).
+python tools/bench_diff.py --dir .
 
 SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}" \
     python -m pytest \

@@ -308,3 +308,96 @@ def test_cluster_corrupt_block_refetch_then_recompute(rng):
     with TcpShuffleCluster(n_workers=2) as c:
         out = c.run_query(q)
         assert _canon(_rows(out)) == local
+
+
+def test_cluster_trace_context_propagates(cluster, rng):
+    """The tentpole acceptance: one query run under an activated
+    TraceContext produces ONE merged trace whose cluster:map/cluster:reduce
+    spans were recorded by >= 2 distinct worker processes, all parented on
+    the driver's root span — and the merged Chrome trace still validates
+    in the trace-viewer checker."""
+    from spark_rapids_tpu.obs import span as _span
+    from spark_rapids_tpu.obs import trace_export as _te
+    from spark_rapids_tpu.utils import tracing
+    from tools.trace_viewer_check import validate_trace
+
+    trace_conf = RapidsConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.profile.traceCapture": True,
+    })
+    n = 3000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 29, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 100, n), pa.int64()),
+    })
+    df = from_arrow(t, trace_conf, batch_rows=512, partitions=4)
+    df.shuffle_partitions = 4
+    q = df.group_by("k").agg(E.Sum(col("v")).alias("s"))
+    tracing.set_capture(True, clear=True)
+    tctx = _span.new_trace()
+    try:
+        with _span.activate(tctx):
+            cluster.run_query(q)
+        per_process = cluster.collect_traces()
+    finally:
+        tracing.set_capture(False)
+        tracing.trace_events(clear=True)
+
+    traces = _span.assemble_traces(per_process)
+    assert tctx.trace_id in traces, sorted(traces)
+    spans = traces[tctx.trace_id]
+    names = {s["name"] for s in spans}
+    assert "cluster:map" in names and "cluster:reduce" in names
+    # the ONE trace holds spans recorded by >= 2 distinct worker processes
+    worker_procs = {s["process"] for s in spans if s["process"] != "driver"}
+    assert len(worker_procs) >= 2, worker_procs
+    # every task span parents on the driver's root span id — the wire
+    # context, not a fabricated per-worker trace
+    for s in spans:
+        if s["name"] in ("cluster:map", "cluster:reduce"):
+            assert s["parent_id"] == tctx.span_id, s
+    # sub-spans recorded inside a task (shuffle:write under cluster:map)
+    # parent on the task span, one level down
+    by_id = {s["span_id"]: s for s in spans}
+    writes = [s for s in spans if s["name"] == "shuffle:write"]
+    assert writes, names
+    for s in writes:
+        assert by_id[s["parent_id"]]["name"] == "cluster:map", s
+    # the merged multi-process Chrome trace still validates for viewers
+    merged = _te.merge_process_traces(per_process)
+    assert validate_trace(merged) == []
+    traced = [e for e in merged["traceEvents"]
+              if e.get("ph") == "X"
+              and (e.get("args") or {}).get("trace_id") == tctx.trace_id]
+    assert {e["pid"] for e in traced} >= {
+        e["pid"] for e in merged["traceEvents"]
+        if e.get("ph") == "X" and e["name"] == "cluster:map"}
+
+
+def test_cluster_untraced_query_records_no_task_spans(cluster, rng):
+    """Without an activated context the workers must not fabricate orphan
+    single-span traces: task_span() is a no-op when nothing propagated."""
+    from spark_rapids_tpu.obs import span as _span
+    from spark_rapids_tpu.utils import tracing
+
+    trace_conf = RapidsConf({
+        "spark.rapids.tpu.sql.enabled": True,
+        "spark.rapids.tpu.profile.traceCapture": True,
+    })
+    was_enabled = _span.enabled()
+    _span.set_enabled(False)   # simulate spans.enabled=false on the driver
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 7, 800), pa.int64()),
+        "v": pa.array(rng.integers(0, 9, 800), pa.int64()),
+    })
+    df = from_arrow(t, trace_conf, batch_rows=256, partitions=2)
+    df.shuffle_partitions = 2
+    tracing.set_capture(True, clear=True)
+    try:
+        cluster.run_query(df.group_by("k").agg(E.Sum(col("v")).alias("s")))
+        per_process = cluster.collect_traces()
+    finally:
+        _span.set_enabled(was_enabled)
+        tracing.set_capture(False)
+        tracing.trace_events(clear=True)
+    assert _span.assemble_traces(per_process) == {}

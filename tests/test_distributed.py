@@ -279,3 +279,46 @@ def test_distributed_local_topn(rng):
     ex = assert_distributed_matches(q, sort=True)
     assert any("SortExec" in x for x in ex.dist_nodes), (
         ex.dist_nodes, ex.host_nodes)
+
+
+def test_distributed_mesh_dispatch_span_joins_trace(rng):
+    """A mesh dispatch executed while a TraceContext is active records a
+    mesh:dispatch span parented into THAT trace (the serving executor
+    thread activates QueryContext.trace before calling into the engine);
+    with no active context no span is fabricated."""
+    from spark_rapids_tpu.obs import span as _span
+    from spark_rapids_tpu.utils import tracing
+
+    n = 4000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 19, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 50, n), pa.int64()),
+    })
+    df = from_arrow(t, _conf(), batch_rows=512, partitions=4)
+    df.shuffle_partitions = 8
+    q = df.group_by("k").agg(E.Sum(col("v")).alias("s"))
+    plan = q.physical_plan()
+
+    tracing.set_capture(True, clear=True)
+    tctx = _span.new_trace()
+    try:
+        with _span.activate(tctx):
+            MeshExecutor(device_mesh(8)).execute(plan)
+        events = tracing.trace_events(clear=True)
+        # second run, no context: dispatch must not invent an orphan trace
+        MeshExecutor(device_mesh(8)).execute(q.physical_plan())
+        untraced = tracing.trace_events(clear=True)
+    finally:
+        tracing.set_capture(False)
+        tracing.trace_events(clear=True)
+
+    traces = _span.assemble_traces({"driver": events})
+    assert set(traces) == {tctx.trace_id}
+    dispatches = [s for s in traces[tctx.trace_id]
+                  if s["name"] == "mesh:dispatch"]
+    assert dispatches
+    for s in dispatches:
+        assert s["parent_id"] == tctx.span_id
+        assert s["attrs"]["devices"] == 8
+        assert "node" in s["attrs"]
+    assert _span.assemble_traces({"driver": untraced}) == {}

@@ -29,7 +29,7 @@ if REPO not in sys.path:
 
 from tools.lint import (  # noqa: E402
     cache_keys, conf_keys, doc_drift, gauge_catalog, jit_purity,
-    type_support,
+    span_catalog, type_support,
 )
 from tools.lint import core  # noqa: E402
 
@@ -311,6 +311,35 @@ def test_gauge_catalog_flags_undeclared_counter(repo_copy):
             '"fixture_lost_total", 0) + 1\n')
     v = gauge_catalog.run_pass(repo_copy)
     assert any("fixture_lost_total" in x for x in v)
+
+
+def test_span_catalog_clean_at_head():
+    assert span_catalog.run_pass(REPO) == []
+
+
+def test_span_catalog_flags_undeclared_span(repo_copy):
+    """A span name opened in code but missing from obs/span.CATALOG
+    raises KeyError at runtime and fragments trace reassembly — the
+    pass catches it statically."""
+    _append(repo_copy, "spark_rapids_tpu/exec/misc.py",
+            "\n\ndef _fixture_traced():\n"
+            "    from spark_rapids_tpu.obs import span as _sp\n"
+            '    with _sp.span("fixture:bogus-phase"):\n'
+            "        pass\n")
+    v = span_catalog.run_pass(repo_copy)
+    assert any("fixture:bogus-phase" in x and "obs/span.CATALOG" in x
+               for x in v)
+
+
+def test_span_catalog_flags_fstring_span_name(repo_copy):
+    """Dynamic detail belongs in attrs, never interpolated into the span
+    name — an f-string name is flagged outright."""
+    _append(repo_copy, "spark_rapids_tpu/exec/misc.py",
+            "\n\ndef _fixture_traced(q):\n"
+            "    from spark_rapids_tpu.obs import span as _sp\n"
+            '    _sp.record_span(f"query:{q}", 0, 1)\n')
+    v = span_catalog.run_pass(repo_copy)
+    assert any("f-string" in x for x in v)
 
 
 def test_cache_keys_clean_at_head():

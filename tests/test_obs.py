@@ -531,3 +531,175 @@ def test_gauge_catalog_guard_catches_undeclared(tmp_path):
     assert "other_unknown_total" in flagged
     assert "third_unknown_total" in flagged
     assert "year_total" not in flagged
+
+
+# -- span model + trace reassembly (obs/span.py) ---------------------------
+
+def test_span_wire_roundtrip_and_ids():
+    from spark_rapids_tpu.obs import span as sp
+
+    ctx = sp.new_trace()
+    back = sp.TraceContext.from_wire(ctx.to_wire())
+    assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+    assert sp.TraceContext.from_wire(None) is None
+    # ids are fresh per trace
+    other = sp.new_trace()
+    assert other.trace_id != ctx.trace_id
+
+
+def test_span_undeclared_name_raises():
+    from spark_rapids_tpu.obs import span as sp
+
+    with pytest.raises(KeyError):
+        sp.Span("not:declared")
+    with pytest.raises(KeyError):
+        sp.record_span("also:not-declared", 0, 1, ctx=sp.new_trace())
+
+
+def test_span_parenting_and_activation():
+    from spark_rapids_tpu.obs import span as sp
+
+    tracing.set_capture(True, clear=True)
+    root = sp.new_trace()
+    try:
+        with sp.activate(root):
+            assert sp.current() is root
+            with sp.span("query:plan", attrs={"q": "q1"}) as outer:
+                assert outer.parent_id == root.span_id
+                # the child context is installed for nested spans
+                inner_id = sp.record_span(
+                    "query:compile", 0, 1000)
+                assert inner_id is not None
+            # context restored after the with-block
+            assert sp.current() is root
+        assert sp.current() is None
+        events = tracing.trace_events(clear=True)
+    finally:
+        tracing.set_capture(False)
+        tracing.trace_events(clear=True)
+    spans = {e["args"]["span_id"]: e for e in sp.span_events(events)}
+    inner = spans[inner_id]["args"]
+    assert inner["trace_id"] == root.trace_id
+    assert inner["parent_id"] == outer.span_id
+
+
+def test_task_span_noop_without_context():
+    """Worker-side sites must not fabricate orphan traces."""
+    from spark_rapids_tpu.obs import span as sp
+
+    tracing.set_capture(True, clear=True)
+    try:
+        with sp.task_span("cluster:map") as s:
+            assert s is None
+        with sp.activate(sp.new_trace()):
+            with sp.task_span("cluster:map") as s:
+                assert s is not None
+        events = tracing.trace_events(clear=True)
+    finally:
+        tracing.set_capture(False)
+        tracing.trace_events(clear=True)
+    assert len(sp.span_events(events)) == 1
+
+
+def test_span_disabled_records_nothing():
+    from spark_rapids_tpu.obs import span as sp
+
+    tracing.set_capture(True, clear=True)
+    try:
+        sp.set_enabled(False)
+        assert sp.record_span("query:plan", 0, 1,
+                              ctx=sp.new_trace()) is None
+        with sp.span("query:plan") as s:
+            assert s is None
+        events = tracing.trace_events(clear=True)
+    finally:
+        sp.set_enabled(True)
+        tracing.set_capture(False)
+        tracing.trace_events(clear=True)
+    assert sp.span_events(events) == []
+
+
+def test_assemble_traces_merges_processes():
+    from spark_rapids_tpu.obs import span as sp
+
+    root = sp.new_trace()
+
+    def ev(name, span_id, parent_id, start, proc_extra=None):
+        args = {"trace_id": root.trace_id, "span_id": span_id,
+                "parent_id": parent_id}
+        args.update(proc_extra or {})
+        return {"name": name, "start_ns": start, "dur_ns": 10,
+                "thread": 1, "args": args}
+
+    per = {
+        "driver": [ev("query:submit", "s1", root.span_id, 100),
+                   {"name": "not-a-span", "start_ns": 0, "dur_ns": 1,
+                    "thread": 1, "args": {}}],
+        "worker-0": [ev("cluster:map", "m1", "s1", 200, {"shuffle": 3})],
+        "worker-1": [ev("cluster:reduce", "r1", "s1", 300)],
+    }
+    traces = sp.assemble_traces(per)
+    assert set(traces) == {root.trace_id}
+    spans = traces[root.trace_id]
+    assert [s["name"] for s in spans] == [
+        "query:submit", "cluster:map", "cluster:reduce"]  # start_ns order
+    assert {s["process"] for s in spans} == {
+        "driver", "worker-0", "worker-1"}
+    m = [s for s in spans if s["span_id"] == "m1"][0]
+    assert m["parent_id"] == "s1" and m["attrs"]["shuffle"] == 3
+
+
+def test_span_catalog_lint_shape():
+    """obs/span.CATALOG stays a statically-parseable literal of 2-tuples
+    (tools/lint/span_catalog.py and docs render both depend on it)."""
+    import ast as _ast
+    from spark_rapids_tpu.obs import span as sp
+
+    src = pathlib.Path(sp.__file__).read_text()
+    lit = None
+    for node in _ast.walk(_ast.parse(src)):
+        if (isinstance(node, _ast.AnnAssign)
+                and getattr(node.target, "id", None) == "CATALOG"):
+            lit = _ast.literal_eval(node.value)
+    assert lit is not None
+    assert lit == sp.CATALOG
+    assert all(isinstance(n, str) and isinstance(h, str) for n, h in lit)
+
+
+# -- labeled histogram families (per-tenant SLOs) --------------------------
+
+def test_histo_labeled_families_and_reset():
+    histo.reset_all()
+    histo.record_labeled("serve_queue_wait_ns", 5_000_000,
+                         tenant="acme", priority=1)
+    histo.record_labeled("serve_queue_wait_ns", 9_000_000,
+                         tenant="acme", priority=1)
+    histo.record_labeled("serve_queue_wait_ns", 1_000_000,
+                         tenant="zed", priority=0)
+    fam = histo.family("serve_queue_wait_ns")
+    key_acme = (("priority", "1"), ("tenant", "acme"))
+    assert fam[key_acme].snapshot()["count"] == 2
+    assert fam[(("priority", "0"), ("tenant", "zed"))].snapshot()[
+        "count"] == 1
+    # the base (unlabeled) histogram aggregates every labeled record
+    assert histo.get("serve_queue_wait_ns").snapshot()["count"] == 3
+    with pytest.raises(KeyError):
+        histo.record_labeled("not_declared_ns", 1, tenant="x")
+    histo.reset_all()
+    assert histo.family("serve_queue_wait_ns") == {}
+
+
+def test_prometheus_tenant_slo_exposition():
+    from spark_rapids_tpu.serve import metrics as sm
+
+    histo.reset_all()
+    sm.reset_tenants()
+    sm.note_outcome("acme", 1, "completed")
+    sm.observe_queue_wait("acme", 1, 4_000_000)
+    text = render_prometheus()
+    assert ('srtpu_serve_queue_wait_seconds_bucket{priority="1",'
+            'tenant="acme",le=') in text
+    assert ('srtpu_serve_tenant_outcome_total{tenant="acme",priority="1",'
+            'outcome="completed"} 1') in text
+    histo.reset_all()
+    sm.reset_tenants()

@@ -471,3 +471,140 @@ def test_chaos_concurrent_serving_bit_identical():
         srv.close()
     assert not errs, errs
     assert get_pool().used == 0
+
+
+# -- per-tenant SLO metrics + submit tracing --------------------------------
+
+
+def test_tenant_slo_histograms_and_outcomes():
+    """Completed queries land queue-wait/deadline-slack observations and
+    outcome counts keyed by (tenant, priority); tenant_slos() merges them
+    into one percentile view."""
+    from spark_rapids_tpu.obs import histo
+    from spark_rapids_tpu.serve import metrics as sm
+
+    histo.reset_all()
+    sm.reset_tenants()
+    conf = C.RapidsConf()
+    dfs = _queries(conf, n=3)
+    srv = QueryServer(conf)
+    try:
+        tks = [srv.submit(dfs[i % 2], name=f"slo{i}", tenant="acme",
+                          priority=1, deadline_ms=600_000)
+               for i in range(2)]
+        # a DISTINCT query (identical ones would singleflight-dedup onto
+        # the in-flight acme submission and never reach "completed")
+        tk_def = srv.submit(dfs[2], name="slo-default")
+        for tk in tks + [tk_def]:
+            tk.result(timeout_s=120)
+    finally:
+        srv.close()
+    outcomes = sm.tenant_outcomes()
+    assert outcomes[("acme", 1)]["admitted"] == 2
+    assert outcomes[("acme", 1)]["completed"] == 2
+    # a tenant-less submit folds into the "default" tenant
+    assert outcomes[(sm.DEFAULT_TENANT, 0)]["completed"] >= 1
+    slos = sm.tenant_slos()
+    acme = slos[("acme", 1)]
+    qw = acme["queue_wait_ms"]
+    assert qw["count"] == 2
+    assert 0 <= qw["p50"] <= qw["p95"] <= qw["p99"]
+    # deadline was set: slack histogram observed for both completions
+    assert acme["deadline_slack_ms"]["count"] == 2
+    histo.reset_all()
+    sm.reset_tenants()
+
+
+def test_tenant_slo_rejection_outcomes_and_overflow_fold():
+    from spark_rapids_tpu.obs import histo
+    from spark_rapids_tpu.serve import metrics as sm
+
+    histo.reset_all()
+    sm.reset_tenants()
+    sm.configure_slo(True, max_tenants=2)
+    try:
+        for t in ("t0", "t1", "t2", "t3"):
+            sm.note_outcome(t, 0, "admitted")
+        oc = sm.tenant_outcomes()
+        assert oc[("t0", 0)]["admitted"] == 1
+        assert oc[("t1", 0)]["admitted"] == 1
+        # past the cap, unknown tenants fold into the overflow bucket
+        # instead of growing the label space unbounded
+        assert oc[(sm.OVERFLOW_TENANT, 0)]["admitted"] == 2
+        assert ("t2", 0) not in oc and ("t3", 0) not in oc
+    finally:
+        sm.configure_slo(True, max_tenants=64)
+        sm.reset_tenants()
+
+    # a real queue-full shed is counted as a typed rejection outcome
+    conf = C.RapidsConf()
+    blocker, q, q2, *_ = _queries(conf)
+    faults.install("serve.cancel:slow@op=blk,ms=300,count=1")
+    srv = QueryServer(conf, max_concurrent=1, max_queue=1)
+    try:
+        tk_b = srv.submit(blocker, name="blk", tenant="shed-t")
+        tk_q = srv.submit(q, name="q1", tenant="shed-t")
+        with pytest.raises(AdmissionRejected):
+            srv.submit(q2, name="q2", tenant="shed-t")
+        tk_b.result(120)
+        tk_q.result(120)
+    finally:
+        srv.close()
+    oc = sm.tenant_outcomes()[("shed-t", 0)]
+    assert oc["rejected:queue-full"] == 1
+    assert oc["admitted"] == 2
+    sm.reset_tenants()
+    histo.reset_all()
+
+
+def test_tenant_slo_disabled_by_conf():
+    from spark_rapids_tpu.obs import histo
+    from spark_rapids_tpu.serve import metrics as sm
+
+    histo.reset_all()
+    sm.reset_tenants()
+    conf = C.RapidsConf({C.SERVE_SLO_ENABLED.key: False})
+    srv = QueryServer(conf)
+    try:
+        [df] = _queries(conf, n=1)
+        srv.submit(df, tenant="ghost").result(timeout_s=120)
+    finally:
+        srv.close()
+        # restore the default for later servers in this process
+        sm.configure_slo(True, 64)
+    assert ("ghost", 0) not in sm.tenant_outcomes()
+    sm.reset_tenants()
+    histo.reset_all()
+
+
+def test_submit_records_query_lifecycle_spans():
+    """One submission produces submit/admit/queue-wait/execute spans that
+    share the Ticket's trace id — the serving half of the distributed
+    timeline."""
+    from spark_rapids_tpu.obs import span as sp
+    from spark_rapids_tpu.utils import tracing
+
+    conf = C.RapidsConf()
+    [df] = _queries(conf, n=1)
+    srv = QueryServer(conf)
+    tracing.set_capture(True, clear=True)
+    try:
+        tk = srv.submit(df, name="traced", tenant="acme")
+        tk.result(timeout_s=120)
+        events = tracing.trace_events(clear=True)
+    finally:
+        tracing.set_capture(False)
+        tracing.trace_events(clear=True)
+        srv.close()
+    traces = sp.assemble_traces({"driver": events})
+    assert traces, "no span events captured"
+    # find the trace that carries the submit span for THIS query
+    mine = [spans for spans in traces.values()
+            if any(s["name"] == "query:submit"
+                   and s["attrs"].get("query") == "traced" for s in spans)]
+    assert len(mine) == 1
+    names = {s["name"] for s in mine[0]}
+    assert {"query:submit", "query:admit", "query:queue-wait",
+            "query:execute"} <= names
+    execute = [s for s in mine[0] if s["name"] == "query:execute"][0]
+    assert execute["attrs"]["tenant"] == "acme"

@@ -30,6 +30,7 @@ if _ROOT not in sys.path:
 from tools.lint import core  # noqa: E402
 # importing a pass module registers it; import order is run order
 from tools.lint import gauge_catalog  # noqa: E402,F401
+from tools.lint import span_catalog  # noqa: E402,F401
 from tools.lint import cache_keys  # noqa: E402,F401
 from tools.lint import type_support  # noqa: E402,F401
 from tools.lint import jit_purity  # noqa: E402,F401
