@@ -660,6 +660,10 @@ def main(budget_s=None, faults=None, pool_cap=None):
                     "agg_repartition_count", 0),
                 "repartition_depth": prof.task_metrics.get(
                     "max_agg_repartition_depth", 0),
+                # which join/agg paths served the query and whether each
+                # was measured or static (plan/autotune.py); bench_diff
+                # tolerates rounds without the field
+                "dispatch_paths": prof.dispatch_paths(),
             }), flush=True)
             ppath = os.path.join(prof_dir, f"profile_{suite}_{qn}.json")
             with open(ppath, "w") as f:

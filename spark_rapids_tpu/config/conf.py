@@ -741,6 +741,32 @@ JIT_PERSIST_DIR = conf(
         "fingerprint, the same scheme the XLA:CPU kernel cache uses "
         "(_xla_cpu_cache.py), so feature-set changes land in a fresh cache.")
 
+AUTOTUNE_ENABLED = conf(
+    "spark.rapids.tpu.autotune.enabled", default=True,
+    doc="Measurement-driven dispatch: persist per-(op, shape-class) "
+        "operator timings harvested from query profiles and consult them "
+        "when picking join paths (dense/bucketed/ht/sorted), the fused agg "
+        "batch window, and CBO cost constants. Never a correctness "
+        "dependency — with no sample the static defaults apply, and "
+        "candidate paths are restricted to bit-identical alternatives "
+        "(plan/autotune.py, docs/adaptive_dispatch.md).")
+
+AUTOTUNE_DIR = conf(
+    "spark.rapids.tpu.autotune.dir", default="",
+    doc="Directory for the persistent autotune timing store. Empty (the "
+        "default) selects the SRTPU_AUTOTUNE_DIR environment variable when "
+        "set, else a temp-dir path keyed by the CPU-feature fingerprint. "
+        "The store file name folds the jax version, backend, and host "
+        "CPU-feature salt (the jit_persist digest contract), and the salt "
+        "is re-verified on load; drifted or corrupt stores are unlinked.")
+
+AUTOTUNE_MIN_SAMPLES = conf(
+    "spark.rapids.tpu.autotune.minSamples", default=2,
+    doc="Samples required per (op, shape-class, path) before its median "
+        "participates in measured dispatch; below this the static default "
+        "path is used.",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
 FASTPATH_ENABLED = conf(
     "spark.rapids.tpu.fastpath.enabled", default=True,
     doc="Execute small queries on an interactive fast path: when every "

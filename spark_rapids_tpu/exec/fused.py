@@ -320,6 +320,8 @@ class TpuFusedStageExec(UnaryExec):
             yield out
 
     def _execute_agg(self, partition: int, segs):
+        import time as _time
+        from spark_rapids_tpu.plan import autotune as AT
         agg = self.agg
         agg._prepare()
         consts = tuple(seg.consts for seg in segs)
@@ -330,6 +332,9 @@ class TpuFusedStageExec(UnaryExec):
         flags = []
         runs = {}
         n_batches = 0
+        t0 = _time.perf_counter_ns()
+        rows_in = 0
+        shape = None
         it = self.child.execute(partition)
         # seed: the first batch's first-pass output defines the carry's
         # static capacity (its bucket bounds the groups a partition may
@@ -337,6 +342,10 @@ class TpuFusedStageExec(UnaryExec):
         for batch in it:
             n_batches += 1
             cap = batch.capacity
+            rows_in += cap
+            shape = AT.shape_class(
+                cap, len(agg.group_exprs),
+                AT.family_of(str(b.dtype) for b in agg._group_bound))
             key = self._stage_key(segs, cap) + akey + ("seed",)
             fns = self._chain_fns(segs, cap)
             run = shared_jit(key, lambda: _make_seed(fns, agg))
@@ -350,15 +359,29 @@ class TpuFusedStageExec(UnaryExec):
         if n_batches == 0:
             yield from self._fall_back(partition)
             return
-        # steps: windows of up to agg_window batches, ONE dispatch each —
+        # window size: measured carry-overflow/throughput trade-off per
+        # shape-class when the aggregate merges exactly (no float buffers
+        # — window size then never changes the result, an overflowing
+        # window just re-runs unfused); static agg_window otherwise
+        window_n, source = self.agg_window, "default"
+        if agg.window_tunable():
+            cands = tuple(dict.fromkeys((str(self.agg_window), "3", "15")))
+            pick, source = AT.choose("aggwin", shape, str(self.agg_window),
+                                     cands)
+            try:
+                window_n = max(1, int(pick))
+            except ValueError:
+                window_n = self.agg_window
+        # steps: windows of up to window_n batches, ONE dispatch each —
         # chain+first_pass per batch then a single (carry+firsts)
         # concat/merge (the classic operator pays a dispatch per batch
         # plus an end-of-partition 8-way cascade)
         window: List[ColumnarBatch] = []
         for batch in it:
             n_batches += 1
+            rows_in += batch.capacity
             window.append(batch)
-            if len(window) < self.agg_window:
+            if len(window) < window_n:
                 continue
             carry, flags, counts_all = self._run_step(
                 segs, agg, consts, akey, carry, carry_cap, bc_targets,
@@ -372,11 +395,15 @@ class TpuFusedStageExec(UnaryExec):
         # overflow the carry holds truncated garbage -> re-run unfused
         if flags and any(bool(v) for v in jax.device_get(flags)):
             yield from self._fall_back(partition)
+            AT.record_decision(self, "aggwin", str(window_n), source, shape,
+                               ns=_time.perf_counter_ns() - t0, rows=rows_in)
             return
         out = carry if agg.mode == "partial" else agg._final_project_fn(carry)
         agg.metrics["numOutputBatches"].add(1)
         agg._pending_rows.append(out.num_rows)
         yield out
+        AT.record_decision(self, "aggwin", str(window_n), source, shape,
+                           ns=_time.perf_counter_ns() - t0, rows=rows_in)
 
     def _run_step(self, segs, agg, consts, akey, carry, carry_cap,
                   bc_targets, window, runs, flags):

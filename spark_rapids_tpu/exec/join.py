@@ -143,26 +143,26 @@ class HashJoinExec(BinaryExec):
             finally:
                 for h in handles:
                     h.close()
-            dense = self._prepare_dense(build)
-            table = jh = ht = None
-            if dense is None:
-                prep = self._prepare_table(build)
-                if prep is not None and not isinstance(prep, K.JoinHashes):
-                    table = prep
-                else:
-                    # duplicate keys (JoinHashes view) or build too large:
-                    # the general path. Round 12: open-addressing hash
-                    # table with chunked gather; the sorted-hash join is
-                    # the conf-off / rehash-exhausted fallback.
-                    if self._hashtbl_enabled:
-                        ht = K.build_batch_hash_table(build,
-                                                      tuple(self._rkeys))
-                    if ht is None:
-                        jh = (prep if isinstance(prep, K.JoinHashes)
-                              else _prepare_build(build, tuple(self._rkeys)))
+        # Peek one probe batch so the path decision happens at the probe's
+        # shape-class (plan/autotune.py): capacity is the log2 rows bucket
+        # and is static, so this costs no device sync.
+        probe_iter = self.left.execute(partition)
+        first = next(probe_iter, None)
+        probe_cap = first.capacity if first is not None else 16
+        with self.timer("buildTimeNs"):
+            (dense, table, ht, jh, path,
+             source, shape) = self._choose_path(build, probe_cap)
         build_matched = jnp.zeros(build.capacity, jnp.bool_)
+        join_ns0 = self.metrics["joinTimeNs"].value
+        probe_rows = 0
 
-        for probe in self.left.execute(partition):
+        def _probes():
+            if first is not None:
+                yield first
+                yield from probe_iter
+
+        for probe in _probes():
+            probe_rows += probe.capacity
             if ht is not None:
                 with self.timer("joinTimeNs"):
                     handles, build_matched = self._join_batch_ht(
@@ -191,6 +191,54 @@ class HashJoinExec(BinaryExec):
             out = self._unmatched_build(build, build_matched)
             if out is not None:
                 yield out
+
+        from spark_rapids_tpu.plan import autotune as AT
+        AT.record_decision(
+            self, f"join:{self.join_type}", path, source, shape,
+            ns=self.metrics["joinTimeNs"].value - join_ns0,
+            rows=probe_rows)
+
+    def _choose_path(self, build: ColumnarBatch, probe_cap: int):
+        """Pick the probe structure for this partition: the static
+        dense -> bucketed-unique -> ht -> sorted-hash precedence, with
+        the autotune Dispatcher re-ranking only between paths proven to
+        emit identical rows in identical order (dense<->unique for every
+        join type; ht<->sorted only for the semi/anti filters). Returns
+        (dense, table, ht, jh, path, source, shape)."""
+        from spark_rapids_tpu.plan import autotune as AT
+        ls = self.left.output_schema
+        fam = AT.family_of(str(ls[i].dtype) for i in self._lkeys)
+        shape = AT.shape_class(probe_cap, len(self._lkeys), fam)
+        op = f"join:{self.join_type}"
+        dense = self._prepare_dense(build)
+        if dense is not None:
+            path, source = AT.choose(op, shape, "dense",
+                                     ("dense", "unique"))
+            if path == "unique":
+                prep = self._prepare_table(build)
+                if prep is not None and not isinstance(prep, K.JoinHashes):
+                    return None, prep, None, None, "unique", source, shape
+                # table refused (slot budget): back to the static path
+                path, source = "dense", "default"
+            return dense, None, None, None, "dense", source, shape
+        prep = self._prepare_table(build)
+        if prep is not None and not isinstance(prep, K.JoinHashes):
+            return None, prep, None, None, "unique", "default", shape
+        # duplicate keys (JoinHashes view) or build too large: the general
+        # path. Round 12: open-addressing hash table with chunked gather;
+        # the sorted-hash join is the conf-off / rehash-exhausted fallback.
+        path, source = (("ht", "default") if self._hashtbl_enabled
+                        else ("sorted", "default"))
+        if path == "ht" and self.join_type in ("left_semi", "left_anti"):
+            path, source = AT.choose(op, shape, "ht", ("ht", "sorted"))
+        if path == "ht":
+            ht = K.build_batch_hash_table(build, tuple(self._rkeys))
+            if ht is not None:
+                return None, None, ht, None, "ht", source, shape
+            path, source = "sorted", "default"
+        jh = (prep if isinstance(prep, K.JoinHashes)
+              else _prepare_build(build, tuple(self._rkeys)))
+        return None, None, None, jh, "sorted", source, shape
 
     # -- bucketed unique-key table path ------------------------------------
     # Round-4 general-join rebuild (VERDICT r3 item 3): when the build keys
@@ -579,6 +627,15 @@ class HashJoinExec(BinaryExec):
         mls = {i: int(jax.device_get(
                    jnp.max(c.offsets[1:] - c.offsets[:-1])))
                for i, c in enumerate(build.columns) if c.offsets is not None}
+        # fused probes have no per-operator timing to feed the store, but
+        # the decision is still surfaced in explain_analyze/dispatch_paths
+        from spark_rapids_tpu.plan import autotune as AT
+        ls = self.left.output_schema
+        AT.record_decision(
+            self, f"join:{self.join_type}", kind, "default",
+            AT.shape_class(build.capacity, len(self._lkeys),
+                           AT.family_of(str(ls[i].dtype)
+                                        for i in self._lkeys)))
         return _FusedJoinProbe(self, kind, build, tbl, slots, lg_b, mls)
 
     def _fused_build_side(self, partition: int) -> Optional[ColumnarBatch]:

@@ -65,16 +65,35 @@ class BroadcastHashJoinExec(HashJoinExec):
         # set by plan/reuse.py when another join shares this build side: a
         # SharedBroadcast holder publishing one prepared (build, jh) pair
         self._shared_broadcast = None
+        # (path, source, shape) picked when the broadcast was built —
+        # consulted by do_execute when recording dispatch decisions
+        self._bcast_decision = None
         self._register_metric("broadcastTimeNs")
 
     def num_partitions(self) -> int:
         return self.left.num_partitions()
 
-    def _build_broadcast(self):
+    def _build_broadcast(self, probe_cap: int = 16):
         # locked: probe partitions run concurrently under parallel shuffle
         # writes / prefetch workers, and the build must execute exactly once
+        self._prepare()
         with self._bcast_lock:
             if self._broadcast is None:
+                from spark_rapids_tpu.plan import autotune as AT
+                ls = self.left.output_schema
+                shape = AT.shape_class(
+                    probe_cap, len(self._lkeys),
+                    AT.family_of(str(ls[i].dtype) for i in self._lkeys))
+                # ht<->sorted re-ranking is order-safe only for the
+                # semi/anti filters (probe-order output); plain inner/left
+                # output order depends on the structure, so they stay on
+                # the static precedence (see exec/join.py _choose_path)
+                path, source = (("ht", "default") if self._hashtbl_enabled
+                                else ("sorted", "default"))
+                if path == "ht" and self.join_type in ("left_semi",
+                                                       "left_anti"):
+                    path, source = AT.choose(f"join:{self.join_type}",
+                                             shape, "ht", ("ht", "sorted"))
                 holder = self._shared_broadcast
                 if holder is not None:
                     shared = holder.get()
@@ -86,6 +105,9 @@ class BroadcastHashJoinExec(HashJoinExec):
                         _reuse.note("reuse_bytes_saved_total",
                                     int(shared[0].nbytes()))
                         self._broadcast = shared
+                        self._bcast_decision = (
+                            "ht" if shared[2] is not None else "sorted",
+                            "default", shape)
                         return self._broadcast
                 with self.timer("broadcastTimeNs"):
                     batches = list(self.right.execute_all())
@@ -99,22 +121,41 @@ class BroadcastHashJoinExec(HashJoinExec):
                     # table; sorted hashes remain the conf-off / overflow
                     # fallback
                     ht = jh = None
-                    if self._hashtbl_enabled:
+                    if path == "ht":
                         ht = K.build_batch_hash_table(build,
                                                       tuple(self._rkeys))
+                        if ht is None:
+                            path, source = "sorted", "default"
                     if ht is None:
                         jh = jax.jit(K.prepare_join_side, static_argnums=1)(
                             build, tuple(self._rkeys))
                 self._broadcast = (build, jh, ht)
+                self._bcast_decision = (path, source, shape)
                 if holder is not None:
                     holder.put(self._broadcast)
             return self._broadcast
 
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
         self._prepare()
-        build, jh, ht = self._build_broadcast()
+        # peek one probe batch so a cold broadcast build decides its probe
+        # structure at the probe's shape-class (capacity is static: no sync)
+        probe_iter = self.left.execute(partition)
+        first = next(probe_iter, None)
+        probe_cap = first.capacity if first is not None else 16
+        build, jh, ht = self._build_broadcast(probe_cap)
+        decision = self._bcast_decision or (
+            "ht" if ht is not None else "sorted", "default", None)
         build_matched = jnp.zeros(build.capacity, jnp.bool_)
-        for probe in self.left.execute(partition):
+        join_ns0 = self.metrics["joinTimeNs"].value
+        probe_rows = 0
+
+        def _probes():
+            if first is not None:
+                yield first
+                yield from probe_iter
+
+        for probe in _probes():
+            probe_rows += probe.capacity
             if ht is not None:
                 with self.timer("joinTimeNs"):
                     handles, build_matched = self._join_batch_ht(
@@ -131,6 +172,18 @@ class BroadcastHashJoinExec(HashJoinExec):
                                                       build_matched)
             if out is not None:
                 yield out
+
+        from spark_rapids_tpu.plan import autotune as AT
+        path, source, shape = decision
+        if shape is None:
+            ls = self.left.output_schema
+            shape = AT.shape_class(
+                probe_cap, len(self._lkeys),
+                AT.family_of(str(ls[i].dtype) for i in self._lkeys))
+        AT.record_decision(
+            self, f"join:{self.join_type}", path, source, shape,
+            ns=self.metrics["joinTimeNs"].value - join_ns0,
+            rows=probe_rows)
 
     def _fused_build_side(self, partition):
         # the broadcast build spans ALL build-side partitions — the

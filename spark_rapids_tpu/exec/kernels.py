@@ -1528,6 +1528,7 @@ _hashtbl_counters = {
     "hashtbl_probe_total": 0,   # probe passes over a table
     "hashtbl_rehash_total": 0,  # seeded rebuilds after overflow
     "hashtbl_chunk_total": 0,   # bounded output chunks emitted by joins
+    "hashtbl_pallas_fallback_total": 0,  # lowering failures -> sticky XLA
 }
 
 
@@ -1732,6 +1733,25 @@ def _pallas_probe_kernel(capacity: int, max_probes: int):
 
 
 _pallas_broken = False  # sticky: first lowering failure disables the path
+_pallas_mode_last = None  # last-seen conf mode, to detect off/auto -> "on"
+
+
+def reset_pallas_fallback() -> None:
+    """Clear the sticky Pallas lowering-failure latch so the next probe
+    re-attempts the kernel (e.g. after a driver/library fix)."""
+    global _pallas_broken
+    _pallas_broken = False
+
+
+def _note_pallas_fallback(err: Exception) -> None:
+    _note_hashtbl("hashtbl_pallas_fallback_total")
+    try:
+        from spark_rapids_tpu.obs import events as _events
+        _events.emit("pallas-fallback",
+                     backend=jax.default_backend(),
+                     error=f"{type(err).__name__}: {err}"[:200])
+    except Exception:
+        pass
 
 
 def probe_hash_table_pallas(tbl: HashTable, h1: jax.Array, h2: jax.Array,
@@ -1763,17 +1783,23 @@ def probe_hash_table_dispatch(tbl: HashTable, h1: jax.Array, h2: jax.Array,
     """Backend dispatch: Pallas kernel where the platform lowers it, the
     pure-XLA ``probe_hash_table`` everywhere else (JAX_PLATFORMS=cpu lanes,
     and as the sticky fallback after any Pallas lowering failure)."""
-    global _pallas_broken
+    global _pallas_broken, _pallas_mode_last
     from spark_rapids_tpu.config import conf as _C
     mode = _C.HASHTBL_PALLAS_MODE.get(_C.get_active())
+    if mode == "on" and _pallas_mode_last not in (None, "on"):
+        # conf changed to an explicit "on": the operator asked for a
+        # re-attempt, so the sticky latch from the previous mode resets
+        reset_pallas_fallback()
+    _pallas_mode_last = mode
     use = (mode == "on"
            or (mode == "auto" and jax.default_backend() == "tpu"))
     if use and not _pallas_broken:
         try:
             return probe_hash_table_pallas(tbl, h1, h2, capacity, seed,
                                            max_probes)
-        except Exception:  # unsupported lowering: never fail the query
+        except Exception as e:  # unsupported lowering: never fail the query
             _pallas_broken = True
+            _note_pallas_fallback(e)
     return probe_hash_table(tbl, h1, h2, capacity, seed, max_probes)
 
 

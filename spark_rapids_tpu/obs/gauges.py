@@ -47,6 +47,21 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "Table builds that overflowed and retried with a new seed/capacity"),
     ("hashtbl_chunk_total", "counter",
      "Bounded gather chunks emitted by the chunked join gatherer"),
+    ("hashtbl_pallas_fallback_total", "counter",
+     "Pallas probe-kernel lowering failures that engaged the sticky "
+     "XLA fallback (exec/kernels.py; reset by switching "
+     "kernel.hashTable.pallasMode to 'on')"),
+    ("autotune_hit_total", "counter",
+     "Dispatch decisions served from measured timings "
+     "(plan/autotune.py, docs/adaptive_dispatch.md)"),
+    ("autotune_miss_total", "counter",
+     "Dispatch lookups that fell back to the static default path "
+     "(no sample at the op's shape-class)"),
+    ("autotune_store_total", "counter",
+     "Timing samples merged into the persistent autotune store"),
+    ("autotune_override_total", "counter",
+     "Measured dispatch decisions that differed from the static "
+     "default path (exploration or re-ranking)"),
     ("semaphore_wait_ns_total", "counter",
      "Nanoseconds tasks waited to enter the device"),
     ("semaphore_acquire_total", "counter", "Semaphore acquire calls"),
@@ -241,6 +256,8 @@ def snapshot() -> Dict[str, int]:
     out.update(_k.counters())
     from spark_rapids_tpu.serve import metrics as _serve_m
     out.update(_serve_m.counters())
+    from spark_rapids_tpu.plan import autotune as _at
+    out.update(_at.counters())
     return out
 
 

@@ -139,6 +139,14 @@ class QueryProfile:
         if root is not None:
             self.nodes = collect_node_stats(root)
             self.metrics = root.collect_metrics()
+            if first:
+                # close the measurement loop: operator timings, dispatch
+                # decisions, and output ratios feed the persistent
+                # autotune store (plan/autotune.py; never raises, and
+                # collect_node_stats above already copied the decisions
+                # this drains)
+                from spark_rapids_tpu.plan import autotune as _at
+                _at.feedback(root)
         if first:
             _histo.record("query_wall_ns", self.wall_ns)
             # per-phase distributions (bench --latency reads these through
@@ -174,12 +182,25 @@ class QueryProfile:
         return self
 
     # -- products ----------------------------------------------------------
+    def dispatch_paths(self) -> Dict[str, int]:
+        """Dispatch decisions across the plan, counted by
+        ``op:path:source`` — which join/agg paths served the query and
+        whether each choice was measured or the static default
+        (plan/autotune.py; bench.py emits this per query)."""
+        out: Dict[str, int] = {}
+        for node in self.nodes:
+            for d in node.get("dispatch", ()):
+                key = f"{d['op']}:{d['path']}:{d['source']}"
+                out[key] = out.get(key, 0) + 1
+        return out
+
     def to_dict(self) -> Dict:
         return {
             "query_id": self.query_id,
             "description": self.description,
             "wall_ms": _ns_ms(self.wall_ns),
             "phases": dict(self.phases),
+            "dispatch_paths": self.dispatch_paths(),
             "latency": {  # process-wide log-bucket estimates (obs/histo.py)
                 "query_wall": _histo.percentiles("query_wall_ns"),
                 "batch_op": _histo.percentiles("batch_op_ns"),
@@ -254,6 +275,12 @@ class QueryProfile:
                              if k.endswith("Ns") else f"{k}={v}")
             if "fused" in node:
                 cells.append(f"fused=#{node['fused']}")
+            dseen: List[str] = []
+            for d in node.get("dispatch", ()):
+                cell = f"path={d['path']} source={d['source']}"
+                if cell not in dseen:
+                    dseen.append(cell)
+            cells.extend(dseen)
             lines.append(f"{pad}{prefix}{node['description']}  "
                          f"[{' '.join(cells)}]" if cells else
                          f"{pad}{prefix}{node['description']}")
@@ -293,14 +320,18 @@ def collect_node_stats(root) -> List[Dict]:
     def walk(node, depth: int, parent: Optional[int]):
         nid = len(out)
         snap = node.metrics_snapshot()
-        out.append({
+        row = {
             "id": nid,
             "parent": parent,
             "depth": depth,
             "name": type(node).__name__,
             "description": node.node_description(),
             "metrics": snap,
-        })
+        }
+        disp = getattr(node, "_dispatch", None)
+        if disp:
+            row["dispatch"] = [dict(d) for d in disp]
+        out.append(row)
         fused = list(getattr(node, "fused_ops", ()))
         if fused:
             share = snap.get("opTime", 0) // len(fused)
@@ -308,7 +339,7 @@ def collect_node_stats(root) -> List[Dict]:
                 m = op.metrics_snapshot()
                 m["opTime"] = m.get("opTime", 0) + share
                 fid = len(out)
-                out.append({
+                frow = {
                     "id": fid,
                     "parent": nid,
                     "depth": depth + 1,
@@ -316,7 +347,11 @@ def collect_node_stats(root) -> List[Dict]:
                     "description": op.node_description(),
                     "metrics": m,
                     "fused": nid,
-                })
+                }
+                fdisp = getattr(op, "_dispatch", None)
+                if fdisp:
+                    frow["dispatch"] = [dict(d) for d in fdisp]
+                out.append(frow)
                 if len(op.children) == 2:
                     # absorbed join: its build subtree executed for real
                     walk(op.children[1], depth + 2, fid)

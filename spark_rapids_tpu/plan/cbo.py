@@ -23,9 +23,13 @@ halve, aggregates quarter, joins take the probe side.
 from __future__ import annotations
 
 import math
-from typing import Dict, Optional, Tuple
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.config import conf as C
+from spark_rapids_tpu.plan import autotune
 from spark_rapids_tpu.plan import logical as L
 from spark_rapids_tpu.plan.overrides import PlanMeta
 
@@ -46,21 +50,61 @@ from spark_rapids_tpu.config.conf import (  # noqa: F401
 _FILTER_SELECTIVITY = 0.5
 _AGG_REDUCTION = 0.25
 
+# parquet footer row counts, memoized ACROSS CBO passes keyed by
+# (path, size, mtime_ns) — one plan re-optimized per query used to
+# re-open every footer serially every pass
+_FOOTER_ROWS: Dict[Tuple[str, int, int], int] = {}
+_FOOTER_LOCK = threading.Lock()
+
+
+def _footer_key(path: str) -> Tuple[str, int, int]:
+    st = os.stat(path)
+    return (path, st.st_size, st.st_mtime_ns)
+
+
+def _read_footer_rows(path: str) -> int:
+    import pyarrow.parquet as pq
+    return int(pq.ParquetFile(path).metadata.num_rows)
+
+
+def _scan_rows(paths: List[str]) -> float:
+    """Sum of footer row counts, read through the scan.metadataThreads
+    bounded pool (the PR-8 scan pool sizing) on first sight of a file."""
+    keys = [_footer_key(p) for p in paths]
+    with _FOOTER_LOCK:
+        missing = [(p, k) for p, k in zip(paths, keys)
+                   if k not in _FOOTER_ROWS]
+    if missing:
+        n_threads = min(
+            int(C.SCAN_METADATA_THREADS.get(C.get_active())), len(missing))
+        if n_threads > 1:
+            with ThreadPoolExecutor(max_workers=n_threads,
+                                    thread_name_prefix="cbo-meta") as pool:
+                rows = list(pool.map(_read_footer_rows,
+                                     [p for p, _ in missing]))
+        else:
+            rows = [_read_footer_rows(p) for p, _ in missing]
+        with _FOOTER_LOCK:
+            for (_, k), r in zip(missing, rows):
+                _FOOTER_ROWS[k] = r
+    with _FOOTER_LOCK:
+        return float(sum(_FOOTER_ROWS[k] for k in keys))
+
 
 def estimate_rows(node: L.LogicalPlan,
                   _cache: Optional[Dict[int, float]] = None) -> float:
     """Memoized per plan-node: one CBO pass reads each parquet footer once,
-    not once per ancestor."""
+    not once per ancestor (and footer counts memoize across passes, see
+    _scan_rows). Static filter/agg selectivities are corrected by observed
+    output ratios recorded per plan fingerprint (plan/autotune.py) when
+    the store has samples for the exact expression."""
     if _cache is None:
         _cache = {}
     if id(node) in _cache:
         return _cache[id(node)]
     if isinstance(node, L.ParquetScan):
         try:
-            import pyarrow.parquet as pq
-
-            est = float(sum(pq.ParquetFile(p).metadata.num_rows
-                            for p in node.paths))
+            est = _scan_rows(list(node.paths))
         except Exception:
             est = 1e6
     elif isinstance(node, L.InMemoryScan):
@@ -68,9 +112,13 @@ def estimate_rows(node: L.LogicalPlan,
     else:
         kids = [estimate_rows(c, _cache) for c in node.children]
         if isinstance(node, L.Filter):
-            est = kids[0] * _FILTER_SELECTIVITY
+            sel = autotune.ratio(
+                "filter", autotune.plan_fingerprint(node.condition))
+            est = kids[0] * (_FILTER_SELECTIVITY if sel is None else sel)
         elif isinstance(node, L.Aggregate):
-            est = max(1.0, kids[0] * _AGG_REDUCTION)
+            red = autotune.ratio(
+                "agg", autotune.plan_fingerprint(tuple(node.group_exprs)))
+            est = max(1.0, kids[0] * (_AGG_REDUCTION if red is None else red))
         elif isinstance(node, L.Join):
             est = max(kids) if kids else 1.0
         elif isinstance(node, L.Limit):
@@ -86,6 +134,12 @@ def estimate_rows(node: L.LogicalPlan,
 # -- the optimizer ----------------------------------------------------------
 
 
+def _clamp_ratio(r: float) -> float:
+    """Bound measured cost ratios: a pathological sample (near-zero rows,
+    clock skew) must not collapse or explode the DP."""
+    return min(max(r, 1e-3), 1e3)
+
+
 class CostBasedOptimizer:
     """DP placement over the tagged meta tree (CostBasedOptimizer analog)."""
 
@@ -94,6 +148,22 @@ class CostBasedOptimizer:
         self.dev_cost = self.conf[CBO_DEVICE_OP_COST]
         self.cpu_cost = self.conf[CBO_CPU_OP_COST]
         self.xfer_cost = self.conf[CBO_TRANSFER_COST]
+        # measured ns/row medians re-derive the relative cpu/xfer costs,
+        # anchored on the configured device cost so the DP scale is
+        # stable; any component without enough samples keeps its conf
+        # value (measurement is never a correctness dependency)
+        self.cost_source = "default"
+        med = autotune.medians("cbo", "global", ("dev", "cpu", "xfer"))
+        dev_ns = med.get("dev")
+        if dev_ns and dev_ns > 0:
+            if "cpu" in med:
+                self.cpu_cost = self.dev_cost * _clamp_ratio(
+                    med["cpu"] / dev_ns)
+                self.cost_source = "measured"
+            if "xfer" in med:
+                self.xfer_cost = self.dev_cost * _clamp_ratio(
+                    med["xfer"] / dev_ns)
+                self.cost_source = "measured"
 
     def optimize(self, meta: PlanMeta) -> None:
         """Annotate meta nodes the optimal placement keeps on CPU. The root's

@@ -8,6 +8,7 @@ subset that the reference accelerates.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence
 
 import pyarrow as pa
@@ -294,7 +295,19 @@ class DataFrame:
                                     f"waiting for the task semaphore")
                     try:
                         for b in node.execute(p):
-                            tables.append(batch_to_arrow(b, schema))
+                            # device->host materialization cost feeds the
+                            # CBO's measured xfer ns/row (plan/autotune.py;
+                            # buffered, flushed at prof.finish below)
+                            t0 = time.perf_counter_ns()
+                            t = batch_to_arrow(b, schema)
+                            tables.append(t)
+                            if t.num_rows:
+                                from spark_rapids_tpu.plan import (
+                                    autotune as _at,
+                                )
+                                _at.observe("cbo", "global", "xfer",
+                                            time.perf_counter_ns() - t0,
+                                            t.num_rows)
                     finally:
                         if sem is not None:
                             sem.release(p if ctx is None

@@ -677,6 +677,8 @@ class Overrides:
         _histo.set_enabled(self.conf[C.METRICS_HISTOGRAM_ENABLED])
         from spark_rapids_tpu.obs import memtrack as _mt
         _mt.configure(self.conf)
+        from spark_rapids_tpu.plan import autotune as _at
+        _at.configure(self.conf)
         prof = None
         if self.conf[C.PROFILE_ENABLED]:
             # per-query profile created up front so the planning phases

@@ -33,6 +33,14 @@ if not TPU_LANE:
     from _xla_cpu_cache import cpu_cache_dir
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cpu_cache_dir())
 
+# Hermetic autotune store: without this, the in-process suite would read
+# and write the host-shared default timing store, making dispatch (and any
+# differential assertion) depend on what ran on this machine before.
+import tempfile  # noqa: E402
+
+os.environ["SRTPU_AUTOTUNE_DIR"] = tempfile.mkdtemp(
+    prefix="srtpu_autotune_test_")
+
 import jax  # noqa: E402
 
 if not TPU_LANE:
@@ -53,7 +61,7 @@ def rng():
 # the default lane stays fast. CI/driver should run both.
 SLOW_LANE_MODULES = ("test_distributed", "test_cluster", "test_tpcds",
                      "test_scaletest", "test_fusion_diff", "test_reuse_diff",
-                     "test_warmstart")
+                     "test_warmstart", "test_autotune_warm")
 SLOW_LANE = os.environ.get("SRTPU_SLOW_LANE") == "1"
 
 

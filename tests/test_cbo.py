@@ -7,6 +7,7 @@ device, and (d) never change results — only placement.
 
 import numpy as np
 import pyarrow as pa
+import pytest
 
 from spark_rapids_tpu.config import conf as C
 from spark_rapids_tpu.config.conf import RapidsConf
@@ -20,6 +21,18 @@ from spark_rapids_tpu.plan.cbo import (
 )
 from spark_rapids_tpu.plan.cpu import CpuExec
 from spark_rapids_tpu.plan.overrides import Overrides
+
+
+@pytest.fixture(autouse=True)
+def _static_cost_model(tmp_path, monkeypatch):
+    # These tests pin the *static* cost model; isolate them from timing
+    # samples and selectivity ratios other tests in the session fed the
+    # shared autotune store (which would — correctly — change estimates).
+    from spark_rapids_tpu.plan import autotune
+    monkeypatch.setenv("SRTPU_AUTOTUNE_DIR", str(tmp_path))
+    autotune.reset_for_tests()
+    yield
+    autotune.reset_for_tests()
 
 
 def _tab(n, seed=0):

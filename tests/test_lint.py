@@ -346,6 +346,28 @@ def test_cache_keys_clean_at_head():
     assert cache_keys.run_pass(REPO) == []
 
 
+def test_cache_keys_flags_autotune_salt_drop(repo_copy):
+    """The autotune timing store must key on the full environment salt:
+    dropping the CPU-feature fingerprint would let ns/row measured on one
+    host steer dispatch on a different microarchitecture."""
+    _replace(repo_copy, "spark_rapids_tpu/plan/autotune.py",
+             "jax.default_backend(),\n                     "
+             "cpu_feature_fingerprint()",
+             'jax.default_backend(),\n                     "static"')
+    v = cache_keys.run_pass(repo_copy)
+    assert any("autotune" in x and "cpu_feature_fingerprint" in x
+               for x in v), v
+
+
+def test_cache_keys_flags_autotune_digest_without_salt(repo_copy):
+    _replace(repo_copy, "spark_rapids_tpu/plan/autotune.py",
+             '(_environment_salt() + "||" + repr(key))',
+             'repr(key)')
+    v = cache_keys.run_pass(repo_copy)
+    assert any("_store_digest" in x and "_environment_salt" in x
+               for x in v), v
+
+
 def test_cache_keys_flags_params_dropping_key(repo_copy):
     """Original bug shape (VERDICT r5): a parameterized expression whose
     custom cache_key drops _params, silently sharing one compiled kernel

@@ -543,6 +543,11 @@ def test_tenant_slo_rejection_outcomes_and_overflow_fold():
     srv = QueryServer(conf, max_concurrent=1, max_queue=1)
     try:
         tk_b = srv.submit(blocker, name="blk", tenant="shed-t")
+        # wait for the worker to move the blocker from the queue into the
+        # running slot, else q1 (not q2) eats the queue-full rejection
+        deadline = time.monotonic() + 30
+        while srv.admission._queued and time.monotonic() < deadline:
+            time.sleep(0.005)
         tk_q = srv.submit(q, name="q1", tenant="shed-t")
         with pytest.raises(AdmissionRejected):
             srv.submit(q2, name="q2", tenant="shed-t")

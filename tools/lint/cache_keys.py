@@ -10,8 +10,9 @@ and the base ``Expression.cache_key`` folds ``_params`` in through
 
 This pass fails when either side of that contract breaks, and also guards
 the persistent-program cache key site (exec/jit_persist.py environment
-salt) and the hash-table kernel static-arg contract (exec/kernels.py).
-Pure AST, no imports of the checked code.
+salt), the autotune timing-store digest (plan/autotune.py — same salt
+contract), and the hash-table kernel static-arg contract
+(exec/kernels.py). Pure AST, no imports of the checked code.
 """
 
 from __future__ import annotations
@@ -148,6 +149,42 @@ def _check_persist_key(violations: list, root: str) -> None:
             "on-disk entry key")
 
 
+def _check_autotune_key(violations: list, root: str) -> None:
+    """plan/autotune.py store-digest contract: the persistent timing
+    store's file name must fold the same environment salt as jit_persist
+    (jax version + backend + CPU features) — measured ns/row must never
+    steer dispatch on a different backend or host."""
+    path = os.path.join(core.pkg_dir(root), "plan", "autotune.py")
+    rel = os.path.relpath(path, root)
+    if not os.path.exists(path):
+        violations.append(f"{rel}: missing (autotune store removed? "
+                          "update tools/lint/cache_keys.py)")
+        return
+    tree = core.parse(path)
+    fns = {n.name: n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    salt = fns.get("_environment_salt")
+    if salt is None:
+        violations.append(
+            f"{rel}: _environment_salt() not found — the timing-store "
+            "digest no longer has a declared environment key site")
+    else:
+        needed = {"__version__", "default_backend",
+                  "cpu_feature_fingerprint"}
+        missing = needed - _fn_mentions(salt, needed)
+        if missing:
+            violations.append(
+                f"{rel}:{salt.lineno}: _environment_salt() no longer "
+                f"covers {sorted(missing)} — persisted timings could "
+                "steer dispatch in an environment they never measured")
+    dig = fns.get("_store_digest")
+    if dig is None or "_environment_salt" not in _fn_mentions(
+            dig, {"_environment_salt"}):
+        violations.append(
+            f"{rel}: _store_digest() must fold _environment_salt() into "
+            "the timing-store file name")
+
+
 def _check_kernel_static_keys(violations: list, root: str) -> None:
     """exec/kernels.py hash-table jit key contract: table-layout parameters
     (capacity, seed, max_probes) must be STATIC jit args — they shape the
@@ -212,13 +249,14 @@ def _check_kernel_static_keys(violations: list, root: str) -> None:
 
 
 @register("cache-keys",
-          "_params/cache_key contract, persist-digest salt, kernel "
-          "static jit args")
+          "_params/cache_key contract, persist/autotune digest salts, "
+          "kernel static jit args")
 def run_pass(root: str) -> list:
     violations: list = []
     for path in core.iter_py_files(root):
         check_file(path, violations, root)
     _check_key_private_attrs(violations, root)
     _check_persist_key(violations, root)
+    _check_autotune_key(violations, root)
     _check_kernel_static_keys(violations, root)
     return violations
