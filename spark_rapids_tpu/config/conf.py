@@ -516,6 +516,31 @@ SORT_OOC_TARGET_ROWS = conf(
     doc="Output batch row target for the out-of-core sort merge "
         "(reference: GpuSortExec targetSize).")
 
+SORT_OOC_MAX_MERGE_RUNS = conf(
+    "spark.rapids.tpu.sql.sort.outOfCore.maxMergeRuns", default=16,
+    doc="Cap on the number of sorted runs the out-of-core sort merges per "
+        "output batch. Above the cap, runs are pre-merged pairwise-grouped "
+        "into combined runs that shed through the spill framework, so the "
+        "bounded merge set (and its device concat) never grows with input "
+        "batch count.",
+    check=lambda v: None if int(v) >= 2 else "must be >= 2")
+
+SORT_MERGE_PATH_ENABLED = conf(
+    "spark.rapids.tpu.sql.sort.outOfCore.mergePath", default=True,
+    doc="Use the merge-path partitioned device merge for out-of-core "
+        "sorted runs when the sort key packs into one word (single-column "
+        "boolean/int/date/float32/short/byte keys): ranks presorted "
+        "pieces by binary search instead of re-sorting the concatenated "
+        "merge set. Bit-identical to the re-sort; plan/autotune.py picks "
+        "between the two from measured ns/row.")
+
+SORT_RADIX_ENABLED = conf(
+    "spark.rapids.tpu.sql.sort.radixPack", default=True,
+    doc="Allow the packed key-normalized ('radix') sort path: key words "
+        "are normalized to bit-width-bounded unsigned fields and packed "
+        "into fewer u32 sort operands. Bit-identical to the lexsort path; "
+        "plan/autotune.py picks between them from measured ns/row.")
+
 LEXSORT_VARIADIC_MAX = conf(
     "spark.rapids.tpu.sql.sort.variadicMaxOperands", default=6,
     doc="Max sort-key words for the single fused variadic device sort; "
@@ -561,6 +586,18 @@ HASHTBL_PALLAS_MODE = conf(
     doc="Hash-table probe kernel dispatch: 'auto' uses the Pallas kernel "
         "on TPU backends and pure XLA elsewhere; 'on'/'off' force a side. "
         "Any Pallas lowering failure falls back to XLA permanently.",
+    check=lambda v: None if v in ("auto", "on", "off")
+    else "must be auto|on|off")
+
+SORTWIN_PALLAS_MODE = conf(
+    "spark.rapids.tpu.sql.kernel.sortWindow.pallasMode", default="auto",
+    internal=True,
+    doc="Segmented-scan kernel dispatch for sort/window primitives: "
+        "'auto' uses the Pallas kernel on TPU backends and pure XLA "
+        "elsewhere; 'on'/'off' force a side. The kernel is probed with an "
+        "eager lowering test before any traced program commits to it; any "
+        "failure falls back to XLA permanently (reset by switching this "
+        "conf to 'on').",
     check=lambda v: None if v in ("auto", "on", "off")
     else "must be auto|on|off")
 

@@ -524,14 +524,24 @@ class SortSpec(NamedTuple):
 
 
 def sort_indices(
-    batch: ColumnarBatch, specs: Sequence[SortSpec]
+    batch: ColumnarBatch, specs: Sequence[SortSpec], path: str = "lex"
 ) -> jax.Array:
     """Stable lexicographic argsort of the live rows; padding rows sort last.
 
     Replaces cudf ``Table.orderBy`` (reference GpuSortExec.scala:144 /
     SortUtils.scala) with a single fused lexsort on bit-encoded keys.
-    """
+
+    ``path="radix"`` sorts on the packed key-normalized words instead
+    (``packed_sort_keys``): the same total order in fewer sort operands.
+    Both paths are stable over identical preorders, so their outputs are
+    bit-identical — the dispatch (exec/sort.py + plan/autotune.py) may
+    pick either freely. Falls back to lexsort when a key column is
+    radix-ineligible."""
     active = batch.active_mask()
+    if path == "radix":
+        packed = packed_sort_keys(batch, specs)
+        if packed is not None:
+            return lexsort_chain(packed).astype(jnp.int32)
     keys: List[jax.Array] = []
     # lexsort: LAST key is primary -> emit least-significant spec first
     for spec in reversed(list(specs)):
@@ -1538,9 +1548,11 @@ def _note_hashtbl(name: str, n: int = 1) -> None:
 
 
 def counters() -> dict:
-    """Hash-table kernel counters for the obs gauge catalog."""
+    """Kernel counters (hash-table + sort/window) for the gauge catalog."""
     with _hashtbl_lock:
-        return dict(_hashtbl_counters)
+        out = dict(_hashtbl_counters)
+    out.update(sortwin_counters())
+    return out
 
 
 def hashtbl_capacity(n_rows: int) -> int:
@@ -1864,3 +1876,441 @@ def group_rows_table(h1: jax.Array, h2: jax.Array,
         return _group_rows_prehashed_sort(h1, h2, active)
 
     return jax.lax.cond(overflow, via_sort, via_table, operand=None)
+
+
+# ---------------------------------------------------------------------------
+# Ordered-computation kernels (round 13): segmented prefix scans, the
+# merge-path out-of-core merge, and packed ("radix") sort keys. Reference:
+# the GpuWindowExec/segmented-scan layer and the out-of-core merge of
+# GpuSortExec.scala — here each is a gather/scan formulation over the same
+# statically-shaped buffers the rest of the module uses. docs/kernels.md
+# "Sort & window kernels".
+# ---------------------------------------------------------------------------
+
+
+_sortwin_lock = threading.Lock()
+_sortwin_counters = {
+    "sort_runs_total": 0,    # sorted runs created by the out-of-core sort
+    "sort_merge_total": 0,   # merge-path device merges (vs concat+re-sort)
+    "sort_radix_total": 0,   # packed-key single-pass sorts taken
+    "window_scan_total": 0,  # window functions served by scan/prefix paths
+    "window_loop_total": 0,  # window functions served by gather/RMQ paths
+    "sortwin_pallas_fallback_total": 0,  # segscan lowering failures -> XLA
+}
+
+
+def _note_sortwin(name: str, n: int = 1) -> None:
+    with _sortwin_lock:
+        _sortwin_counters[name] += n
+
+
+def sortwin_counters() -> dict:
+    with _sortwin_lock:
+        return dict(_sortwin_counters)
+
+
+_SEGSCAN_OPS = {
+    "add": jnp.add,
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+
+
+def _segscan_identity(op_name: str, dtype):
+    if op_name == "add":
+        return jnp.zeros((), dtype)
+    big = (jnp.array(jnp.inf, dtype) if jnp.issubdtype(dtype, jnp.floating)
+           else jnp.array(jnp.iinfo(dtype).max, dtype))
+    small = (jnp.array(-jnp.inf, dtype)
+             if jnp.issubdtype(dtype, jnp.floating)
+             else jnp.array(jnp.iinfo(dtype).min, dtype))
+    return big if op_name == "min" else small
+
+
+def _segscan_combine(op):
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return (fa | fb, jnp.where(fb, vb, op(va, vb)))
+
+    return combine
+
+
+def segmented_scan_xla(values: jax.Array, is_start: jax.Array,
+                       op_name: str = "add") -> jax.Array:
+    """Inclusive segmented scan (resets at segment heads), pure XLA.
+
+    The associative-scan carry pair (seen-a-head, running value) is the
+    canonical two-prefix formulation: window running aggregates and
+    rank/row_number are differences of these prefixes."""
+    op = _SEGSCAN_OPS[op_name]
+    _, out = jax.lax.associative_scan(
+        _segscan_combine(op), (is_start, values))
+    return out
+
+
+_SEGSCAN_LANES = 128   # last-dim tile width (VPU lanes)
+_SEGSCAN_SUBLANES = 8  # f32/i32 min sublane count
+
+
+def _pallas_segscan_kernel(op_name: str):
+    """Kernel body factory for the blocked segmented scan.
+
+    One whole-array block shaped (rows, 128): an in-row inclusive
+    segmented scan, then an exclusive scan of per-row summaries carries
+    segment state across rows — the standard two-level formulation, all
+    on the VPU."""
+    op = _SEGSCAN_OPS[op_name]
+    combine = _segscan_combine(op)
+
+    def kernel(vals_ref, seg_ref, out_ref):
+        vals = vals_ref[...]
+        seg = seg_ref[...] != 0
+        # level 1: segmented scan within each 128-lane row
+        f_in, v_in = jax.lax.associative_scan(combine, (seg, vals), axis=1)
+        # level 2: exclusive scan of row summaries (last column of level 1)
+        f_sum, v_sum = f_in[:, -1:], v_in[:, -1:]
+        f_inc, v_inc = jax.lax.associative_scan(combine, (f_sum, v_sum),
+                                                axis=0)
+        rows = vals.shape[0]
+        ident = _segscan_identity(op_name, vals.dtype)
+        f_exc = jnp.roll(f_inc, 1, axis=0)
+        v_exc = jnp.roll(v_inc, 1, axis=0)
+        row_id = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+        f_exc = jnp.where(row_id == 0, False, f_exc)
+        v_exc = jnp.where(row_id == 0, ident, v_exc)
+        # the carry applies to each row's prefix before its first head
+        no_head = jnp.cumsum(seg.astype(jnp.int32), axis=1) == 0
+        out = jnp.where(no_head, op(v_exc, v_in), v_in)
+        out_ref[...] = out
+
+    return kernel
+
+
+def segmented_scan_pallas(values: jax.Array, is_start: jax.Array,
+                          op_name: str = "add",
+                          interpret: bool = False) -> jax.Array:
+    """Pallas variant of ``segmented_scan_xla`` — identical contract.
+
+    Pads to a (sublanes x 128)-aligned 2D block (padding rows are their
+    own one-row segments, so they never contaminate the carry) and runs
+    the two-level scan as one kernel. ``interpret=True`` runs the same
+    kernel through the Pallas interpreter (the CPU test lane)."""
+    from jax.experimental import pallas as pl
+
+    n = values.shape[0]
+    blk = _SEGSCAN_LANES * _SEGSCAN_SUBLANES
+    npad = ((max(n, 1) + blk - 1) // blk) * blk
+    ident = jnp.full((npad - n,), _segscan_identity(op_name, values.dtype))
+    v = jnp.concatenate([values, ident]) if npad > n else values
+    s = is_start.astype(jnp.int32)
+    if npad > n:
+        s = jnp.concatenate([s, jnp.ones(npad - n, jnp.int32)])
+    v2 = v.reshape(npad // _SEGSCAN_LANES, _SEGSCAN_LANES)
+    s2 = s.reshape(npad // _SEGSCAN_LANES, _SEGSCAN_LANES)
+    out = pl.pallas_call(
+        _pallas_segscan_kernel(op_name),
+        out_shape=jax.ShapeDtypeStruct(v2.shape, v2.dtype),
+        interpret=interpret,
+    )(v2, s2)
+    return out.reshape(-1)[:n]
+
+
+_sortwin_pallas_broken = False  # sticky: first lowering failure -> XLA
+_sortwin_mode_last = None       # last-seen conf mode (off/auto -> "on" reset)
+_sortwin_probed = False         # one-time eager lowering probe ran
+
+
+def reset_sortwin_pallas_fallback() -> None:
+    """Clear the sticky segscan Pallas latch (and its lowering probe) so
+    the next scan re-attempts the kernel."""
+    global _sortwin_pallas_broken, _sortwin_probed
+    _sortwin_pallas_broken = False
+    _sortwin_probed = False
+
+
+def _note_sortwin_pallas_fallback(err: Exception) -> None:
+    _note_sortwin("sortwin_pallas_fallback_total")
+    try:
+        from spark_rapids_tpu.obs import events as _events
+        _events.emit("pallas-fallback",
+                     backend=jax.default_backend(), site="segscan",
+                     error=f"{type(err).__name__}: {err}"[:200])
+    except Exception:
+        pass
+
+
+def _segscan_pallas_ok() -> bool:
+    """One-time EAGER lowering probe: the segmented scan is embedded in
+    traced window programs, where a lowering failure would surface at
+    compile time and fail the query. The probe runs under
+    ``ensure_compile_time_eval``: a plain call from inside an outer trace
+    would be STAGED into that trace (burying the failure in the caller's
+    compile — and injecting the dead kernel into its program) instead of
+    compiling here where the except can latch the sticky fallback."""
+    global _sortwin_probed, _sortwin_pallas_broken
+    if not _sortwin_probed:
+        _sortwin_probed = True
+        try:
+            with jax.ensure_compile_time_eval():
+                v = jnp.arange(_SEGSCAN_LANES * _SEGSCAN_SUBLANES,
+                               dtype=jnp.float32)
+                s = (jnp.arange(v.shape[0], dtype=jnp.int32) % 64) == 0
+                jax.block_until_ready(segmented_scan_pallas(v, s, "add"))
+        except Exception as e:
+            _sortwin_pallas_broken = True
+            _note_sortwin_pallas_fallback(e)
+    return not _sortwin_pallas_broken
+
+
+# Pallas TPU kernels have no 64-bit lanes: the dispatch only routes 32-bit
+# scans to the kernel; 64-bit running sums (window f64/int64 lanes) keep
+# the XLA formulation.
+_SEGSCAN_PALLAS_DTYPES = (jnp.float32, jnp.int32, jnp.uint32)
+
+
+def segmented_scan(values: jax.Array, is_start: jax.Array,
+                   op_name: str = "add") -> jax.Array:
+    """Backend dispatch for the segmented scan: the Pallas kernel where
+    the platform lowers it (probed eagerly, sticky XLA fallback on any
+    failure), ``segmented_scan_xla`` everywhere else. Same mode conf
+    contract as the hash-table probe: sortWindow.pallasMode auto/on/off,
+    with the latch reset on a transition to 'on'."""
+    global _sortwin_mode_last, _sortwin_pallas_broken
+    from spark_rapids_tpu.config import conf as _C
+    mode = _C.SORTWIN_PALLAS_MODE.get(_C.get_active())
+    if mode == "on" and _sortwin_mode_last not in (None, "on"):
+        reset_sortwin_pallas_fallback()
+    _sortwin_mode_last = mode
+    use = (mode == "on"
+           or (mode == "auto" and jax.default_backend() == "tpu"))
+    if (use and values.ndim == 1
+            and any(values.dtype == d for d in _SEGSCAN_PALLAS_DTYPES)
+            and _segscan_pallas_ok()):
+        try:
+            return segmented_scan_pallas(values, is_start, op_name)
+        except Exception as e:  # eager-path failure: never fail the query
+            _sortwin_pallas_broken = True
+            _note_sortwin_pallas_fallback(e)
+    return segmented_scan_xla(values, is_start, op_name)
+
+
+# -- packed ("radix") sort keys ---------------------------------------------
+#
+# sortable_keys() emits one word per ordering concern (data, null flag,
+# NaN class, padding), so a single-column ORDER BY already costs 2-3 sort
+# operands and multi-column sorts overflow the variadic-sort budget into
+# the chained LSD fallback. But most words are nearly empty: null flags
+# are 1 bit, NaN classes 2 bits, SHORT/BYTE keys 16/8 bits. The radix
+# plan normalizes every key word to an unsigned field of known bit width
+# and greedily packs adjacent (in significance order) fields into u32
+# words — the same total order in strictly fewer sort passes. Packing is
+# order-preserving by construction, so the packed sort is bit-identical
+# to the lexsort path (autotune may flip between them freely).
+
+
+def _radix_widths(dtype, str_words: int = 2) -> Optional[List[int]]:
+    """Field bit widths (least-significant first, null field included) for
+    one sort column, or None when the dtype's keys cannot be bounded
+    (DOUBLE sorts on f64 values — no device bit encoding exists)."""
+    if dtype == T.BOOLEAN:
+        return [2]                      # null folds into the data field
+    if dtype == T.BYTE:
+        return [8, 1]
+    if dtype == T.SHORT:
+        return [16, 1]
+    if dtype in (T.INT, T.DATE):
+        return [32, 1]
+    if dtype in (T.LONG, T.TIMESTAMP):
+        return [32, 32, 1]
+    if dtype == T.FLOAT:
+        return [32, 2]                  # value bits + NaN/null class
+    if isinstance(dtype, T.DecimalType):
+        if dtype.precision <= T.DecimalType.MAX_LONG_DIGITS:
+            return [32, 32, 1]
+        return [32, 32, 32, 32, 1]
+    return None  # DOUBLE (f64 values), STRING/BINARY (dict-dynamic), nested
+
+
+def radix_plan(dtypes: Sequence, specs) -> Optional[Tuple[int, int]]:
+    """(flat_words, packed_words) the two sort paths would use for these
+    key columns (padding word included), or None when any key column is
+    radix-ineligible. Host-side and static: dtypes only."""
+    fields: List[int] = []
+    for spec in reversed(list(specs)):
+        w = _radix_widths(dtypes[spec.column],
+                          getattr(spec, "str_words", 2))
+        if w is None:
+            return None
+        fields.extend(w)
+    fields.append(1)  # the padding-last word sort_indices appends
+    # one lexsort operand per field: sortable_keys emits exactly one word
+    # per ordering concern for every radix-eligible dtype
+    flat = len(fields)
+    packed = 0
+    used = 33
+    for w in fields:
+        if used + w > 32:
+            packed += 1
+            used = w
+        else:
+            used += w
+    return flat, packed
+
+
+def _radix_fields(col: DeviceColumn, ascending: bool,
+                  nulls_first: Optional[bool]
+                  ) -> List[Tuple[jax.Array, int]]:
+    """(unsigned u32 field, bit width) list, least-significant first,
+    matching ``_radix_widths`` and ordering EXACTLY like the
+    ``sortable_keys`` words for the same column (ties included)."""
+    if nulls_first is None:
+        nulls_first = ascending
+    dt = col.dtype
+    valid = col.validity
+
+    def null_field():
+        nk = jnp.where(valid, jnp.uint32(1), jnp.uint32(0))
+        return (jnp.uint32(1) - nk if not nulls_first else nk, 1)
+
+    if dt == T.BOOLEAN:
+        k = col.data.astype(jnp.int32)
+        if not ascending:
+            k = 1 - k
+        null_v = jnp.int32(-1) if nulls_first else jnp.int32(2)
+        k = jnp.where(valid, k, null_v)
+        return [((k + 1).astype(jnp.uint32), 2)]
+    if dt in (T.BYTE, T.SHORT):
+        bias = 1 << (7 if dt == T.BYTE else 15)
+        d = col.data.astype(jnp.int32)
+        k = (d + bias) if ascending else (bias - 1 - d)
+        k = jnp.where(valid, k, 0).astype(jnp.uint32)
+        return [(k, 16 if dt == T.SHORT else 8), null_field()]
+    if dt in (T.INT, T.DATE):
+        k32 = jax.lax.bitcast_convert_type(
+            col.data.astype(jnp.int32), jnp.uint32) ^ jnp.uint32(1 << 31)
+        if not ascending:
+            k32 = ~k32
+        k32 = jnp.where(valid, k32, jnp.uint32(0))
+        return [(k32, 32), null_field()]
+    if dt == T.FLOAT:
+        d, is_nan = _float_canonical(col.data)
+        d32 = d.astype(jnp.float32)
+        bits = jax.lax.bitcast_convert_type(d32, jnp.uint32)
+        neg = (bits >> 31) != 0
+        ordered = bits ^ jnp.where(neg, jnp.uint32(0xFFFFFFFF),
+                                   jnp.uint32(1 << 31))
+        ex = jnp.where(is_nan, jnp.int32(2), jnp.int32(1))
+        if not ascending:
+            ordered = ~ordered
+            ex = 3 - ex
+        ex = jnp.where(valid, ex,
+                       jnp.int32(0) if nulls_first else jnp.int32(3))
+        ordered = jnp.where(valid & ~is_nan, ordered, jnp.uint32(0))
+        return [(ordered, 32), (ex.astype(jnp.uint32), 2)]
+    if col.is_wide_decimal:
+        from spark_rapids_tpu.exec import int128 as I128
+        kh, kl = I128.sortable_keys(col.data2, col.data)
+        words = [kl, kh]
+        if not ascending:
+            words = [~w for w in words]
+        words = [jnp.where(valid, w, jnp.zeros_like(w)) for w in words]
+        out: List[Tuple[jax.Array, int]] = []
+        for w in words:
+            lo, hi = _split_u64(w)
+            out.extend([(lo, 32), (hi, 32)])
+        out.append(null_field())
+        return out
+    # LONG / TIMESTAMP / DECIMAL64: the u64 bijection, split to u32 lanes
+    k = _int_sortable(col.data)
+    if not ascending:
+        k = ~k
+    k = jnp.where(valid, k, jnp.zeros_like(k))
+    lo, hi = _split_u64(k)
+    return [(lo, 32), (hi, 32), null_field()]
+
+
+def packed_sort_keys(batch: ColumnarBatch,
+                     specs) -> Optional[List[jax.Array]]:
+    """u32 sort operands for the packed radix path (padding field
+    included), least-significant first — ``lexsort_chain`` input. None
+    when any key column is radix-ineligible (callers keep the lexsort
+    path; ``radix_plan`` pre-checks this statically)."""
+    fields: List[Tuple[jax.Array, int]] = []
+    for spec in reversed(list(specs)):
+        col = batch.columns[spec.column]
+        if _radix_widths(col.dtype, getattr(spec, "str_words", 2)) is None:
+            return None
+        fields.extend(_radix_fields(col, spec.ascending, spec.nulls_first))
+    pad = jnp.where(batch.active_mask(), jnp.uint32(0), jnp.uint32(1))
+    fields.append((pad, 1))
+    words: List[jax.Array] = []
+    cur = None
+    used = 0
+    for w, bits in fields:
+        w = w.astype(jnp.uint32)
+        if cur is None or used + bits > 32:
+            if cur is not None:
+                words.append(cur)
+            cur, used = w, bits
+        else:
+            cur = cur | (w << jnp.uint32(used))
+            used += bits
+    words.append(cur)
+    return words
+
+
+# -- merge-path out-of-core merge --------------------------------------------
+
+
+_MERGE_PAD = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def merge_key_bits(dtype) -> Optional[int]:
+    """Total key bits when this dtype's full sort key (null ordering
+    included) packs into ONE u64 word — the merge-path eligibility test.
+    The padding sentinel (all-ones) must stay unreachable, so 64-bit
+    data keys (LONG/TIMESTAMP/decimal) are excluded."""
+    widths = _radix_widths(dtype)
+    if widths is None:
+        return None
+    bits = sum(widths)
+    return bits if bits < 64 else None
+
+
+def merge_key_u64(col: DeviceColumn, ascending: bool,
+                  nulls_first: Optional[bool],
+                  active: jax.Array) -> jax.Array:
+    """One u64 key per row whose ascending order IS the column's full
+    sort order (``sortable_keys`` ties included); padding rows get the
+    unreachable all-ones sentinel so they sort past every live row."""
+    fields = _radix_fields(col, ascending, nulls_first)
+    key = jnp.zeros(col.validity.shape[0], jnp.uint64)
+    shift = 0
+    for w, bits in fields:
+        key = key | (w.astype(jnp.uint64) << jnp.uint64(shift))
+        shift += bits
+    assert shift < 64, "merge key overflows one word; caller gates on " \
+                       "merge_key_bits"
+    return jnp.where(active, key, _MERGE_PAD)
+
+
+def merge_piece_positions(keys: Sequence[jax.Array]) -> List[jax.Array]:
+    """Merged-order position of every row of every presorted piece.
+
+    The merge-path formulation: a row's global rank is its local index
+    plus, per other piece, a binary-search count of that piece's rows
+    ordered before it — ``side`` breaks cross-piece ties by piece index,
+    matching what a stable sort of the concatenation would do, so the
+    merge is bit-identical to the re-sort it replaces. O(k^2 log n)
+    searchsorted lanes, no data movement until the final gather."""
+    out: List[jax.Array] = []
+    for p, kp in enumerate(keys):
+        pos = jnp.arange(kp.shape[0], dtype=jnp.int32)
+        for q, kq in enumerate(keys):
+            if q == p:
+                continue
+            side = "right" if q < p else "left"
+            pos = pos + jnp.searchsorted(kq, kp, side=side).astype(jnp.int32)
+        out.append(pos)
+    return out

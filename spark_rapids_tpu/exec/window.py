@@ -39,7 +39,13 @@ from spark_rapids_tpu.exprs import window as W
 
 def _segmented_scan(values: jax.Array, is_start: jax.Array, op):
     """Inclusive segmented scan: resets at segment starts. ``op`` must be
-    associative (add/min/max)."""
+    associative (add/min/max). Named ops route through the shared kernel
+    dispatch (exec/kernels.py): Pallas segmented-scan kernel on TPU for
+    32-bit lanes, pure-XLA flag-carry scan everywhere else — identical
+    results either way (same combine, same float order)."""
+    name = _SEGSCAN_OP_NAMES.get(op)
+    if name is not None:
+        return K.segmented_scan(values, is_start, name)
 
     def combine(a, b):
         fa, va = a
@@ -48,6 +54,9 @@ def _segmented_scan(values: jax.Array, is_start: jax.Array, op):
 
     _, out = jax.lax.associative_scan(combine, (is_start, values))
     return out
+
+
+_SEGSCAN_OP_NAMES = {jnp.add: "add", jnp.minimum: "min", jnp.maximum: "max"}
 
 
 class WindowExec(UnaryExec):
@@ -99,20 +108,57 @@ class WindowExec(UnaryExec):
                 f = type(f)(E.resolve(f.children[0], cs))
             bound_wins.append((f, func.spec.resolved_frame(), name))
         self._bound_wins = bound_wins
-
-        @jax.jit
-        def run(batch):
-            return self._compute(batch)
-
-        @jax.jit
-        def run_presorted(batch):
-            # planner-sorted stream: the within-batch sort is an identity
-            # permutation — skip it (and its two full-batch gathers)
-            return self._compute(batch, presorted=True)
-
-        self._run = run
-        self._run_presorted = run_presorted
+        # bounded-ROWS min/max frames have two order-equivalent device
+        # formulations (prefix/suffix scan blocks vs RMQ sparse table —
+        # comparisons only, so bit-identical); plan/autotune.py picks from
+        # measured ns/row. The choice is a trace-time constant, so compiled
+        # programs are cached per path (_get_run).
+        self._minmax_path = "scan"
+        self._has_bounded_minmax = any(
+            isinstance(f, (E.Min, E.Max)) and frame.kind == "rows"
+            and frame.start is not W.UNBOUNDED
+            and frame.end is not W.UNBOUNDED
+            for f, frame, _n in bound_wins)
+        # windows that statically query a sparse table (per-row log-range
+        # gathers — the "loop" formulation analog, counted in the
+        # window_loop_total gauge): First/Last, and Min/Max over frames
+        # with no scan shape
+        self._has_rmq_frames = any(
+            (isinstance(f, (E.First, E.Last)) and f.children)
+            or (isinstance(f, (E.Min, E.Max))
+                and not frame.is_unbounded_both
+                and not (frame.start is W.UNBOUNDED and frame.end == 0)
+                and not (frame.kind == "rows"
+                         and frame.start is not W.UNBOUNDED
+                         and frame.end is not W.UNBOUNDED))
+            for f, frame, _n in bound_wins)
+        self._run_jits = {}
         self._prepared = True
+
+    def _get_run(self, presorted: bool = False):
+        """jax.jit of _compute, cached per (minmax path, presorted) — the
+        path is read at trace time, so flipping it must fork the program."""
+        key = (self._minmax_path, presorted)
+        fn = self._run_jits.get(key)
+        if fn is None:
+            if presorted:
+                # planner-sorted stream: the within-batch sort is an
+                # identity permutation — skip it (and its two full-batch
+                # gathers)
+                fn = jax.jit(
+                    lambda batch: self._compute(batch, presorted=True))
+            else:
+                fn = jax.jit(lambda batch: self._compute(batch))
+            self._run_jits[key] = fn
+        return fn
+
+    @property
+    def _run(self):
+        return self._get_run(False)
+
+    @property
+    def _run_presorted(self):
+        return self._get_run(True)
 
     @property
     def output_schema(self) -> T.Schema:
@@ -210,12 +256,44 @@ class WindowExec(UnaryExec):
         return None
 
     # -- execution ---------------------------------------------------------
+    def _choose_window_paths(self, cap: int):
+        """Pick the bounded-minmax formulation at this capacity's
+        shape-class (no device sync) BEFORE the first trace; returns
+        (path, source, shape) for the dispatch record."""
+        from spark_rapids_tpu.plan import autotune as AT
+        fam = AT.family_of(
+            str(f.children[0].dtype)
+            for f, _fr, _n in self._bound_wins if f.children) or "na"
+        shape = AT.shape_class(cap, len(self._bound_wins), fam)
+        if not self._has_bounded_minmax:
+            return "scan", "default", shape
+        path, source = AT.choose("window:minmax", shape, "scan",
+                                 ("scan", "rmq"))
+        self._minmax_path = path
+        return path, source, shape
+
     def do_execute(self, partition: int) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.plan import autotune as AT
         self._prepare()
         it = self.child.execute(partition)
         first = next(it, None)
         if first is None:
             return
+        path, source, shape = self._choose_window_paths(first.capacity)
+        op = "window:minmax" if self._has_bounded_minmax else "window"
+        ns0 = self.metrics["windowTimeNs"].value
+        rows = 0
+        for b in self._do_execute_batches(first, it):
+            rows += b.capacity
+            K._note_sortwin("window_scan_total")
+            if self._has_rmq_frames or path == "rmq":
+                K._note_sortwin("window_loop_total")
+            yield b
+        AT.record_decision(
+            self, op, path, source, shape,
+            ns=self.metrics["windowTimeNs"].value - ns0, rows=rows)
+
+    def _do_execute_batches(self, first, it) -> Iterator[ColumnarBatch]:
         second = next(it, None)
         if second is None:
             with self.timer("windowTimeNs"):
@@ -340,7 +418,7 @@ class WindowExec(UnaryExec):
                 "dense": jnp.zeros(1, jnp.int64)}
 
     def _run_streaming(self, batch, carry):
-        key = ("stream", batch.capacity)
+        key = ("stream", batch.capacity, self._minmax_path)
         cache = getattr(self, "_stream_jits", None)
         if cache is None:
             cache = self._stream_jits = {}
@@ -774,6 +852,12 @@ class WindowExec(UnaryExec):
                                          out_t, active, re_c, idx)
             if frame.kind == "rows" and frame.start is not W.UNBOUNDED \
                     and frame.end is not W.UNBOUNDED:
+                # two order-equivalent formulations (comparisons only, so
+                # bit-identical); _choose_window_paths picked from measured
+                # ns/row before this trace
+                if self._minmax_path == "rmq":
+                    return self._rmq_minmax(f, vals, valid, active, lo_c,
+                                            hi_c, empty, out_t, cap)
                 return self._bounded_minmax(f, vals, valid, active, seg_flag,
                                             seg_start, seg_end, idx,
                                             frame.start, frame.end, out_t,

@@ -51,6 +51,25 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "Pallas probe-kernel lowering failures that engaged the sticky "
      "XLA fallback (exec/kernels.py; reset by switching "
      "kernel.hashTable.pallasMode to 'on')"),
+    ("sort_runs_total", "counter",
+     "Sorted runs produced by the out-of-core sort (exec/sort.py)"),
+    ("sort_merge_total", "counter",
+     "Out-of-core merge sets combined by the merge-path device merge "
+     "(searchsorted ranks, no re-sort — docs/kernels.md)"),
+    ("sort_radix_total", "counter",
+     "Sorts executed on the packed key-normalized (radix) encoding "
+     "instead of the flat lexsort word chain"),
+    ("window_scan_total", "counter",
+     "Window batches computed by the segmented-scan engine "
+     "(exec/window.py)"),
+    ("window_loop_total", "counter",
+     "Window batches that queried a sparse-table/RMQ path (per-row "
+     "log-range gathers: First/Last, value-bounded or autotuned-rmq "
+     "min/max frames)"),
+    ("sortwin_pallas_fallback_total", "counter",
+     "Pallas segmented-scan lowering failures that engaged the sticky "
+     "XLA fallback (exec/kernels.py; reset by switching "
+     "kernel.sortWindow.pallasMode to 'on')"),
     ("autotune_hit_total", "counter",
      "Dispatch decisions served from measured timings "
      "(plan/autotune.py, docs/adaptive_dispatch.md)"),

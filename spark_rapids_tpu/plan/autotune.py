@@ -31,8 +31,10 @@ Shape-class = log2-bucketed rows x key-width x dtype-family
 so ``ColumnarBatch.capacity`` is used as the rows proxy — no device
 sync on the hot path. Callers restrict candidate sets to paths proven
 to produce bit-identical output in identical order (dense<->unique for
-every join type; ht<->sorted only for semi/anti), so measurements only
-ever *re-rank* paths, never change results.
+every join type; ht<->sorted only for semi/anti; lex<->radix and
+resort<->merge for ``op="sort"``/``"sort:ooc"``; scan<->rmq for
+``op="window:minmax"`` — comparisons only, no float reassociation), so
+measurements only ever *re-rank* paths, never change results.
 
 Counters export as ``srtpu_autotune_{hit,miss,store,override}_total``
 (obs/gauges.py CATALOG). Config: ``spark.rapids.tpu.autotune.*``; the

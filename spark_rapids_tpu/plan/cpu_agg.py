@@ -472,13 +472,16 @@ class CpuWindowExec(CpuExec, UnaryExec):
         pkeys, okeys, asc, napos = [], [], [], []
         for i, p in enumerate(spec.partition_by):
             vals, valid = cpu_eval(E.resolve(p, cs), t, cs)
-            df[f"#p{i}"] = pd.array(vals).where(valid, None) if not valid.all() \
-                else vals
+            # Series, not pd.array: extension arrays have no .where
+            df[f"#p{i}"] = (pd.Series(vals, index=df.index)
+                            .where(np.asarray(valid), None)
+                            if not valid.all() else vals)
             pkeys.append(f"#p{i}")
         for i, o in enumerate(spec.order_by):
             vals, valid = cpu_eval(E.resolve(o.child, cs), t, cs)
-            df[f"#o{i}"] = pd.array(vals).where(valid, None) if not valid.all() \
-                else vals
+            df[f"#o{i}"] = (pd.Series(vals, index=df.index)
+                            .where(np.asarray(valid), None)
+                            if not valid.all() else vals)
             okeys.append(f"#o{i}")
             asc.append(o.ascending)
             nf = o.nulls_first if o.nulls_first is not None else o.ascending
@@ -667,8 +670,12 @@ def _cpu_window_agg(df, grouper, f, frame, cs, t, okeys=(), asc=()):
             if frame.kind == "range" and okeys:
                 # RANGE running frames include all peer rows tied on the
                 # order key (Spark default frame; the device exec scans to
-                # the peer-run end) — broadcast each run's last value
-                runs = g[list(okeys)].apply(tuple, axis=1)
+                # the peer-run end) — broadcast each run's last value.
+                # Null keys are peers of each other: normalize to a
+                # sentinel first (NaN != NaN would split the null run)
+                kdf = g[list(okeys)]
+                kdf = kdf.astype(object).mask(kdf.isna(), "\0null")
+                runs = kdf.apply(tuple, axis=1)
                 run_id = (runs != runs.shift()).cumsum()
                 res = res.groupby(run_id).transform("last")
             pieces.append(res)

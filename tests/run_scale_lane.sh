@@ -1,10 +1,11 @@
 #!/bin/sh
 # Scale lane: the capped-pool SF10 gauntlet (tools/scale_gauntlet.py,
-# docs/oversized_state.md). Runs the heaviest exact-arithmetic TPC-DS
-# aggregations twice in one process — uncapped, then under a pool cap —
-# and fails unless capped results are bit-identical to uncapped AND the
-# pressure machinery demonstrably fired (spill chunks > 0, agg
-# repartition passes > 0 with depth >= 1).
+# docs/oversized_state.md). Runs heavyweight TPC-DS aggregations twice
+# in one process — uncapped, then under a pool cap — and fails unless
+# capped results match uncapped under each lane's gate (q65 exact /
+# bit-identical, q67 reorder-tolerant float-ULP) AND the pressure
+# machinery demonstrably fired (spill chunks > 0, agg repartition
+# passes > 0 with depth >= 1).
 #
 # ~10-25 min at the default SF10 on one core; override for smoke runs:
 #   SCALE_SF=1 tests/run_scale_lane.sh          # ~2 min
@@ -12,7 +13,7 @@
 # derives from the uncapped peak), SCALE_BATCH_ROWS, SCALE_OUT.
 set -e
 cd "$(dirname "$0")/.."
-set -- --sf "${SCALE_SF:-10}" --queries "${SCALE_QUERIES:-q65}" \
+set -- --sf "${SCALE_SF:-10}" --queries "${SCALE_QUERIES:-q65,q67}" \
     --out "${SCALE_OUT:-docs/tpcds_status_sf10.md}"
 [ -n "$SCALE_POOL_CAP" ] && set -- "$@" --pool-cap "$SCALE_POOL_CAP"
 [ -n "$SCALE_BATCH_ROWS" ] && set -- "$@" --batch-rows "$SCALE_BATCH_ROWS"

@@ -29,7 +29,7 @@ if REPO not in sys.path:
 
 from tools.lint import (  # noqa: E402
     cache_keys, conf_keys, doc_drift, gauge_catalog, jit_purity,
-    span_catalog, type_support,
+    pallas_fallback, span_catalog, type_support,
 )
 from tools.lint import core  # noqa: E402
 

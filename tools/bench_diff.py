@@ -20,6 +20,13 @@ Round tolerance, by design:
 - missing ``parsed`` key (MULTICHIP schema) -> metrics come from tail
   JSON lines only; a tail without metric lines is fine.
 
+On/off tracker rounds (``BENCH_AUTOTUNE_r*.json``,
+``BENCH_SORTWIN_r*.json``) are gated too: each query contributes
+``query:<q>:speedup`` (wall_off/wall_on — losing a previously-held
+speedup trips the gate) and ``query:<q>:roofline_util``; a round with
+any ``identical: false`` query is degraded (a wrong answer has no
+legitimate speed).
+
 CLI:
     python tools/bench_diff.py [--dir .] [--threshold 0.15] [--json]
 
@@ -41,9 +48,17 @@ from typing import Dict, List, Optional, Tuple
 # and counts drift for legitimate reasons (deeper coverage, more queries)
 _HIGHER_BETTER = re.compile(
     r"(rows_per_sec|queries_per_sec|roofline_util|utilization"
-    r"|queries_per_s)$")
+    r"|queries_per_s|speedup)$")
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+#: artifact families and their globs; the two on/off tracker families
+#: (autotune, sortwin) share one schema and one extractor
+_KINDS = (("bench", "BENCH_r*.json"),
+          ("multichip", "MULTICHIP_r*.json"),
+          ("autotune", "BENCH_AUTOTUNE_r*.json"),
+          ("sortwin", "BENCH_SORTWIN_r*.json"))
+_ONOFF_KINDS = frozenset({"autotune", "sortwin"})
 
 
 def _json_lines(tail: str) -> List[Dict]:
@@ -106,11 +121,33 @@ def extract_metrics(doc: Dict) -> Dict[str, float]:
     return metrics
 
 
+def extract_onoff_metrics(doc: Dict) -> Dict[str, float]:
+    """Normalize an on/off tracker artifact (BENCH_AUTOTUNE_r*,
+    BENCH_SORTWIN_r*) into {metric_name: value}.
+
+    Per query: ``speedup`` = wall_off_ms / wall_on_ms (>1 means the
+    feature won; higher is better, so a later round losing a win it
+    used to have trips the gate) and ``roofline_util`` when the round
+    recorded it. A query with ``identical: false`` contributes nothing
+    — a wrong answer has no legitimate speed.
+    """
+    metrics: Dict[str, float] = {}
+    for q, row in sorted((doc.get("queries") or {}).items()):
+        if not isinstance(row, dict) or row.get("identical") is False:
+            continue
+        off, on = _num(row.get("wall_off_ms")), _num(row.get("wall_on_ms"))
+        if off is not None and on is not None and on > 0:
+            metrics[f"query:{q}:speedup"] = round(off / on, 4)
+        u = _num(row.get("roofline_util"))
+        if u is not None:
+            metrics[f"query:{q}:roofline_util"] = u
+    return metrics
+
+
 def load_rounds(bench_dir: str) -> List[Dict]:
     """Every BENCH_r*/MULTICHIP_r* artifact, sorted by (kind, round)."""
     rounds = []
-    for kind, pattern in (("bench", "BENCH_r*.json"),
-                          ("multichip", "MULTICHIP_r*.json")):
+    for kind, pattern in _KINDS:
         for path in sorted(glob.glob(os.path.join(bench_dir, pattern))):
             m = _ROUND_RE.search(path)
             if not m:
@@ -129,6 +166,14 @@ def load_rounds(bench_dir: str) -> List[Dict]:
                 degraded = f"rc={rc}"
             elif "parsed" in doc and doc.get("parsed") is None:
                 degraded = "parsed: null"
+            elif kind in _ONOFF_KINDS:
+                bad = [q for q, row in (doc.get("queries") or {}).items()
+                       if isinstance(row, dict)
+                       and row.get("identical") is False]
+                if bad:
+                    degraded = f"non-identical results: {sorted(bad)}"
+            extract = (extract_onoff_metrics if kind in _ONOFF_KINDS
+                       else extract_metrics)
             rounds.append({
                 "kind": kind,
                 "round": int(m.group(1)),
@@ -137,7 +182,7 @@ def load_rounds(bench_dir: str) -> List[Dict]:
                 "degraded": degraded,
                 # a degraded round contributes NO baselines: its numbers
                 # (if any survived in the tail) are untrustworthy
-                "metrics": {} if degraded else extract_metrics(doc),
+                "metrics": {} if degraded else extract(doc),
             })
     rounds.sort(key=lambda r: (r["kind"], r["round"]))
     return rounds

@@ -1,0 +1,199 @@
+#!/usr/bin/env python3
+"""Sort/window kernel on/off tracker bench -> BENCH_SORTWIN_r*.json.
+
+Measures the PR-18 device sort & window paths against the pre-PR
+formulations on the SAME process and data: "off" pins the legacy paths
+(no radix pack, no out-of-core merge path, no autotuned dispatch, Pallas
+scans off); "on" is the shipping default (autotune enabled over a
+hermetic per-run store so measured dispatch can kick in). Because every
+alternative path is an order-equivalent rewrite, results must be
+BIT-IDENTICAL — a query whose on/off rows differ is reported
+``identical: false`` and poisons the round (tools/bench_diff.py treats
+it as degraded).
+
+Per query the artifact records best-of wall on each side, the on/off
+ratio, the dispatch paths the profile saw, and ``roofline_util``
+(bytes-touched / execute-time / delivered-bandwidth ceiling, the
+bench.py formulation). After the warm passes it also renders one
+``explain_analyze`` and keeps the dispatch lines — the acceptance check
+that warm sort/window dispatch reports ``source=measured``.
+
+Usage:
+    python tools/bench_sortwin.py [--sf 0.02] [--runs 3] [--warm 3]
+        [--queries q12,q44,q47,q67] [--out BENCH_SORTWIN_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# window-heavy (q12/q47 rolling + ratio windows, q67 rank over a wide
+# rollup) and sort-heavy (q44 double rank + top/bottom sorts) tracker
+# queries, all 'ok' in docs/tpcds_status.json
+DEFAULT_QUERIES = "q12,q44,q47,q67"
+
+
+def _canon(rows):
+    return sorted((tuple(repr(v) for v in r.values()) for r in rows))
+
+
+def _roofline(n=1 << 24, reps=2):
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones(n, jnp.float32)
+    x.block_until_ready()
+
+    @jax.jit
+    def red(v, s):
+        return jnp.sum(v * (1.0 + s))
+
+    red(x, 0.0).block_until_ready()
+    best = 0.0
+    for r in range(reps):
+        t0 = time.perf_counter()
+        outs = [red(x, 1e-9 * (r * 4 + i)) for i in range(4)]
+        for o in outs:
+            o.block_until_ready()
+        best = max(best, 4 * n / ((time.perf_counter() - t0) / 4))
+    return best
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sf", type=float, default=0.02)
+    ap.add_argument("--runs", type=int, default=3,
+                    help="interleaved off/on timing pairs per query")
+    ap.add_argument("--warm", type=int, default=3,
+                    help="autotune-on warm passes before timing")
+    ap.add_argument("--queries", default=DEFAULT_QUERIES)
+    ap.add_argument("--out", default="BENCH_SORTWIN_r01.json")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.bench import tpcds_queries as Q
+    from spark_rapids_tpu.bench.tpcds_schema import tables_for
+    from spark_rapids_tpu.config.conf import RapidsConf
+    from spark_rapids_tpu.exec import kernels as K
+    from spark_rapids_tpu.plan import from_arrow
+
+    store = tempfile.mkdtemp(prefix="srtpu_sortwin_at_")
+    on_conf = RapidsConf({"spark.rapids.tpu.autotune.dir": store})
+    off_conf = RapidsConf({
+        "spark.rapids.tpu.sql.sort.radixPack": False,
+        "spark.rapids.tpu.sql.sort.outOfCore.mergePath": False,
+        "spark.rapids.tpu.autotune.enabled": False,
+        "spark.rapids.tpu.sql.kernel.sortWindow.pallasMode": "off",
+    })
+
+    tables = tables_for(args.sf)
+    roofline = _roofline()
+
+    def run_query(name, conf):
+        dfs = {}
+        for k, v in tables.items():
+            df = from_arrow(v, conf)
+            df.shuffle_partitions = 2
+            dfs[k] = df
+        out = Q.QUERIES[name](dfs)
+        t0 = time.perf_counter()
+        rows = out.collect()
+        wall = (time.perf_counter() - t0) * 1e3
+        return rows, wall, out
+
+    names = [q.strip() for q in args.queries.split(",") if q.strip()]
+    queries, explain_excerpt = {}, []
+    for name in names:
+        if name not in Q.QUERIES:
+            print(f"{name}: not in registry, skipped", file=sys.stderr)
+            continue
+        for _ in range(args.warm):
+            run_query(name, on_conf)
+        best_off = best_on = float("inf")
+        rows_off = rows_on = None
+        last_df = None
+        for _ in range(args.runs):
+            r_off, w_off, _ = run_query(name, off_conf)
+            r_on, w_on, last_df = run_query(name, on_conf)
+            best_off, best_on = min(best_off, w_off), min(best_on, w_on)
+            rows_off, rows_on = r_off, r_on
+        prof = last_df.last_profile()
+        dispatch = prof.dispatch_paths() if prof else {}
+        # bytes the query touched (bench.py formulation): inputs read
+        # once + pooled allocations + spill round trips, over execute time
+        input_bytes = sum(t.nbytes for t in tables.values())
+        mem_ops = (prof.memory.get("ops", {}) if prof else {})
+        alloc = sum(int(g.get("allocd", 0)) for g in mem_ops.values())
+        spill = sum(prof.task_metrics.get(f, 0) for f in
+                    ("spill_to_host_bytes", "spill_to_disk_bytes",
+                     "read_spill_bytes")) if prof else 0
+        ex_s = ((prof.phases.get("execute") or prof.wall_ns / 1e6) / 1e3
+                if prof else best_on / 1e3)
+        queries[name] = {
+            "wall_off_ms": round(best_off, 2),
+            "wall_on_ms": round(best_on, 2),
+            "ratio": round(best_on / best_off, 4) if best_off else None,
+            "identical": _canon(rows_off) == _canon(rows_on),
+            "rows": len(rows_on),
+            "dispatch_paths": dispatch,
+            "roofline_util": (round(
+                (input_bytes + alloc + spill) / ex_s / roofline, 6)
+                if ex_s > 0 else None),
+        }
+        print(f"{name}: off={best_off:.1f}ms on={best_on:.1f}ms "
+              f"identical={queries[name]['identical']} "
+              f"dispatch={dispatch}", flush=True)
+        if last_df is not None:
+            # keep the sort/window dispatch lines; measured ones first —
+            # the warm-store acceptance evidence (docs/adaptive_dispatch.md)
+            explain_excerpt.extend(
+                f"{name}: {ln.strip()}"
+                for ln in last_df.explain_analyze().splitlines()
+                if "source=" in ln and ("TpuSort" in ln or "TpuWindow" in ln))
+    explain_excerpt = (
+        sorted(explain_excerpt,
+               key=lambda ln: "source=measured" not in ln)[:12])
+
+    counters = {k: v for k, v in K.counters().items()
+                if k.startswith(("sort_", "window_", "sortwin_"))}
+    measured = sorted({k.rsplit(":", 1)[0] for q in queries.values()
+                       for k in q["dispatch_paths"]
+                       if k.endswith(":measured")})
+    doc = {
+        "sf": args.sf,
+        "counters": counters,
+        "queries": queries,
+        "measured_paths": measured,
+        "explain_analyze_dispatch_lines": explain_excerpt,
+        "methodology": (
+            "On/off tracker comparison on one process and dataset: "
+            f"{args.warm} autotune-on warm passes populate a hermetic "
+            "timing store, then per query "
+            f"{args.runs} interleaved off/on pairs, best wall per side, "
+            "ratio = min(on)/min(off). off pins radixPack=false, "
+            "outOfCore.mergePath=false, autotune.enabled=false, "
+            "sortWindow.pallasMode=off. Rows compared exactly "
+            "(repr-canonical): every alternative path is an "
+            "order-equivalent rewrite, so on/off must be bit-identical. "
+            "roofline_util = bytes_touched / execute_s / delivered "
+            "reduce bandwidth (bench.py formulation)."),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    bad = [q for q, e in queries.items() if not e["identical"]]
+    if bad:
+        print(f"NON-IDENTICAL on/off results: {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+"""pallas-fallback pass: every Pallas kernel keeps a reachable XLA exit.
+
+The kernel contract (docs/kernels.md): Pallas is an OPTIMIZATION, never a
+correctness dependency. Each ``pl.pallas_call`` site lives in a
+``*_pallas`` wrapper; a dispatcher calls the wrapper inside try/except,
+latches a module-global ``*_broken`` sticky flag on any failure, and
+falls through to the pure-XLA formulation — so a lowering failure on a
+new platform degrades to XLA instead of failing the query. The wrapper
+also forwards ``interpret=`` into ``pallas_call`` so the CPU test lane
+can execute the kernel through the Pallas interpreter.
+
+This pass breaks when the contract breaks:
+
+1. a ``pallas_call`` appears outside a ``*_pallas`` wrapper (no
+   dispatch seam to fall back through);
+2. a ``*_pallas`` wrapper doesn't forward ``interpret`` (the CPU lane
+   can no longer cover the kernel);
+3. no dispatcher try/excepts the wrapper with a sticky ``*_broken``
+   latch, or the dispatcher has no reference to the XLA alternative
+   (``<base>`` or ``<base>_xla`` for wrapper ``<base>_pallas``).
+
+It also extends the cache-keys static-arg guard to the sort kernels:
+``exec/sort.py`` jit entry points whose non-batch parameters shape the
+compiled program (sort specs, dispatch path, merge key layout) must
+declare them static — a traced-value key would silently reuse a kernel
+compiled for a different sort. Pure AST, no imports of the checked code.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.lint import core
+from tools.lint.core import register
+
+
+def _functions(tree):
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _calls_name(node: ast.AST, name: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Name) and f.id == name:
+                return True
+            if isinstance(f, ast.Attribute) and f.attr == name:
+                return True
+    return False
+
+
+def _mentions_name(node: ast.AST, name: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == name:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == name:
+            return True
+    return False
+
+
+def _pallas_call_sites(fn: ast.AST):
+    out = []
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr == "pallas_call":
+            out.append(sub)
+    return out
+
+
+def _check_kernels(violations: list, root: str) -> None:
+    path = os.path.join(core.pkg_dir(root), "exec", "kernels.py")
+    rel = os.path.relpath(path, root)
+    tree = core.parse(path)
+    fns = _functions(tree)
+    wrappers = []
+    for fn in fns:
+        sites = _pallas_call_sites(fn)
+        if not sites:
+            continue
+        if not fn.name.endswith("_pallas"):
+            violations.append(
+                f"{rel}:{fn.lineno}: pallas_call in {fn.name}() — Pallas "
+                "kernels must live in a *_pallas wrapper behind a "
+                "dispatcher with a sticky XLA fallback")
+            continue
+        wrappers.append(fn)
+        args = {a.arg for a in fn.args.args} | {
+            a.arg for a in fn.args.kwonlyargs}
+        fwd = any(kw.arg == "interpret" for c in sites for kw in c.keywords)
+        if "interpret" not in args or not fwd:
+            violations.append(
+                f"{rel}:{fn.lineno}: {fn.name}() must take interpret= and "
+                "forward it to pallas_call — the CPU test lane covers "
+                "Pallas kernels through the interpreter")
+    if not wrappers:
+        violations.append(
+            f"{rel}: no *_pallas kernels found (kernels moved? update "
+            "tools/lint/pallas_fallback.py)")
+        return
+    for fn in wrappers:
+        base = fn.name[: -len("_pallas")]
+        guarded = False
+        for other in fns:
+            if other.name == fn.name:
+                continue
+            for t in (s for s in ast.walk(other) if isinstance(s, ast.Try)):
+                if not _calls_name(t, fn.name):
+                    continue
+                latch = any(
+                    isinstance(s, ast.Assign) and any(
+                        isinstance(tgt, ast.Name)
+                        and tgt.id.endswith("_broken")
+                        for tgt in s.targets)
+                    for h in t.handlers for s in ast.walk(h))
+                xla = (_mentions_name(other, base)
+                       or _mentions_name(other, base + "_xla"))
+                if latch and xla:
+                    guarded = True
+        if not guarded:
+            violations.append(
+                f"{rel}:{fn.lineno}: {fn.name}() has no dispatcher that "
+                "try/excepts it with a sticky *_broken latch AND falls "
+                f"back to {base}()/{base}_xla() — a lowering failure "
+                "would fail the query instead of degrading to XLA")
+
+
+# jit entry points in exec/sort.py whose non-batch params are compile
+# keys: (function name, params that must be static)
+_SORT_STATIC = {
+    "_sort_run": ("specs", "path"),
+    "_merge_gather": ("col", "ascending", "nulls_first"),
+}
+
+
+def _static_positions(fn: ast.FunctionDef):
+    args = [a.arg for a in fn.args.args]
+    static = set()
+    for dec in ast.walk(ast.Module(body=[*fn.decorator_list], type_ignores=[])):
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            try:
+                v = ast.literal_eval(kw.value)
+            except ValueError:
+                continue
+            for s in (v if isinstance(v, (tuple, list)) else (v,)):
+                if isinstance(s, str) and s in args:
+                    static.add(args.index(s))
+                elif isinstance(s, int):
+                    static.add(s)
+    return args, static
+
+
+def _check_sort_static(violations: list, root: str) -> None:
+    path = os.path.join(core.pkg_dir(root), "exec", "sort.py")
+    rel = os.path.relpath(path, root)
+    tree = core.parse(path)
+    found = set()
+    for fn in _functions(tree):
+        if fn.name not in _SORT_STATIC:
+            continue
+        found.add(fn.name)
+        args, static = _static_positions(fn)
+        bad = [p for p in _SORT_STATIC[fn.name]
+               if p not in args or args.index(p) not in static]
+        if bad:
+            violations.append(
+                f"{rel}:{fn.lineno}: {fn.name}() must take {bad} as "
+                "static jit args — sort specs / dispatch paths shape the "
+                "compiled program, so a traced key would reuse a kernel "
+                "compiled for a different sort")
+    for name in _SORT_STATIC:
+        if name not in found:
+            violations.append(
+                f"{rel}: {name}() not found (sort kernels moved? update "
+                "tools/lint/pallas_fallback.py)")
+
+
+@register("pallas-fallback",
+          "every Pallas kernel has a reachable sticky XLA fallback, "
+          "interpret coverage, and static sort-kernel jit args")
+def run_pass(root: str) -> list:
+    violations: list = []
+    _check_kernels(violations, root)
+    _check_sort_static(violations, root)
+    return violations

@@ -32,6 +32,7 @@ from tools.lint import core  # noqa: E402
 from tools.lint import gauge_catalog  # noqa: E402,F401
 from tools.lint import span_catalog  # noqa: E402,F401
 from tools.lint import cache_keys  # noqa: E402,F401
+from tools.lint import pallas_fallback  # noqa: E402,F401
 from tools.lint import type_support  # noqa: E402,F401
 from tools.lint import jit_purity  # noqa: E402,F401
 from tools.lint import conf_keys  # noqa: E402,F401
