@@ -1054,6 +1054,239 @@ def clients_main(budget_s=None, clients=8, faults_spec=None,
                          f"(stats={stats})")
 
 
+def _serve_open_guard(environ):
+    """--serve-open gates remote-vs-in-process bit-identity; refuse the
+    BENCH_* overrides that would change what the gate compares. SO_*
+    knobs (scale, lambda steps, window) tune load, not the comparison."""
+    banned = [k for k in ("BENCH_SF_H", "BENCH_SF_DS", "BENCH_RUNS",
+                          "BENCH_DEPTH") if k in environ]
+    if banned:
+        raise SystemExit(
+            f"--serve-open is set: refusing to run with correctness-gate "
+            f"overrides {banned} (the open-workload lane gates remote-vs-"
+            f"in-process bit-identity and must control its own inputs)")
+
+
+def serve_open_main(budget_s=None, out_path="artifacts/serve_open.json"):
+    """Open-workload overload lane: Poisson arrivals submit TPC-H q1/q6
+    OVER THE WIRE (net/ front-end, two authenticated tenants) at stepped
+    offered loads; the server runs deliberately small (max_concurrent /
+    max_queue) so the top step overloads it for real. Measures the
+    goodput-vs-offered-load curve and the per-tenant shed curve under
+    weighted fair-share admission. Gates: every completed remote result
+    bit-identical to in-process ``to_arrow()``, every non-completion a
+    TYPED shed (admission reason / deadline / local thread-cap — never an
+    unexplained error), shedding actually observed at the overload step,
+    and the HBM pool balanced after teardown. The final driver-metric
+    line is emitted even when the budget truncates steps (docs/net.md)."""
+    import random
+
+    from spark_rapids_tpu.bench import tpch
+    from spark_rapids_tpu.config import conf as C
+    from spark_rapids_tpu.mem.pool import get_pool
+    from spark_rapids_tpu.net import NetClient, QueryFrontend
+    from spark_rapids_tpu.net import metrics as netm
+    from spark_rapids_tpu.plan import from_arrow
+    from spark_rapids_tpu.serve import (AdmissionRejected,
+                                        QueryDeadlineExceeded, QueryServer)
+    from spark_rapids_tpu.serve import metrics as slo
+
+    _serve_open_guard(os.environ)
+    sf = float(os.environ.get("SO_SF", 0.02))
+    lambdas = [float(x) for x in
+               os.environ.get("SO_LAMBDAS", "4,16,48").split(",")]
+    window_s = float(os.environ.get("SO_WINDOW_S", 4.0))
+    seed = int(os.environ.get("SO_SEED", 42))
+    max_inflight = int(os.environ.get("SO_MAX_INFLIGHT", 256))
+    max_concurrent = int(os.environ.get("SO_MAX_CONCURRENT", 2))
+    max_queue = int(os.environ.get("SO_MAX_QUEUE", 8))
+    bud = _Budget(budget_s)
+    names = ["q1", "q6"]
+    tenants = [("gold", "tok-gold", 1), ("bronze", "tok-bronze", 0)]
+
+    _mark(f"serve-open lane: sf={sf} lambdas={lambdas} window={window_s}s "
+          f"server={max_concurrent}x/{max_queue}q")
+    tables = {"lineitem": tpch.gen_lineitem(sf, seed=7)}
+    expected = {}
+    for qn in names:
+        d = {k: from_arrow(v) for k, v in tables.items()}
+        expected[qn] = tpch.DF_QUERIES[qn](d).to_arrow()
+    _mark("in-process baseline done")
+
+    # single-flight off: the lane measures scheduling under load, and the
+    # repeated query mix would otherwise dedupe the queue empty
+    conf = C.RapidsConf({
+        C.SERVE_SINGLEFLIGHT.key: False,
+        C.SERVE_FAIRSHARE_ENABLED.key: True,
+        C.SERVE_FAIRSHARE_WEIGHTS.key: "gold=3,bronze=1",
+        C.NET_AUTH_TOKENS.key: "tok-gold=gold,tok-bronze=bronze",
+    })
+    srv = QueryServer(conf, max_concurrent=max_concurrent,
+                      max_queue=max_queue)
+    fe = QueryFrontend(srv, tables=tables)
+
+    points = []
+    totals = {"arrivals": 0, "completed": 0, "mismatch": 0, "untyped": 0}
+    shed_curve = {}  # tenant -> reason -> count (lane total)
+    gates = {}
+    try:
+        for lam in lambdas:
+            if bud.enabled and bud.remaining() < window_s + 2:
+                _mark(f"budget: skipping lambda={lam:g} and beyond")
+                break
+            rng = random.Random(seed + int(lam * 1000))
+            cap = threading.BoundedSemaphore(max_inflight)
+            lock = threading.Lock()
+            stats = {"arrivals": 0, "completed": 0, "mismatch": 0,
+                     "untyped": 0, "local-cap": 0}
+            sheds = {}  # tenant -> reason -> count (this step)
+            walls = []
+            threads = []
+
+            def shed(tenant, reason):
+                with lock:
+                    sheds.setdefault(tenant, {})
+                    sheds[tenant][reason] = sheds[tenant].get(reason, 0) + 1
+
+            def one_arrival(i, lam=lam, rng_pick=None):
+                qn = names[i % len(names)]
+                tenant, token, prio = tenants[rng_pick]
+                t0 = time.perf_counter()
+                try:
+                    with NetClient(fe.host, fe.port, token=token) as cl:
+                        d = {k: cl.table(k, partitions=2) for k in tables}
+                        out = cl.submit(tpch.DF_QUERIES[qn](d), priority=prio,
+                                        deadline_ms=60_000,
+                                        name=f"so-{lam:g}-{i}", timeout_s=120)
+                except AdmissionRejected as e:
+                    shed(tenant, e.reason)
+                    return
+                except QueryDeadlineExceeded:
+                    shed(tenant, "deadline")
+                    return
+                except Exception as e:  # noqa: BLE001 — gate counts these
+                    with lock:
+                        stats["untyped"] += 1
+                    _mark(f"UNTYPED failure: {type(e).__name__}: {e}")
+                    return
+                finally:
+                    cap.release()
+                with lock:
+                    walls.append(time.perf_counter() - t0)
+                    stats["completed"] += 1
+                    if not out.equals(expected[qn]):
+                        stats["mismatch"] += 1
+
+            t_start = time.perf_counter()
+            t_end = t_start + window_s
+            next_at = t_start
+            i = 0
+            while time.perf_counter() < t_end:
+                now = time.perf_counter()
+                if now < next_at:
+                    time.sleep(min(next_at - now, 0.05))
+                    continue
+                next_at += rng.expovariate(lam)
+                stats["arrivals"] += 1
+                # typed local shed: the driver itself refuses to hold more
+                # than max_inflight submission threads open
+                if not cap.acquire(blocking=False):
+                    stats["local-cap"] += 1
+                    tenant = tenants[rng.randrange(len(tenants))][0]
+                    shed(tenant, "local-cap")
+                    i += 1
+                    continue
+                th = threading.Thread(
+                    target=one_arrival, args=(i,),
+                    kwargs={"rng_pick": rng.randrange(len(tenants))},
+                    name=f"so-arrival-{i}", daemon=True)
+                th.start()
+                threads.append(th)
+                i += 1
+            for th in threads:
+                th.join(timeout=180)
+            step_s = time.perf_counter() - t_start
+            shed_total = sum(n for per in sheds.values()
+                             for n in per.values())
+            point = {
+                "lambda": lam,
+                "offered_per_s": round(stats["arrivals"] / step_s, 3),
+                "goodput_per_s": round(stats["completed"] / step_s, 3),
+                "shed_per_s": round(shed_total / step_s, 3),
+                "wall_ms": _pctiles_ms(walls),
+                "arrivals": stats["arrivals"],
+                "completed": stats["completed"],
+                "sheds": {t: dict(per) for t, per in sorted(sheds.items())},
+                "untyped": stats["untyped"],
+            }
+            points.append(point)
+            for t, per in sheds.items():
+                agg = shed_curve.setdefault(t, {})
+                for r, n in per.items():
+                    agg[r] = agg.get(r, 0) + n
+            for k in ("arrivals", "completed", "mismatch", "untyped"):
+                totals[k] += stats[k]
+            _mark(f"lambda={lam:g}: offered={point['offered_per_s']}/s "
+                  f"goodput={point['goodput_per_s']}/s "
+                  f"shed={point['shed_per_s']}/s untyped={stats['untyped']}")
+    finally:
+        fe.close()
+        srv.close()
+        gates["bit_identical"] = (totals["mismatch"] == 0
+                                  and totals["completed"] > 0)
+        gates["typed_sheds_only"] = totals["untyped"] == 0
+        # the top offered-load step must actually overload the small
+        # server: at least one typed shed observed there
+        gates["sheds_at_overload"] = bool(points) and (
+            sum(n for per in points[-1]["sheds"].values()
+                for n in per.values()) > 0)
+        gates["pool_balanced"] = get_pool().used == 0
+        goodput = max((p["goodput_per_s"] for p in points), default=0.0)
+        tenant_slos = {f"{t}/p{p}": v
+                       for (t, p), v in sorted(slo.tenant_slos().items())}
+        artifact = {
+            "sf": sf, "window_s": window_s, "seed": seed,
+            "max_inflight": max_inflight,
+            "server": {"max_concurrent": max_concurrent,
+                       "max_queue": max_queue,
+                       "fairshare_weights": "gold=3,bronze=1"},
+            "queries": names, "points": points, "totals": totals,
+            "shed_curve": {t: dict(per)
+                           for t, per in sorted(shed_curve.items())},
+            "net": netm.counters(), "tenant_slos": tenant_slos,
+            "gates": gates,
+        }
+        out_dir = os.path.dirname(out_path)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(json.dumps({"serve_open": artifact}))
+        for p in points:
+            print(json.dumps({
+                "metric": f"serve_open:lam{p['lambda']:g}:queries_per_s",
+                "value": p["goodput_per_s"],
+                "unit": "queries/s",
+                "offered_per_s": p["offered_per_s"],
+                "shed_per_s": p["shed_per_s"],
+            }))
+        print(json.dumps({
+            "metric": "serve_open_goodput_queries_per_s",
+            "value": goodput,
+            "unit": "queries/s",
+            "points": len(points),
+            "arrivals": totals["arrivals"],
+            "completed": totals["completed"],
+            "shed_curve": {t: dict(per)
+                           for t, per in sorted(shed_curve.items())},
+            "gates_passed": all(gates.values()) if gates else False,
+        }))
+    if gates and not all(gates.values()):
+        raise SystemExit(f"serve-open gates failed: "
+                         f"{[k for k, v in gates.items() if not v]} "
+                         f"(totals={totals})")
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -1100,6 +1333,21 @@ if __name__ == "__main__":
     ap.add_argument("--clients-out", type=str,
                     default="artifacts/serve_clients.json", metavar="PATH",
                     help="artifact path for --clients results")
+    ap.add_argument("--serve-open", action="store_true",
+                    help="run the open-workload overload lane instead of "
+                         "the throughput sweep: Poisson arrivals submit "
+                         "q1/q6 over the network front-end (two "
+                         "authenticated tenants, weighted fair-share) at "
+                         "stepped offered loads against a deliberately "
+                         "small server; gates remote-vs-in-process bit-"
+                         "identity, typed-sheds-only, shedding at the "
+                         "overload step, and pool balance; reports the "
+                         "goodput-vs-offered-load curve and per-tenant "
+                         "shed curve (docs/net.md). SO_* env knobs tune "
+                         "lambda steps/window/scale")
+    ap.add_argument("--serve-open-out", type=str,
+                    default="artifacts/serve_open.json", metavar="PATH",
+                    help="artifact path for --serve-open results")
     _args = ap.parse_args()
     if _args.budget is None and not sys.stdout.isatty():
         # non-interactive bare run (CI/harness): a full unbudgeted sweep can
@@ -1108,6 +1356,9 @@ if __name__ == "__main__":
         _args.budget = float(os.environ.get("SRTPU_BENCH_BUDGET_S", "600"))
     if _args.latency:
         latency_main(budget_s=_args.budget, out_path=_args.latency_out)
+    elif _args.serve_open:
+        serve_open_main(budget_s=_args.budget,
+                        out_path=_args.serve_open_out)
     elif _args.clients is not None:
         clients_main(budget_s=_args.budget, clients=_args.clients,
                      faults_spec=_args.faults, out_path=_args.clients_out)

@@ -738,6 +738,40 @@ REUSE_CACHE_MAX_ENTRIES = conf(
         "materialization cache at once.",
     check=lambda v: None if v >= 1 else "must be >= 1")
 
+REUSE_EVICT_ENABLED = conf(
+    "spark.rapids.tpu.sql.exchange.reuse.eviction.enabled", default=True,
+    doc="When the materialization cache is full, evict idle cached "
+        "entries (no active reader) by ascending retention score instead "
+        "of refusing the new entry outright. The score combines rebuild "
+        "cost (cached bytes as the proxy), recency of last access, and "
+        "the owning tenant's fair-share weight, so a hot tenant cannot "
+        "starve the cache (exec/reuse.py; docs/net.md). Disabled, a full "
+        "cache denies admission exactly as before.")
+
+REUSE_EVICT_COST_WEIGHT = conf(
+    "spark.rapids.tpu.sql.exchange.reuse.eviction.costWeight", default=1.0,
+    doc="Weight of the rebuild-cost term (log2 of cached bytes) in the "
+        "eviction retention score. 0 removes size from the decision.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
+REUSE_EVICT_RECENCY_HALFLIFE_S = conf(
+    "spark.rapids.tpu.sql.exchange.reuse.eviction.recencyHalfLifeS",
+    default=300.0,
+    doc="Half-life in seconds of the recency term in the eviction "
+        "retention score: an entry's recency value halves every interval "
+        "of this length since its last access, so stale entries decay "
+        "toward eviction.",
+    check=lambda v: None if v > 0 else "must be > 0")
+
+REUSE_EVICT_TENANT_WEIGHT = conf(
+    "spark.rapids.tpu.sql.exchange.reuse.eviction.tenantWeight",
+    default=1.0,
+    doc="Strength of the tenant term in the eviction retention score: "
+        "entries cached on behalf of tenants with a higher "
+        "serve.fairshare.weights share survive longer under pressure. 0 "
+        "makes eviction tenant-blind.",
+    check=lambda v: None if v >= 0 else "must be >= 0")
+
 
 # ---------------------------------------------------------------------------
 # Round-9 interactive-latency knobs (plan/plan_cache.py, exec/jit_persist.py,
@@ -902,6 +936,98 @@ SERVE_SLO_MAX_TENANTS = conf(
         "from tenants past the cap are folded into the 'overflow' tenant "
         "so an unbounded tenant-id stream cannot grow label cardinality "
         "without bound (serve/metrics.py).",
+    check=lambda v: None if v >= 1 else "must be >= 1")
+
+SERVE_EDF_ENABLED = conf(
+    "spark.rapids.tpu.serve.edf.enabled", default=True,
+    doc="Deadline-aware ordering within a priority band: among queued "
+        "queries of equal priority the one with the earliest absolute "
+        "deadline runs first (EDF); queries without a deadline sort after "
+        "every deadlined one and stay FIFO among themselves. Disabled, "
+        "order within a band is pure FIFO (serve/server.py; "
+        "docs/serving.md).")
+
+SERVE_FAIRSHARE_ENABLED = conf(
+    "spark.rapids.tpu.serve.fairshare.enabled", default=False,
+    doc="Per-tenant weighted fair-share admission: each tenant's queued "
+        "submissions are capped at its quota — its share of "
+        "serve.queue.maxDepth under serve.fairshare.weights — and a "
+        "submission past quota is shed with "
+        "AdmissionRejected(reason='quota') while other tenants' slots "
+        "stay available (serve/admission.py; docs/net.md).")
+
+SERVE_FAIRSHARE_WEIGHTS = conf(
+    "spark.rapids.tpu.serve.fairshare.weights", default="",
+    doc="Comma-separated 'tenant=weight' relative shares for fair-share "
+        "admission and tenant-weighted cache eviction, e.g. "
+        "'dashboards=3,adhoc=1'. A tenant not listed gets "
+        "serve.fairshare.defaultWeight. Each tenant's queue quota is "
+        "max(1, floor(maxDepth * weight / total declared weight)).")
+
+SERVE_FAIRSHARE_DEFAULT_WEIGHT = conf(
+    "spark.rapids.tpu.serve.fairshare.defaultWeight", default=1.0,
+    doc="Relative share assigned to tenants absent from "
+        "serve.fairshare.weights (and to the None tenant).",
+    check=lambda v: None if v > 0 else "must be > 0")
+
+
+# ---------------------------------------------------------------------------
+# Round-19 network front-end knobs (spark_rapids_tpu/net/; docs/net.md)
+# ---------------------------------------------------------------------------
+
+NET_HOST = conf(
+    "spark.rapids.tpu.net.host", default="127.0.0.1",
+    doc="Interface the network front-end (net/frontend.py) binds its "
+        "listening socket to.")
+
+NET_PORT = conf(
+    "spark.rapids.tpu.net.port", default=0,
+    doc="TCP port for the network front-end; 0 picks an ephemeral port "
+        "(read the bound address from QueryFrontend.address).",
+    check=lambda v: None if 0 <= v <= 65535 else "must be in [0, 65535]")
+
+NET_MAX_FRAME_BYTES = conf(
+    "spark.rapids.tpu.net.maxFrameBytes", default=64 << 20,
+    doc="Upper bound on one wire frame's payload. A frame header "
+        "declaring more is rejected with a typed protocol error and the "
+        "connection is closed without reading the payload, so an "
+        "adversarial length cannot balloon server memory "
+        "(net/protocol.py).",
+    check=lambda v: None if v >= 1024 else "must be >= 1024")
+
+NET_AUTH_TOKENS = conf(
+    "spark.rapids.tpu.net.auth.tokens", default="",
+    doc="Comma-separated 'token=tenant' shared-secret credentials for "
+        "the front-end, e.g. 's3cret=dashboards,t0ken=adhoc'. A client "
+        "must AUTH with a listed token before SUBMIT is accepted; its "
+        "session is pinned to the mapped tenant id. Empty (the default) "
+        "runs the front-end in open mode: any token authenticates as the "
+        "'default' tenant — for tests and single-tenant benches only "
+        "(net/session.py; docs/net.md).")
+
+NET_SESSION_IDLE_TIMEOUT_S = conf(
+    "spark.rapids.tpu.net.session.idleTimeoutS", default=300.0,
+    doc="Idle bound on an authenticated session: a connection with no "
+        "frame activity for this long is reaped — its socket closed and "
+        "any in-flight query cancelled (net/session.py).",
+    check=lambda v: None if v > 0 else "must be > 0")
+
+NET_SUBMIT_GATE_ENABLED = conf(
+    "spark.rapids.tpu.net.submitGate.enabled", default=True,
+    doc="Admission-time lowering gate at the wire: SUBMIT consults the "
+        "plan tagger (the PR-9 plan memo keeps repeats cheap) and the "
+        "type_support matrix, and a plan with any CPU-fallback node is "
+        "rejected with AdmissionRejected(reason='unsupported-plan') "
+        "carrying the offending (operator, type) cells — instead of "
+        "accepting work that degrades mid-execution "
+        "(serve/lowering.py; docs/net.md).")
+
+NET_STREAM_BATCH_ROWS = conf(
+    "spark.rapids.tpu.net.streamBatchRows", default=65536,
+    doc="Row cap per Arrow IPC record batch on the result stream. "
+        "Smaller batches give the client earlier first bytes and the "
+        "server finer-grained backpressure (each batch frame is one "
+        "blocking send); larger batches amortize framing overhead.",
     check=lambda v: None if v >= 1 else "must be >= 1")
 
 

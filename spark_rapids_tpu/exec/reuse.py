@@ -22,7 +22,9 @@ plan/reuse.py; this module owns what runs during the query:
 
 from __future__ import annotations
 
+import math
 import threading
+import time
 import weakref
 from typing import Callable, Dict, Iterator, List, Optional
 
@@ -41,6 +43,9 @@ _counters: Dict[str, int] = {
     "reuse_broadcasts_total": 0,
     "reuse_subqueries_total": 0,
     "reuse_bytes_saved_total": 0,
+    "reuse_evict_total": 0,
+    "reuse_evict_bytes_total": 0,
+    "reuse_evict_skipped_active_total": 0,
 }
 
 
@@ -83,13 +88,30 @@ class MaterializationCache:
     """Process-wide budget for cached exchange materializations. An entry
     denied admission becomes a passthrough: its consumers re-read from the
     shuffle manager, which is still one map-side materialization — the cap
-    only bounds reduce-side batch pinning, never correctness."""
+    only bounds reduce-side batch pinning, never correctness.
+
+    Round 19 adds scored eviction behind the byte/entry caps
+    (``exchange.reuse.eviction.*``): when a full cache would deny a new
+    materialization, the lowest-retention idle entries are evicted to
+    make room instead. Retention per admitted entry::
+
+        costWeight   * log2(bytes + 1)        # recompute cost proxy
+      + 2^(-idle_s / recencyHalfLifeS)        # recency, half-life decay
+      + tenantWeight * fair-share weight      # serve.fairshare.weights
+
+    so a hot tenant's small-but-fresh entries outlive a cold tenant's
+    stale ones, and a single tenant can no longer starve the cache just
+    by filling it first. Entries with a reader mid-replay are never
+    evicted (the ``_active_readers`` guard); denial stays the fallback
+    when nothing idle scores low enough to free the needed room."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.bytes_used = 0
         self.entry_count = 0
         self._admitted: set = set()  # id(entry)
+        # id(entry) -> {"ref": weakref, "nbytes", "last_access", "tenant"}
+        self._registry: Dict[int, Dict] = {}
 
     @staticmethod
     def _caps():
@@ -98,8 +120,41 @@ class MaterializationCache:
         return (C.REUSE_CACHE_MAX_BYTES.get(cfg),
                 C.REUSE_CACHE_MAX_ENTRIES.get(cfg))
 
+    @staticmethod
+    def _evict_conf():
+        from spark_rapids_tpu.config import conf as C
+        cfg = C.get_active()
+        try:
+            from spark_rapids_tpu.serve.admission import parse_weights
+            weights = parse_weights(C.SERVE_FAIRSHARE_WEIGHTS.get(cfg))
+        except ValueError:
+            weights = {}
+        return (C.REUSE_EVICT_ENABLED.get(cfg),
+                C.REUSE_EVICT_COST_WEIGHT.get(cfg),
+                C.REUSE_EVICT_RECENCY_HALFLIFE_S.get(cfg),
+                C.REUSE_EVICT_TENANT_WEIGHT.get(cfg),
+                weights,
+                C.SERVE_FAIRSHARE_DEFAULT_WEIGHT.get(cfg))
+
+    @staticmethod
+    def _current_tenant() -> str:
+        from spark_rapids_tpu.serve import context as _sctx
+        ctx = _sctx.current()
+        tenant = getattr(ctx, "tenant", None) if ctx is not None else None
+        return tenant or "default"
+
     def admit(self, entry, nbytes: int) -> bool:
         max_bytes, max_entries = self._caps()
+        if self._admit_locked(entry, nbytes, max_bytes, max_entries):
+            return True
+        enabled = self._evict_conf()[0]
+        if not enabled:
+            return False
+        self._make_room(entry, nbytes, max_bytes, max_entries)
+        return self._admit_locked(entry, nbytes, max_bytes, max_entries)
+
+    def _admit_locked(self, entry, nbytes: int, max_bytes: int,
+                      max_entries: int) -> bool:
         with self._lock:
             new_entry = id(entry) not in self._admitted
             if new_entry and self.entry_count >= max_entries:
@@ -110,7 +165,65 @@ class MaterializationCache:
                 self._admitted.add(id(entry))
                 self.entry_count += 1
             self.bytes_used += nbytes
+            rec = self._registry.setdefault(
+                id(entry), {"ref": weakref.ref(entry), "nbytes": 0,
+                            "last_access": time.monotonic(),
+                            "tenant": self._current_tenant()})
+            rec["nbytes"] += nbytes
+            rec["last_access"] = time.monotonic()
             return True
+
+    def touch(self, entry) -> None:
+        """A replay hit: refresh the entry's recency."""
+        with self._lock:
+            rec = self._registry.get(id(entry))
+            if rec is not None:
+                rec["last_access"] = time.monotonic()
+
+    def _retention(self, rec: Dict, now: float, cost_w: float,
+                   halflife_s: float, tenant_w: float,
+                   weights: Dict[str, float], default_w: float) -> float:
+        recency = (2.0 ** (-(now - rec["last_access"]) / halflife_s)
+                   if halflife_s > 0 else 0.0)
+        share = weights.get(rec["tenant"], default_w)
+        return (cost_w * math.log2(rec["nbytes"] + 1)
+                + recency + tenant_w * share)
+
+    def _make_room(self, entry, nbytes: int, max_bytes: int,
+                   max_entries: int) -> None:
+        """Evict idle low-retention entries until ``entry`` would fit.
+        Runs WITHOUT the cache lock held — eviction re-enters through
+        ``evict()``."""
+        _, cost_w, halflife_s, tenant_w, weights, default_w = (
+            self._evict_conf())
+        now = time.monotonic()
+        with self._lock:
+            candidates = []
+            for eid, rec in self._registry.items():
+                if eid == id(entry):
+                    continue
+                victim = rec["ref"]()
+                if victim is None:
+                    continue
+                score = self._retention(rec, now, cost_w, halflife_s,
+                                        tenant_w, weights, default_w)
+                candidates.append((score, eid, victim))
+            need_entry = id(entry) not in self._admitted
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        for _score, _eid, victim in candidates:
+            with self._lock:
+                fits = (self.bytes_used + nbytes <= max_bytes
+                        and (not need_entry
+                             or self.entry_count < max_entries))
+            if fits:
+                return
+            freed = victim.evict_cached()
+            if freed < 0:
+                note("reuse_evict_skipped_active_total")
+                continue
+            if freed > 0:
+                note("reuse_evict_total")
+                note("reuse_evict_bytes_total", freed)
 
     def evict(self, entry, nbytes: int) -> None:
         with self._lock:
@@ -118,6 +231,7 @@ class MaterializationCache:
             if id(entry) in self._admitted:
                 self._admitted.discard(id(entry))
                 self.entry_count -= 1
+            self._registry.pop(id(entry), None)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -159,6 +273,7 @@ class SharedExchangeEntry:
         self._parts: Dict[int, object] = {}
         self._initial_refs = 0
         self._refs = 0
+        self._active_readers = 0  # replays in flight: blocks eviction
         _live_entries.add(self)
 
     def retain(self, n: int = 1) -> None:
@@ -198,8 +313,17 @@ class SharedExchangeEntry:
                 return iter(batches)
         if cached is _UNCACHED:
             return producer()
-        note("reuse_bytes_saved_total", sum(h.nbytes for h in cached))
-        return self._replay(cached)
+        with self._lock:
+            # eviction may have raced us between the partition-lock block
+            # and here: re-check and take the reader guard atomically, so
+            # handles can never close under a replay
+            current = self._parts.get(partition)
+            if current is None or current is _UNCACHED:
+                return producer()
+            self._active_readers += 1
+        self._cache.touch(self)
+        note("reuse_bytes_saved_total", sum(h.nbytes for h in current))
+        return self._replay(current)
 
     def _try_cache(self, batches: List[ColumnarBatch]):
         from spark_rapids_tpu.mem.spill import SpillableBatch
@@ -226,11 +350,36 @@ class SharedExchangeEntry:
             return None
         return handles
 
-    @staticmethod
-    def _replay(handles):
-        for h in handles:
-            with h as batch:
-                yield batch
+    def _replay(self, handles):
+        try:
+            for h in handles:
+                with h as batch:
+                    yield batch
+        finally:
+            with self._lock:
+                self._active_readers -= 1
+
+    def evict_cached(self) -> int:
+        """Drop every cached partition (keeping refcounts — the entry
+        stays live and simply re-materializes on next read). Returns the
+        bytes freed, or -1 when a replay is in flight and the entry must
+        not be touched."""
+        with self._lock:
+            if self._active_readers > 0:
+                return -1
+            parts = {k: v for k, v in self._parts.items()
+                     if v is not _UNCACHED}
+            for k in parts:
+                del self._parts[k]
+        if not parts:
+            return 0
+        freed = 0
+        for handles in parts.values():
+            for h in handles:
+                freed += h.nbytes
+                h.close()
+        self._cache.evict(self, freed)
+        return freed
 
     def release(self) -> None:
         with self._lock:

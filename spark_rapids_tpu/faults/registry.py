@@ -21,7 +21,12 @@ Sites (see docs/fault_injection.md for the catalog): ``mem.alloc``,
 ``agg.repartition``, ``serve.admit`` (QueryServer.submit — an injected
 failure surfaces as a typed AdmissionRejected), ``serve.cancel``
 (QueryContext.check — fires at exactly the runtime's cancellation poll
-points, exercising the prompt-unwind path).
+points, exercising the prompt-unwind path), ``net.accept`` (front-end
+connection accept — a fault there drops the connection, never the
+listener), ``net.frame`` (per received frame — corrupt here proves the
+codec rejects damage without wedging the loop), ``net.stream`` (per
+streamed result batch — a fault mid-stream must cancel the query and
+release its admission reservation).
 
 Actions: ``retry`` (RetryOOM), ``split`` (SplitAndRetryOOM), ``drop``
 (TimeoutError), ``error`` (FaultInjectedError), ``corrupt`` (bit-flip,
@@ -49,7 +54,8 @@ from typing import Dict, List, Optional
 
 _SITES = ("mem.alloc", "mem.spill", "io.decode", "shuffle.serialize",
           "shuffle.fetch", "shuffle.block", "parallel.exchange", "executor",
-          "agg.repartition", "serve.admit", "serve.cancel")
+          "agg.repartition", "serve.admit", "serve.cancel",
+          "net.accept", "net.frame", "net.stream")
 _ACTIONS = ("retry", "split", "drop", "error", "corrupt", "slow", "stall",
             "kill")
 

@@ -1,0 +1,31 @@
+"""Network front-end: framed TCP + Arrow IPC serving for QueryServer.
+
+Layers (docs/net.md): protocol.py (frame codec + typed error codes),
+session.py (token -> tenant auth, idle reaping), frontend.py (accept
+loop + result streaming), client.py (blocking client). Import stays
+light — pyarrow and the plan layer load lazily inside the codec.
+"""
+
+from spark_rapids_tpu.net.client import NetClient
+from spark_rapids_tpu.net.frontend import QueryFrontend
+from spark_rapids_tpu.net.metrics import counters
+from spark_rapids_tpu.net.protocol import (
+    ConnectionClosed,
+    NetError,
+    ProtocolError,
+    TableRef,
+)
+from spark_rapids_tpu.net.session import AuthError, Session, SessionManager
+
+__all__ = [
+    "AuthError",
+    "ConnectionClosed",
+    "NetClient",
+    "NetError",
+    "ProtocolError",
+    "QueryFrontend",
+    "Session",
+    "SessionManager",
+    "TableRef",
+    "counters",
+]

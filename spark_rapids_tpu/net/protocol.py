@@ -1,0 +1,295 @@
+"""Length-prefixed frame codec for the network front-end wire protocol.
+
+One frame = a fixed 12-byte header followed by ``length`` payload bytes::
+
+    !4s B  B     H        I
+    SRTP ver ftype reserved length
+
+Frame types (docs/net.md): HELLO (server banner + table catalog),
+AUTH (shared-secret token), OK (auth/cancel ack), SUBMIT (pickled query
+payload), RESULT_START (Arrow schema), RESULT_BATCH (one Arrow IPC
+record-batch message), RESULT_END (stream summary), CANCEL, ERROR (typed
+code mirroring ``AdmissionRejected`` reasons plus the wire-only codes).
+
+Design constraints carried by this module:
+
+- **Bounded frames**: ``decode_header`` rejects a declared length past the
+  ``maxFrameBytes`` cap *before* any payload is read, so an adversarial
+  header cannot balloon server memory; bad magic/version are protocol
+  errors that close the connection, never wedge the accept loop.
+- **Arrow IPC for data**: result rows ride as record-batch IPC messages
+  (``pyarrow.ipc``), the zero-copy export analog of the reference's
+  ColumnarRdd (SURVEY §2.9). Control payloads are pickled dicts — the
+  same cross-process idiom as the cluster ctrl pipe (shuffle/cluster.py)
+  — and are only ever unpickled AFTER token auth succeeds.
+- **Named table refs**: a client-side plan references server-registered
+  tables through ``TableRef`` leaves, so the plan pickle stays small and
+  the server resolves every submission of a query against the SAME table
+  object — keeping the plan memo and single-flight dedup hot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import struct
+from typing import Dict, List, Tuple
+
+MAGIC = b"SRTP"
+VERSION = 1
+
+_HEADER = struct.Struct("!4sBBHI")
+HEADER_BYTES = _HEADER.size  # 12
+
+# frame types
+HELLO = 1
+AUTH = 2
+OK = 3
+SUBMIT = 4
+RESULT_START = 5
+RESULT_BATCH = 6
+RESULT_END = 7
+CANCEL = 8
+ERROR = 9
+
+_TYPES = (HELLO, AUTH, OK, SUBMIT, RESULT_START, RESULT_BATCH, RESULT_END,
+          CANCEL, ERROR)
+TYPE_NAMES = {HELLO: "HELLO", AUTH: "AUTH", OK: "OK", SUBMIT: "SUBMIT",
+              RESULT_START: "RESULT_START", RESULT_BATCH: "RESULT_BATCH",
+              RESULT_END: "RESULT_END", CANCEL: "CANCEL", ERROR: "ERROR"}
+
+# typed error codes: the AdmissionRejected reasons verbatim, plus the
+# wire-only conditions. ERROR payloads carry {"code", "message", "detail"}.
+ERROR_CODES = ("queue-full", "memory", "fault-injected", "shutdown",
+               "quota", "unsupported-plan", "auth", "protocol",
+               "cancelled", "deadline", "failed")
+
+
+class NetError(RuntimeError):
+    """Typed wire-level failure; ``code`` is one of ERROR_CODES."""
+
+    def __init__(self, code: str, message: str, detail=None):
+        super().__init__(message)
+        self.code = code
+        self.detail = detail
+
+
+class ProtocolError(NetError):
+    """Malformed frame (bad magic/version/type/oversized length)."""
+
+    def __init__(self, message: str):
+        super().__init__("protocol", message)
+
+
+class ConnectionClosed(NetError):
+    """Peer closed the connection mid-frame (or before one)."""
+
+    def __init__(self, message: str = "connection closed"):
+        super().__init__("protocol", message)
+
+
+def encode_frame(ftype: int, payload: bytes = b"") -> bytes:
+    if ftype not in _TYPES:
+        raise ProtocolError(f"unknown frame type {ftype}")
+    return _HEADER.pack(MAGIC, VERSION, ftype, 0, len(payload)) + payload
+
+
+def decode_header(header: bytes, max_bytes: int) -> Tuple[int, int]:
+    """Parse one 12-byte header into (ftype, payload length); raises
+    ProtocolError before any payload is read when the frame is bad."""
+    if len(header) != HEADER_BYTES:
+        raise ProtocolError(f"short header: {len(header)} bytes")
+    magic, version, ftype, _reserved, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise ProtocolError(f"unsupported protocol version {version}")
+    if ftype not in _TYPES:
+        raise ProtocolError(f"unknown frame type {ftype}")
+    if length > max_bytes:
+        raise ProtocolError(
+            f"frame payload {length} bytes exceeds the "
+            f"{max_bytes}-byte cap")
+    return ftype, length
+
+
+class FrameBuffer:
+    """Incremental decoder: feed arbitrary byte chunks, collect whole
+    frames. Used by the codec property tests to prove reassembly is
+    split-invariant; the socket paths use ``recv_frame`` directly."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
+        self._buf.extend(data)
+        frames: List[Tuple[int, bytes]] = []
+        while True:
+            if len(self._buf) < HEADER_BYTES:
+                return frames
+            ftype, length = decode_header(
+                bytes(self._buf[:HEADER_BYTES]), self.max_bytes)
+            if len(self._buf) < HEADER_BYTES + length:
+                return frames
+            payload = bytes(self._buf[HEADER_BYTES:HEADER_BYTES + length])
+            del self._buf[:HEADER_BYTES + length]
+            frames.append((ftype, payload))
+
+    def pending(self) -> int:
+        return len(self._buf)
+
+
+# ---------------------------------------------------------------------------
+# socket helpers
+# ---------------------------------------------------------------------------
+
+
+def recv_exact(sock, n: int) -> bytes:
+    """Read exactly ``n`` bytes or raise ConnectionClosed on EOF."""
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionClosed(
+                f"peer closed with {remaining} of {n} bytes outstanding")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock, max_bytes: int) -> Tuple[int, bytes]:
+    ftype, length = decode_header(recv_exact(sock, HEADER_BYTES), max_bytes)
+    payload = recv_exact(sock, length) if length else b""
+    return ftype, payload
+
+
+def send_frame(sock, ftype: int, payload: bytes = b"") -> int:
+    data = encode_frame(ftype, payload)
+    sock.sendall(data)
+    return len(data)
+
+
+# ---------------------------------------------------------------------------
+# control payloads (pickled dicts; unpickled only post-auth server-side)
+# ---------------------------------------------------------------------------
+
+
+def dump_obj(obj) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_obj(payload: bytes):
+    try:
+        return pickle.loads(payload)
+    except Exception as e:
+        raise ProtocolError(f"undecodable control payload: {e}") from e
+
+
+def error_payload(code: str, message: str, detail=None) -> bytes:
+    return dump_obj({"code": code, "message": message, "detail": detail})
+
+
+def raise_typed(doc: Dict) -> None:
+    """Client side: re-raise an ERROR payload as the typed exception the
+    in-process API would have raised."""
+    code = doc.get("code", "failed")
+    message = doc.get("message", "remote error")
+    detail = doc.get("detail")
+    if code in ("queue-full", "memory", "fault-injected", "shutdown",
+                "quota", "unsupported-plan"):
+        from spark_rapids_tpu.serve import AdmissionRejected
+        err = AdmissionRejected(code, message)
+        err.detail = detail
+        raise err
+    if code == "deadline":
+        from spark_rapids_tpu.serve import QueryDeadlineExceeded
+        raise QueryDeadlineExceeded(message)
+    if code == "cancelled":
+        from spark_rapids_tpu.serve import QueryCancelled
+        raise QueryCancelled(message)
+    raise NetError(code, message, detail)
+
+
+# ---------------------------------------------------------------------------
+# Arrow IPC result stream pieces
+# ---------------------------------------------------------------------------
+
+
+def encode_schema(schema) -> bytes:
+    return schema.serialize().to_pybytes()
+
+
+def decode_schema(data: bytes):
+    import pyarrow as pa
+    return pa.ipc.read_schema(pa.py_buffer(data))
+
+
+def encode_batch(batch) -> bytes:
+    """One record batch as an Arrow IPC message (no schema preamble — the
+    stream's schema rode RESULT_START)."""
+    return batch.serialize().to_pybytes()
+
+
+def decode_batch(data: bytes, schema):
+    import pyarrow as pa
+    return pa.ipc.read_record_batch(pa.py_buffer(data), schema)
+
+
+# ---------------------------------------------------------------------------
+# named table references
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TableRef:
+    """Plan leaf standing in for a server-registered table. Pickles small
+    (no data), and every submission referencing ``name`` resolves to the
+    server's one table object — so the plan memo and single-flight dedup
+    key identically across clients."""
+
+    name: str
+    batch_rows: int = 1 << 20
+    partitions: int = 1
+
+    @property
+    def children(self):
+        return []
+
+
+def _rebuild(plan, kids):
+    from spark_rapids_tpu.plan.overrides import _with_children
+    return _with_children(plan, kids)
+
+
+def strip_tables(plan, refs: Dict[int, Tuple[str, int, int]]):
+    """Client side: replace InMemoryScan leaves whose table identity is in
+    ``refs`` (id(table) -> (name, batch_rows, partitions)) with TableRef
+    placeholders; unknown tables stay embedded (pickled wholesale)."""
+    from spark_rapids_tpu.plan import logical as L
+    if isinstance(plan, L.InMemoryScan) and id(plan.table) in refs:
+        name, batch_rows, partitions = refs[id(plan.table)]
+        return TableRef(name, batch_rows, partitions)
+    kids = [strip_tables(c, refs) for c in plan.children]
+    if not plan.children:
+        return plan
+    return _rebuild(plan, kids)
+
+
+def resolve_tables(plan, catalog: Dict[str, object]):
+    """Server side: rebuild TableRef leaves into InMemoryScan over the
+    registered tables; an unknown name is a typed protocol error."""
+    from spark_rapids_tpu.plan import logical as L
+    if isinstance(plan, TableRef):
+        table = catalog.get(plan.name)
+        if table is None:
+            raise NetError(
+                "protocol",
+                f"unknown table {plan.name!r} (registered: "
+                f"{sorted(catalog)})")
+        return L.InMemoryScan(table, plan.batch_rows, plan.partitions)
+    kids = [resolve_tables(c, catalog) for c in plan.children]
+    if not plan.children:
+        return plan
+    return _rebuild(plan, kids)

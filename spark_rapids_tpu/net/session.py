@@ -1,0 +1,132 @@
+"""Tenant sessions for the network front-end.
+
+Identity is auth-shaped, not auth-grade: a shared-secret token maps to a
+tenant id (``spark.rapids.tpu.net.auth.tokens`` = comma-separated
+``token=tenant`` pairs). With no tokens configured the front-end runs in
+**open mode** — any token (including empty) binds to the ``default``
+tenant — which keeps single-process tests and the bench driver friction
+free while still exercising the session machinery.
+
+Sessions carry the tenant id every subsequent SUBMIT inherits, and are
+reaped after ``net.session.idleTimeoutS`` of silence so a leaked client
+cannot pin server state forever. Token comparison uses
+``hmac.compare_digest`` (no timing oracle on the secret).
+"""
+
+from __future__ import annotations
+
+import hmac
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.net import metrics as _m
+
+DEFAULT_TENANT = "default"
+
+_session_ids = itertools.count(1)
+
+
+def parse_tokens(spec: str) -> Dict[str, str]:
+    """Parse ``token=tenant[,token=tenant...]`` into a mapping; blank
+    spec means open mode. Malformed cells raise ValueError so a typo'd
+    config fails at startup, not at the first rejected client."""
+    tokens: Dict[str, str] = {}
+    for cell in (spec or "").split(","):
+        cell = cell.strip()
+        if not cell:
+            continue
+        token, sep, tenant = cell.partition("=")
+        if not sep or not token.strip() or not tenant.strip():
+            raise ValueError(
+                f"bad net.auth.tokens cell {cell!r}: want token=tenant")
+        tokens[token.strip()] = tenant.strip()
+    return tokens
+
+
+class AuthError(RuntimeError):
+    pass
+
+
+class Session:
+    """One authenticated client connection: tenant identity plus the
+    last-activity clock the idle reaper consults."""
+
+    def __init__(self, tenant: str):
+        self.session_id = next(_session_ids)
+        self.tenant = tenant
+        self.created_at = time.monotonic()
+        self.last_seen = self.created_at
+        self.queries = 0
+        self.closed = False
+
+    def touch(self) -> None:
+        self.last_seen = time.monotonic()
+
+    def idle_s(self) -> float:
+        return time.monotonic() - self.last_seen
+
+
+class SessionManager:
+    """Token->tenant authentication plus session registry and reaping.
+
+    ``authenticate`` is the only way to mint a Session; ``reap_idle`` is
+    called opportunistically from the front-end accept loop (no dedicated
+    timer thread) and marks overdue sessions closed so their connection
+    handlers drop them at the next frame boundary.
+    """
+
+    def __init__(self, tokens: Optional[Dict[str, str]] = None,
+                 idle_timeout_s: float = 300.0):
+        self._tokens = dict(tokens or {})
+        self._idle_timeout_s = float(idle_timeout_s)
+        self._lock = threading.Lock()
+        self._sessions: Dict[int, Session] = {}
+
+    @property
+    def open_mode(self) -> bool:
+        return not self._tokens
+
+    def authenticate(self, token: str) -> Session:
+        tenant = None
+        if self.open_mode:
+            tenant = DEFAULT_TENANT
+        else:
+            for known, mapped in self._tokens.items():
+                if hmac.compare_digest(known.encode(), token.encode()):
+                    tenant = mapped
+                    break
+        if tenant is None:
+            _m.bump("net_auth_fail_total")
+            raise AuthError("unknown token")
+        session = Session(tenant)
+        with self._lock:
+            self._sessions[session.session_id] = session
+            _m.set_level("net_sessions_active", len(self._sessions))
+        return session
+
+    def drop(self, session: Session) -> None:
+        session.closed = True
+        with self._lock:
+            self._sessions.pop(session.session_id, None)
+            _m.set_level("net_sessions_active", len(self._sessions))
+
+    def reap_idle(self) -> List[Session]:
+        """Close every session idle past the timeout; returns the reaped
+        sessions (their handlers observe ``closed`` and hang up)."""
+        reaped: List[Session] = []
+        with self._lock:
+            for sid, session in list(self._sessions.items()):
+                if session.idle_s() > self._idle_timeout_s:
+                    session.closed = True
+                    del self._sessions[sid]
+                    reaped.append(session)
+            _m.set_level("net_sessions_active", len(self._sessions))
+        for _ in reaped:
+            _m.bump("net_sessions_reaped_total")
+        return reaped
+
+    def active(self) -> List[Session]:
+        with self._lock:
+            return list(self._sessions.values())

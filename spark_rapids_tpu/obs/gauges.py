@@ -205,6 +205,49 @@ CATALOG: "List[Tuple[str, str, str]]" = [
      "Served queries currently executing"),
     ("sched_queue_wait_ns_total", "counter",
      "Total time served queries spent waiting in the admission queue"),
+    ("admission_quota_rejected_total", "counter",
+     "Submissions shed because the tenant hit its fair-share queue quota "
+     "(serve.fairshare.*)"),
+    ("admission_unsupported_plan_total", "counter",
+     "Wire submissions shed at the lowering gate: the plan memo + type "
+     "support matrix proved the plan will not lower (serve/lowering.py)"),
+    ("net_connections_total", "counter",
+     "TCP connections accepted by the network front-end (net/frontend.py)"),
+    ("net_connections_active", "gauge",
+     "Front-end connections currently open"),
+    ("net_sessions_active", "gauge",
+     "Authenticated tenant sessions currently live"),
+    ("net_sessions_reaped_total", "counter",
+     "Sessions closed by the idle reaper (net.session.idleTimeoutS)"),
+    ("net_auth_fail_total", "counter",
+     "AUTH frames rejected for an unknown token"),
+    ("net_frames_rx_total", "counter",
+     "Protocol frames received by the front-end"),
+    ("net_frames_tx_total", "counter",
+     "Protocol frames sent by the front-end"),
+    ("net_bytes_rx_total", "counter",
+     "Wire bytes received by the front-end (headers + payloads)"),
+    ("net_bytes_tx_total", "counter",
+     "Wire bytes sent by the front-end (headers + payloads)"),
+    ("net_submit_total", "counter",
+     "SUBMIT frames received (pre-gate, pre-admission)"),
+    ("net_submit_rejected_total", "counter",
+     "Wire submissions answered with a typed ERROR before execution"),
+    ("net_cancel_total", "counter",
+     "CANCEL frames honored by the front-end"),
+    ("net_stream_batches_total", "counter",
+     "Arrow IPC record batches streamed to clients"),
+    ("net_protocol_error_total", "counter",
+     "Connections dropped for malformed/oversized/unexpected frames"),
+    ("net_disconnect_cancel_total", "counter",
+     "Queries cancelled because their client vanished mid-flight"),
+    ("reuse_evict_total", "counter",
+     "Materialization-cache entries evicted by the retention scorer "
+     "(exec/reuse.py)"),
+    ("reuse_evict_bytes_total", "counter",
+     "Bytes freed by materialization-cache eviction"),
+    ("reuse_evict_skipped_active_total", "counter",
+     "Eviction candidates skipped because a reader was replaying them"),
 ]
 
 
@@ -277,6 +320,8 @@ def snapshot() -> Dict[str, int]:
     out.update(_serve_m.counters())
     from spark_rapids_tpu.plan import autotune as _at
     out.update(_at.counters())
+    from spark_rapids_tpu.net import metrics as _net_m
+    out.update(_net_m.counters())
     return out
 
 

@@ -51,6 +51,9 @@ CATALOG: "List[Tuple[str, str]]" = [
     ("serve_deadline_slack_ns",
      "Deadline slack at completion (deadline minus finish; 0 when the "
      "deadline was already blown)"),
+    ("net_stream_ns",
+     "Result-stream window on the wire: RESULT_START through RESULT_END "
+     "(per-tenant labeled family rides on this)"),
 ]
 
 _enabled = True

@@ -53,6 +53,10 @@ CATALOG: "List[Tuple[str, str]]" = [
     ("shuffle:fetch", "One shuffle block fetch round-trip (client side)"),
     ("shuffle:write", "Map-output partition/serialize/spill on the write path"),
     ("mesh:dispatch", "One SPMD dispatch by the mesh executor"),
+    ("net:accept", "Wire SUBMIT intake: decode + table resolve + lowering "
+     "gate, before QueryServer.submit"),
+    ("net:stream", "Result streaming window: Arrow IPC batches over the "
+     "wire, RESULT_START through RESULT_END"),
 ]
 
 _NAMES = frozenset(name for name, _ in CATALOG)

@@ -12,6 +12,13 @@ front door, never an unattributed OOM mid-query. Two admission gates:
   pool later enforces per allocation (pool.set_query_budget) — the
   reservation guarantees the sum of promises is honorable, the pool
   guarantees no query exceeds its own.
+- **Weighted fair share** (opt-in, ``serve.fairshare.enabled``): each
+  tenant's share of the queue is ``weight / total_weight`` of
+  ``maxDepth`` (floor 1 slot, so a configured tenant is never starved
+  outright). A tenant past its quota sheds typed ``reason="quota"``
+  even while the global queue has room — one hot tenant can no longer
+  occupy every waiting slot. Tenants absent from
+  ``serve.fairshare.weights`` weigh ``serve.fairshare.defaultWeight``.
 
 Reference shape: the GpuSemaphore admits tasks against concurrentGpuTasks
 for exactly this reason (SURVEY §2.2) — this controller is the same idea
@@ -30,11 +37,33 @@ from spark_rapids_tpu.serve.context import QueryContext
 
 class AdmissionRejected(RuntimeError):
     """Typed load-shed: the serving runtime refused a submission. ``reason``
-    is one of "queue-full", "memory", "fault-injected", "shutdown"."""
+    is one of "queue-full", "memory", "quota", "fault-injected",
+    "shutdown" (plus the wire-side "unsupported-plan")."""
 
     def __init__(self, reason: str, message: str):
         super().__init__(message)
         self.reason = reason
+
+
+def parse_weights(spec: str) -> Dict[str, float]:
+    """Parse ``tenant=weight[,tenant=weight...]`` (serve.fairshare.weights)
+    into a mapping; malformed cells raise ValueError at configure time."""
+    weights: Dict[str, float] = {}
+    for cell in (spec or "").split(","):
+        cell = cell.strip()
+        if not cell:
+            continue
+        tenant, sep, weight = cell.partition("=")
+        try:
+            w = float(weight)
+        except ValueError:
+            w = -1.0
+        if not sep or not tenant.strip() or w <= 0:
+            raise ValueError(
+                f"bad serve.fairshare.weights cell {cell!r}: want "
+                f"tenant=positive-weight")
+        weights[tenant.strip()] = w
+    return weights
 
 
 class AdmissionController:
@@ -46,6 +75,29 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._queued = 0
         self._reserved: Dict[int, int] = {}  # ctx_id -> reserved bytes
+        self._fairshare = False
+        self._weights: Dict[str, float] = {}
+        self._default_weight = 1.0
+        self._tenant_queued: Dict[str, int] = {}
+
+    def configure_fairshare(self, enabled: bool,
+                            weights: Optional[Dict[str, float]] = None,
+                            default_weight: float = 1.0) -> None:
+        with self._lock:
+            self._fairshare = bool(enabled)
+            self._weights = dict(weights or {})
+            self._default_weight = float(default_weight)
+
+    def tenant_quota(self, tenant: Optional[str]) -> int:
+        """This tenant's fair share of the queue in slots (floor 1)."""
+        tenant = tenant or _m.DEFAULT_TENANT
+        total = sum(self._weights.values())
+        if tenant not in self._weights:
+            total += self._default_weight
+        weight = self._weights.get(tenant, self._default_weight)
+        if total <= 0:
+            return self.max_queue
+        return max(1, int(self.max_queue * weight / total))
 
     # -- gates -------------------------------------------------------------
     def admit(self, ctx: QueryContext) -> None:
@@ -58,6 +110,18 @@ class AdmissionController:
                     "queue-full",
                     f"admission queue full ({self._queued}/{self.max_queue} "
                     f"queued); shedding {ctx.name}")
+            if self._fairshare:
+                tenant = ctx.tenant or _m.DEFAULT_TENANT
+                quota = self.tenant_quota(tenant)
+                held = self._tenant_queued.get(tenant, 0)
+                if held >= quota:
+                    _m.bump("admission_rejected_total")
+                    _m.bump("admission_quota_rejected_total")
+                    raise AdmissionRejected(
+                        "quota",
+                        f"tenant {tenant!r} is at its fair-share quota "
+                        f"({held}/{quota} queue slots); shedding "
+                        f"{ctx.name}")
             reserved = sum(self._reserved.values())
             if ctx.memory_budget and (reserved + ctx.memory_budget
                                       > self.reservable_bytes):
@@ -68,17 +132,32 @@ class AdmissionController:
                     f"{reserved} of {self.reservable_bytes} reservable "
                     f"bytes already promised; shedding {ctx.name}")
             self._queued += 1
+            tenant = ctx.tenant or _m.DEFAULT_TENANT
+            self._tenant_queued[tenant] = (
+                self._tenant_queued.get(tenant, 0) + 1)
             if ctx.memory_budget:
                 self._reserved[ctx.ctx_id] = ctx.memory_budget
             _m.set_level("admission_queue_depth", self._queued)
             _m.set_level("admission_reserved_bytes",
                          sum(self._reserved.values()))
 
-    def dequeued(self) -> None:
+    def _drop_tenant_slot(self, ctx: Optional[QueryContext]) -> None:
+        if ctx is None:
+            return
+        tenant = ctx.tenant or _m.DEFAULT_TENANT
+        held = self._tenant_queued.get(tenant, 0)
+        if held <= 1:
+            self._tenant_queued.pop(tenant, None)
+        else:
+            self._tenant_queued[tenant] = held - 1
+
+    def dequeued(self, ctx: Optional[QueryContext] = None) -> None:
         """A queued query started running (queue slot freed; reservation
-        stays until release)."""
+        stays until release). ``ctx`` frees its tenant's fair-share slot;
+        legacy callers passing nothing still free the global slot."""
         with self._lock:
             self._queued = max(0, self._queued - 1)
+            self._drop_tenant_slot(ctx)
             _m.set_level("admission_queue_depth", self._queued)
 
     def release(self, ctx: QueryContext, still_queued: bool = False) -> None:
@@ -87,6 +166,7 @@ class AdmissionController:
         with self._lock:
             if still_queued:
                 self._queued = max(0, self._queued - 1)
+                self._drop_tenant_slot(ctx)
             self._reserved.pop(ctx.ctx_id, None)
             _m.set_level("admission_queue_depth", self._queued)
             _m.set_level("admission_reserved_bytes",
@@ -98,7 +178,9 @@ class AdmissionController:
                     "max_queue": self.max_queue,
                     "reserved_bytes": sum(self._reserved.values()),
                     "reservable_bytes": self.reservable_bytes,
-                    "reservations": dict(self._reserved)}
+                    "reservations": dict(self._reserved),
+                    "fairshare": self._fairshare,
+                    "tenant_queued": dict(self._tenant_queued)}
 
 
 def reservable_bytes(conf=None, pool=None) -> int:

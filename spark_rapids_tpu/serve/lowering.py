@@ -1,0 +1,69 @@
+"""Admission-time "will this plan lower?" gate for the network front-end.
+
+PR-9's plan memo and PR-15's ``type_support`` matrix already know, before
+any execution, whether every (op, type) cell of a plan lowers to the
+device path — but until this gate that knowledge only surfaced as
+mid-execution fallbacks. The wire SUBMIT path asks here first and sheds
+unsupported plans with a typed ``rejected:unsupported-plan`` error that
+carries the offending cells, so a remote client learns *which* operator
+over *which* type class blocked the plan instead of paying queue wait +
+partial execution for a query the planner already knew it could not run
+on device.
+
+Only the network front-end consults this gate (``net.submitGate.enabled``)
+— in-process ``QueryServer.submit()`` keeps its run-with-fallbacks
+behavior, which plenty of tier-1 tests rely on.
+
+Verdicts are memoized by the plan-memo key (plan fingerprint + conf +
+partitioning); unmemoizable plans (e.g. dropped table weakrefs) are
+re-tagged each time — correctness first, the memo is only a fast path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+_LOCK = threading.Lock()
+_MEMO: Dict[object, tuple] = {}  # key -> ((op, reason) cells, pinned refs)
+_MEMO_CAP = 512
+
+
+def _collect(meta, cells: List[Tuple[str, str]]) -> None:
+    op = type(meta.node).__name__
+    for reason in meta.reasons:
+        cells.append((op, reason))
+    for child in meta.children:
+        _collect(child, cells)
+
+
+def unsupported_cells(df, conf=None) -> List[Tuple[str, str]]:
+    """Every (op, reason) cell that keeps ``df``'s plan off the device
+    path; empty list = the whole plan lowers. Reasons are the
+    ``check_expr``/type_support strings, so a type-matrix miss reads like
+    "`Sum` does not support string inputs"."""
+    from spark_rapids_tpu.plan import plan_cache as _pc
+    from spark_rapids_tpu.plan.overrides import Overrides
+
+    conf = conf if conf is not None else df.conf
+    pinned: List = []  # keeps id()-keyed tables alive while memoized
+    key = _pc.build_key(df.plan, conf, df.shuffle_partitions, pinned)
+    if key is not None:
+        with _LOCK:
+            hit = _MEMO.get(key)
+        if hit is not None:
+            return list(hit[0])
+    meta = Overrides(conf, df.shuffle_partitions).wrap_and_tag(df.plan)
+    cells: List[Tuple[str, str]] = []
+    _collect(meta, cells)
+    if key is not None:
+        with _LOCK:
+            if len(_MEMO) >= _MEMO_CAP:
+                _MEMO.clear()
+            _MEMO[key] = (tuple(cells), pinned)
+    return cells
+
+
+def clear_memo() -> None:
+    with _LOCK:
+        _MEMO.clear()

@@ -25,6 +25,8 @@ _LOCK = threading.Lock()
 _COUNTERS: Dict[str, int] = {
     "admission_submitted_total": 0,
     "admission_rejected_total": 0,
+    "admission_quota_rejected_total": 0,
+    "admission_unsupported_plan_total": 0,
     "admission_budget_exceeded_total": 0,
     "admission_queue_depth": 0,
     "admission_reserved_bytes": 0,

@@ -4,8 +4,11 @@ Accepts N concurrent queries and makes concurrency safe before fast:
 
 - **Admission** (serve/admission.py): bounded queue + HBM budget
   reservations; overload sheds with a typed ``AdmissionRejected``.
-- **Scheduling**: a priority queue (higher ``priority`` first, FIFO within
-  a priority) drained by ``serve.maxConcurrentQueries`` executor threads;
+- **Scheduling**: a priority queue (higher ``priority`` first; within a
+  priority band, earliest absolute deadline first when
+  ``serve.edf.enabled``, submit order breaking ties and deadline-less
+  queries sorting last) drained by ``serve.maxConcurrentQueries``
+  executor threads;
   device-side fairness is the reworked TaskSemaphore (mem/semaphore.py),
   which the execution path enters with the query's priority, deadline
   budget, and cancellation hook.
@@ -126,6 +129,11 @@ class QueryServer:
               else C.SERVE_QUEUE_DEPTH.get(self.conf))
         self.admission = AdmissionController(
             mq, _adm.reservable_bytes(self.conf))
+        self.admission.configure_fairshare(
+            C.SERVE_FAIRSHARE_ENABLED.get(self.conf),
+            _adm.parse_weights(C.SERVE_FAIRSHARE_WEIGHTS.get(self.conf)),
+            C.SERVE_FAIRSHARE_DEFAULT_WEIGHT.get(self.conf))
+        self._edf = bool(C.SERVE_EDF_ENABLED.get(self.conf))
         self.grace_ms = float(C.SERVE_GRACE_MS.get(self.conf))
         self._singleflight = bool(C.SERVE_SINGLEFLIGHT.get(self.conf))
         self._default_budget = int(C.SERVE_DEFAULT_BUDGET.get(self.conf))
@@ -139,7 +147,8 @@ class QueryServer:
         _span.set_enabled(C.METRICS_SPANS_ENABLED.get(self.conf))
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._pq: List[Tuple[int, int, Ticket]] = []  # (-prio, seq, ticket)
+        # (-prio, deadline-key, seq, ticket): EDF within a priority band
+        self._pq: List[Tuple[int, float, int, Ticket]] = []
         self._inflight: Dict[object, Ticket] = {}  # single-flight registry
         self._stopping = False
         self._workers = [
@@ -163,17 +172,21 @@ class QueryServer:
                deadline_ms: Optional[float] = None,
                memory_budget: Optional[int] = None,
                name: Optional[str] = None,
-               tenant: Optional[str] = None) -> Ticket:
+               tenant: Optional[str] = None,
+               trace=None) -> Ticket:
         """Admit one query; returns its Ticket or raises AdmissionRejected.
         Defaults for deadline/budget come from the serve.* conf knobs.
         ``tenant`` keys the per-tenant SLO histograms/outcome counters
-        (None folds into the "default" tenant)."""
+        (None folds into the "default" tenant). ``trace`` lets a caller
+        that already opened a trace (the network front-end propagating a
+        client's TraceContext) keep the query's spans under it; None
+        starts a fresh trace."""
         from spark_rapids_tpu import faults
         from spark_rapids_tpu.obs import events as _ev
         from spark_rapids_tpu.obs import span as _span
 
         submit_t0 = time.perf_counter_ns()
-        trace = _span.new_trace()
+        trace = trace if trace is not None else _span.new_trace()
         _m.bump("admission_submitted_total")
         try:
             faults.check("serve.admit", op=name or "query")
@@ -219,7 +232,14 @@ class QueryServer:
             if key is not None:
                 self._inflight[key] = ticket
             ctx.state = "queued"
-            heapq.heappush(self._pq, (-ctx.priority, next(_seq), ticket))
+            # EDF key: absolute deadline (monotonic s) within the band;
+            # deadline-less queries sort after every deadlined one. With
+            # EDF off the key is constant, restoring pure FIFO-by-seq.
+            deadline_key = (ctx.deadline
+                            if self._edf and ctx.deadline is not None
+                            else (float("inf") if self._edf else 0.0))
+            heapq.heappush(self._pq, (-ctx.priority, deadline_key,
+                                      next(_seq), ticket))
             self._cv.notify()
         _m.note_outcome(tenant, priority, "admitted")
         _span.record_span("query:submit", submit_t0,
@@ -242,8 +262,8 @@ class QueryServer:
                     if self._stopping:
                         return
                     continue
-                _, _, ticket = heapq.heappop(self._pq)
-            self.admission.dequeued()
+                _, _, _, ticket = heapq.heappop(self._pq)
+            self.admission.dequeued(ticket.ctx)
             self._execute(ticket)
 
     def _execute(self, ticket: Ticket) -> None:
@@ -307,7 +327,8 @@ class QueryServer:
         worker beyond any in-flight deadline."""
         with self._lock:
             self._stopping = True
-            pending = [t for _, _, t in self._pq] if cancel_pending else []
+            pending = ([t for _, _, _, t in self._pq]
+                       if cancel_pending else [])
             if cancel_pending:
                 self._pq.clear()
             self._cv.notify_all()

@@ -41,7 +41,7 @@ SRTPU_SLOW_LANE=1 SRTPU_CHAOS_LANE=1 SRTPU_FAULTS_SEED="${SRTPU_FAULTS_SEED:-42}
     tests/test_fusion_diff.py tests/test_reuse_diff.py \
     tests/test_pipeline.py tests/test_faults.py \
     tests/test_reuse.py tests/test_warmstart.py \
-    tests/test_serve.py -q "$@"
+    tests/test_serve.py tests/test_net.py -q "$@"
 
 # Diagnostics-bundle smoke: the --demo query must produce a complete bundle
 # (profiles, journal, metrics exposition, trace, config) without raising.
@@ -103,3 +103,27 @@ print("clients lane OK: wall p50 %.1f ms, %.1f queries/s, %d shed"
       % (m["value"], m["queries_per_s"], m["shed_total"]))
 '
 test -s "$CL_OUT" || { echo "clients lane: missing $CL_OUT" >&2; exit 1; }
+
+# Open-workload overload lane (bench.py --serve-open): Poisson arrivals
+# over the NETWORK front-end at stepped offered loads against a small
+# server — goodput-vs-offered-load + per-tenant shed curves, gated on
+# remote-vs-in-process bit-identity, typed-sheds-only, shedding at the
+# overload step, and a balanced pool. bench.py refuses BENCH_* shrink
+# overrides for this lane; SO_* tunes scale/lambda steps/window only.
+SO_OUT="${TMPDIR:-/tmp}/srtpu_serve_open_smoke.json"
+SO_LOG="${TMPDIR:-/tmp}/srtpu_serve_open_smoke.out"
+SO_SF="${SO_SF:-0.02}" SO_LAMBDAS="${SO_LAMBDAS:-4,16,48}" \
+    SO_WINDOW_S="${SO_WINDOW_S:-3}" \
+    python bench.py --serve-open --budget 420 --serve-open-out "$SO_OUT" \
+    > "$SO_LOG"
+tail -n 1 "$SO_LOG" | python -c '
+import json, sys
+m = json.loads(sys.stdin.read())
+assert m.get("metric") == "serve_open_goodput_queries_per_s", m
+assert m.get("gates_passed") is True, m
+sheds = sum(n for per in m.get("shed_curve", {}).values()
+            for n in per.values())
+print("serve-open lane OK: %.1f queries/s goodput over %d points, "
+      "%d typed sheds" % (m["value"], m["points"], sheds))
+'
+test -s "$SO_OUT" || { echo "serve-open lane: missing $SO_OUT" >&2; exit 1; }

@@ -613,3 +613,152 @@ def test_submit_records_query_lifecycle_spans():
             "query:execute"} <= names
     execute = [s for s in mine[0] if s["name"] == "query:execute"][0]
     assert execute["attrs"]["tenant"] == "acme"
+
+# -- deadline-aware (EDF) scheduling + fair-share admission -----------------
+
+
+class _RecordingDF:
+    """Minimal df stand-in: records its label when executed. Only usable
+    with single-flight disabled (no plan to fingerprint)."""
+
+    def __init__(self, label, order, gate=None):
+        self.label = label
+        self._order = order
+        self._gate = gate
+        self.conf = None
+        self.shuffle_partitions = 1
+
+    def to_arrow(self):
+        if self._gate is not None:
+            self._gate.wait(30)
+        self._order.append(self.label)
+        return pa.table({"x": [1]})
+
+
+def _edf_server(conf_items):
+    conf = C.RapidsConf(dict({C.SERVE_SINGLEFLIGHT.key: False}, **conf_items))
+    return QueryServer(conf, max_concurrent=1)
+
+
+def _run_ordered(srv, specs):
+    """Hold the one worker with a gated blocker, enqueue ``specs`` =
+    [(label, deadline_ms)], release, return execution order."""
+    order = []
+    gate = threading.Event()
+    blocker = srv.submit(_RecordingDF("blocker", order, gate), name="blk")
+    deadline = time.monotonic() + 30
+    while srv.admission._queued and time.monotonic() < deadline:
+        time.sleep(0.005)
+    tickets = [srv.submit(_RecordingDF(label, order), name=label,
+                          deadline_ms=dl)
+               for label, dl in specs]
+    gate.set()
+    blocker.result(timeout_s=60)
+    for tk in tickets:
+        tk.result(timeout_s=60)
+    return order
+
+
+def test_edf_orders_by_deadline_within_priority():
+    """With EDF on (default), queued same-priority queries run earliest-
+    deadline first; no-deadline queries run after every dated one."""
+    srv = _edf_server({})
+    try:
+        order = _run_ordered(srv, [("nodl", None), ("late", 120_000),
+                                   ("soon", 20_000)])
+    finally:
+        srv.close()
+    assert order == ["blocker", "soon", "late", "nodl"]
+
+
+def test_edf_disabled_falls_back_to_fifo():
+    srv = _edf_server({C.SERVE_EDF_ENABLED.key: False})
+    try:
+        order = _run_ordered(srv, [("late", 120_000), ("soon", 20_000),
+                                   ("nodl", None)])
+    finally:
+        srv.close()
+    # pure submission order: deadlines are ignored for ordering
+    assert order == ["blocker", "late", "soon", "nodl"]
+
+
+def test_priority_still_dominates_deadline():
+    """EDF only breaks ties WITHIN a priority band: a high-priority query
+    with a far deadline still beats a low-priority one due sooner."""
+    srv = _edf_server({})
+    try:
+        order = []
+        gate = threading.Event()
+        blocker = srv.submit(_RecordingDF("blocker", order, gate))
+        deadline = time.monotonic() + 30
+        while srv.admission._queued and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t1 = srv.submit(_RecordingDF("lo-soon", order), priority=0,
+                        deadline_ms=20_000)
+        t2 = srv.submit(_RecordingDF("hi-late", order), priority=5,
+                        deadline_ms=120_000)
+        gate.set()
+        for tk in (blocker, t1, t2):
+            tk.result(timeout_s=60)
+    finally:
+        srv.close()
+    assert order == ["blocker", "hi-late", "lo-soon"]
+
+
+def test_fairshare_quota_parse_and_math():
+    from spark_rapids_tpu.serve.admission import parse_weights
+
+    assert parse_weights("") == {}
+    assert parse_weights("a=2, b=1") == {"a": 2.0, "b": 1.0}
+    with pytest.raises(ValueError):
+        parse_weights("a")
+    with pytest.raises(ValueError):
+        parse_weights("a=0")  # non-positive weight
+
+    ac = AdmissionController(max_queue=8, reservable_bytes=1 << 30)
+    ac.configure_fairshare(True, {"a": 3.0, "b": 1.0}, default_weight=1.0)
+    assert ac.tenant_quota("a") == 6  # 8 * 3/4
+    assert ac.tenant_quota("b") == 2
+    # unknown tenant: defaultWeight joins the denominator
+    assert ac.tenant_quota("ghost") == 1  # max(1, int(8 * 1/5))
+
+
+def test_fairshare_quota_sheds_typed_and_frees_on_dequeue():
+    """Tenant a (weight 1 of 2, max_queue 4 -> quota 2) sheds its third
+    QUEUED query with reason 'quota' while tenant b still admits; slots
+    free as queries move from queued to running."""
+    from spark_rapids_tpu.serve import metrics as sm
+
+    conf = C.RapidsConf({
+        C.SERVE_SINGLEFLIGHT.key: False,
+        C.SERVE_FAIRSHARE_ENABLED.key: True,
+        C.SERVE_FAIRSHARE_WEIGHTS.key: "a=1,b=1",
+    })
+    quota_before = sm.counters()["admission_quota_rejected_total"]
+    srv = QueryServer(conf, max_concurrent=1, max_queue=4)
+    try:
+        order = []
+        gate = threading.Event()
+        blocker = srv.submit(_RecordingDF("blocker", order, gate),
+                             tenant="b")
+        deadline = time.monotonic() + 30
+        while srv.admission._queued and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t1 = srv.submit(_RecordingDF("a1", order), tenant="a")
+        t2 = srv.submit(_RecordingDF("a2", order), tenant="a")
+        with pytest.raises(AdmissionRejected) as ei:
+            srv.submit(_RecordingDF("a3", order), tenant="a")
+        assert ei.value.reason == "quota"
+        assert (sm.counters()["admission_quota_rejected_total"]
+                == quota_before + 1)
+        # the OTHER tenant's share is untouched by a's shed
+        tb = srv.submit(_RecordingDF("b1", order), tenant="b")
+        gate.set()
+        for tk in (blocker, t1, t2, tb):
+            tk.result(timeout_s=60)
+        # queue drained -> a's slots freed; it admits again
+        srv.submit(_RecordingDF("a4", order), tenant="a").result(timeout_s=60)
+    finally:
+        srv.close()
+    snap = srv.admission.snapshot()
+    assert snap["fairshare"] and snap["tenant_queued"] == {}

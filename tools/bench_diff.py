@@ -57,7 +57,8 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 _KINDS = (("bench", "BENCH_r*.json"),
           ("multichip", "MULTICHIP_r*.json"),
           ("autotune", "BENCH_AUTOTUNE_r*.json"),
-          ("sortwin", "BENCH_SORTWIN_r*.json"))
+          ("sortwin", "BENCH_SORTWIN_r*.json"),
+          ("serveopen", "BENCH_SERVEOPEN_r*.json"))
 _ONOFF_KINDS = frozenset({"autotune", "sortwin"})
 
 
